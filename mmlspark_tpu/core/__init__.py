@@ -1,0 +1,14 @@
+from .dataframe import DataFrame
+from .params import (BooleanParam, ComplexParam, DictParam, FloatParam,
+                     HasFeaturesCol, HasInputCol, HasInputCols, HasLabelCol,
+                     HasOutputCol, IntParam, ListParam, Param,
+                     ParamValidationError, Params, StringParam)
+from .pipeline import (Estimator, Model, Pipeline, PipelineModel,
+                       PipelineStage, Transformer, UnaryTransformer,
+                       registered_stages)
+from .schema import (CategoricalUtilities, SchemaConstants, SparkSchema,
+                     findUnusedColumnName, image_to_array, is_image_column,
+                     make_binary_row, make_image_row, tag_image_column)
+from .serialize import load_stage, save_stage
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,297 @@
+"""Columnar DataFrame: the framework's data plane.
+
+The reference rides Spark SQL DataFrames (driver plans, executors hold row
+partitions, native code is entered per-partition via mapPartitions — see
+SURVEY.md §1/§3). This framework is TPU-native and Spark-free: the data plane
+is an immutable columnar table of numpy arrays, designed so whole columns can
+be shipped to TPU HBM in one ``jax.device_put`` instead of the reference's
+element-wise JNI copies (reference: cntk-model/.../CNTKModel.scala:67-74).
+
+Key properties:
+  * columns are numpy arrays (numeric, string/object, or object-structs for
+    images); zero-copy from/to pyarrow and pandas where dtypes allow;
+  * per-column metadata dict — carries categorical levels and score-column
+    tags the way the reference stores them in Spark column metadata under
+    ``MMLTag`` (reference: core/schema/.../Categoricals.scala:16-60);
+  * logical partitions (``npartitions``) so partition-parallel semantics
+    (LightGBM workers, DistributedHTTP, PartitionSample) survive; batches are
+    what actually feed the device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+def _as_column(values) -> np.ndarray:
+    if isinstance(values, np.ndarray):
+        return values
+    arr = np.asarray(values)
+    if arr.dtype.kind == "U":  # normalize unicode to object for cheap appends
+        arr = arr.astype(object)
+    if arr.dtype.kind not in "bifuOSU" and arr.ndim == 0:
+        raise TypeError(f"cannot build a column from {type(values)}")
+    return arr
+
+
+def _copy_meta(meta: dict[str, dict]) -> dict[str, dict]:
+    """Deep-copy column metadata. Metadata is small nested dicts (MML_TAG ->
+    {categorical: {...}, kind: ...}); sharing inner dicts across frames lets
+    schema taggers mutate upstream frames, so copy all the way down."""
+    import copy as _copy
+    return {k: _copy.deepcopy(v) for k, v in meta.items()}
+
+
+class DataFrame:
+    """Immutable columnar table. All transforms return new frames (cheap —
+    columns are shared, not copied)."""
+
+    def __init__(self, data: dict[str, Any], metadata: Optional[dict[str, dict]] = None,
+                 npartitions: int = 1):
+        self._cols: dict[str, np.ndarray] = {}
+        n = None
+        for k, v in data.items():
+            col = _as_column(v)
+            if n is None:
+                n = len(col)
+            elif len(col) != n:
+                raise ValueError(f"column {k!r} length {len(col)} != {n}")
+            self._cols[k] = col
+        self._n = 0 if n is None else n
+        self._meta: dict[str, dict] = _copy_meta(metadata or {})
+        self.npartitions = max(1, int(npartitions))
+
+    # ---- construction ----
+    @staticmethod
+    def fromPandas(pdf, npartitions: int = 1) -> "DataFrame":
+        return DataFrame({c: pdf[c].to_numpy() for c in pdf.columns},
+                         npartitions=npartitions)
+
+    @staticmethod
+    def fromArrow(table, npartitions: int = 1) -> "DataFrame":
+        data = {}
+        for name, col in zip(table.column_names, table.columns):
+            data[name] = col.to_numpy(zero_copy_only=False)
+        return DataFrame(data, npartitions=npartitions)
+
+    @staticmethod
+    def fromRows(rows: Sequence[dict], npartitions: int = 1) -> "DataFrame":
+        if not rows:
+            return DataFrame({})
+        keys = list(rows[0].keys())
+        return DataFrame({k: [r[k] for r in rows] for k in keys},
+                         npartitions=npartitions)
+
+    # ---- basic introspection ----
+    @property
+    def columns(self) -> list[str]:
+        return list(self._cols)
+
+    def count(self) -> int:
+        return self._n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def col(self, name: str) -> np.ndarray:
+        if name not in self._cols:
+            raise KeyError(f"no column {name!r}; have {self.columns}")
+        return self._cols[name]
+
+    __getitem__ = col
+
+    def dtypes(self) -> dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self._cols.items()}
+
+    def metadata(self, name: str) -> dict:
+        import copy as _copy
+        return _copy.deepcopy(self._meta.get(name, {}))
+
+    def schema(self) -> dict[str, dict]:
+        return {k: {"dtype": str(v.dtype), "metadata": self.metadata(k)}
+                for k, v in self._cols.items()}
+
+    # ---- transforms (all return new DataFrames) ----
+    def _derive(self, cols: dict[str, np.ndarray], meta: dict[str, dict]) -> "DataFrame":
+        df = DataFrame({}, npartitions=self.npartitions)
+        df._cols = cols
+        df._n = len(next(iter(cols.values()))) if cols else 0
+        df._meta = meta
+        return df
+
+    def select(self, *names: str) -> "DataFrame":
+        flat: list[str] = []
+        for n in names:
+            flat.extend(n if isinstance(n, (list, tuple)) else [n])
+        return self._derive({n: self.col(n) for n in flat},
+                            _copy_meta({n: self._meta[n] for n in flat if n in self._meta}))
+
+    def drop(self, *names: str) -> "DataFrame":
+        dropset = set(names)
+        return self._derive({k: v for k, v in self._cols.items() if k not in dropset},
+                            _copy_meta({k: v for k, v in self._meta.items() if k not in dropset}))
+
+    def withColumn(self, name: str, values, metadata: Optional[dict] = None) -> "DataFrame":
+        col = _as_column(values)
+        if self._cols and len(col) != self._n:
+            raise ValueError(f"new column {name!r} length {len(col)} != {self._n}")
+        cols = dict(self._cols)
+        cols[name] = col
+        meta = _copy_meta(self._meta)
+        if metadata is not None:
+            meta[name] = _copy_meta({name: metadata})[name]
+        elif name in meta:
+            del meta[name]  # replaced column loses stale metadata
+        return self._derive(cols, meta)
+
+    def withMetadata(self, name: str, metadata: dict) -> "DataFrame":
+        self.col(name)
+        meta = _copy_meta(self._meta)
+        meta[name] = _copy_meta({name: metadata})[name]
+        return self._derive(dict(self._cols), meta)
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        cols = {}
+        for k, v in self._cols.items():
+            cols[new if k == old else k] = v
+        meta = _copy_meta({(new if k == old else k): v for k, v in self._meta.items()})
+        return self._derive(cols, meta)
+
+    def filter(self, mask) -> "DataFrame":
+        """mask: boolean array or row-dict predicate."""
+        if callable(mask):
+            mask = np.fromiter((bool(mask(r)) for r in self.iterRows()),
+                               dtype=bool, count=self._n)
+        mask = np.asarray(mask, dtype=bool)
+        return self._derive({k: v[mask] for k, v in self._cols.items()},
+                            _copy_meta(self._meta))
+
+    where = filter
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._derive({k: v[:n] for k, v in self._cols.items()},
+                            _copy_meta(self._meta))
+
+    def sort(self, name: str, ascending: bool = True) -> "DataFrame":
+        order = np.argsort(self.col(name), kind="stable")
+        if not ascending:
+            order = order[::-1]
+        return self._derive({k: v[order] for k, v in self._cols.items()},
+                            _copy_meta(self._meta))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if set(self.columns) != set(other.columns):
+            raise ValueError("union requires identical column sets")
+        cols = {k: np.concatenate([self._cols[k], other._cols[k]]) for k in self._cols}
+        return self._derive(cols, _copy_meta(self._meta))
+
+    def dropna(self, subset: Optional[Sequence[str]] = None) -> "DataFrame":
+        names = list(subset) if subset else self.columns
+        mask = np.ones(self._n, dtype=bool)
+        for nme in names:
+            c = self.col(nme)
+            if c.dtype.kind == "f":
+                mask &= ~np.isnan(c)
+            elif c.dtype.kind == "O":
+                mask &= np.array([x is not None and x == x for x in c], dtype=bool)
+        return self.filter(mask)
+
+    def randomSplit(self, weights: Sequence[float], seed: int = 0) -> list["DataFrame"]:
+        w = np.asarray(weights, dtype=np.float64)
+        w = w / w.sum()
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self._n)
+        bounds = np.floor(np.cumsum(w) * self._n).astype(int)
+        bounds[-1] = self._n  # cumsum rounding must not drop tail rows
+        out, start = [], 0
+        for b in bounds:
+            idx = np.sort(perm[start:b])
+            out.append(self._derive({k: v[idx] for k, v in self._cols.items()},
+                                    _copy_meta(self._meta)))
+            start = b
+        return out
+
+    def sample(self, fraction: float, seed: int = 0) -> "DataFrame":
+        rng = np.random.default_rng(seed)
+        mask = rng.random(self._n) < fraction
+        return self.filter(mask)
+
+    # ---- partition semantics ----
+    def repartition(self, n: int) -> "DataFrame":
+        df = self._derive(dict(self._cols), _copy_meta(self._meta))
+        df.npartitions = max(1, int(n))
+        return df
+
+    coalesce = repartition
+
+    def partitionBounds(self) -> list[tuple[int, int]]:
+        edges = np.linspace(0, self._n, self.npartitions + 1).astype(int)
+        return [(int(edges[i]), int(edges[i + 1])) for i in range(self.npartitions)]
+
+    def partitions(self) -> Iterator["DataFrame"]:
+        for lo, hi in self.partitionBounds():
+            yield self._derive({k: v[lo:hi] for k, v in self._cols.items()},
+                               _copy_meta(self._meta))
+
+    def mapPartitions(self, fn: Callable[["DataFrame"], "DataFrame"]) -> "DataFrame":
+        parts = [fn(p) for p in self.partitions()]
+        parts = [p for p in parts if p is not None and len(p.columns)]
+        if not parts:
+            return DataFrame({})
+        names = parts[0].columns
+        for p in parts[1:]:
+            if set(p.columns) != set(names):
+                raise ValueError("mapPartitions results have differing columns")
+        cols = {k: np.concatenate([p._cols[k] for p in parts]) for k in names}
+        out = parts[0]._derive(cols, _copy_meta(parts[0]._meta))
+        out.npartitions = self.npartitions
+        return out
+
+    # ---- no-op persistence hooks (API parity with Spark-side Cacher etc.) ----
+    def cache(self) -> "DataFrame":
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        return self
+
+    # ---- export ----
+    def iterRows(self) -> Iterator[dict]:
+        names = self.columns
+        cols = [self._cols[n] for n in names]
+        for i in range(self._n):
+            yield {n: c[i] for n, c in zip(names, cols)}
+
+    def collect(self) -> list[dict]:
+        return list(self.iterRows())
+
+    def head(self, n: int = 5) -> list[dict]:
+        return self.limit(n).collect()
+
+    def first(self) -> dict:
+        if self._n == 0:
+            raise IndexError("empty DataFrame")
+        return next(self.iterRows())
+
+    def toPandas(self):
+        import pandas as pd
+        return pd.DataFrame({k: list(v) if v.ndim > 1 or v.dtype.kind == "O" else v
+                             for k, v in self._cols.items()})
+
+    def toArrow(self):
+        import pyarrow as pa
+        return pa.table({k: pa.array(list(v)) if v.dtype.kind == "O" else pa.array(v)
+                         for k, v in self._cols.items()})
+
+    def iterBatches(self, batch_size: int) -> Iterator["DataFrame"]:
+        for lo in range(0, self._n, batch_size):
+            hi = min(lo + batch_size, self._n)
+            yield self._derive({k: v[lo:hi] for k, v in self._cols.items()},
+                               _copy_meta(self._meta))
+
+    def __repr__(self):
+        spec = ", ".join(f"{k}:{v.dtype}" for k, v in self._cols.items())
+        return f"DataFrame[{self._n} rows, {self.npartitions} parts]({spec})"

@@ -1,0 +1,146 @@
+"""Stage save/load, including non-JSON ("complex") params.
+
+Plays the role of the reference's core/serialize package: ComplexParam +
+ComplexParamsWritable/Readable (reference: src/core/serialize/src/main/scala/
+ComplexParamsSerializer.scala:16-33,137) which persist Spark stages whose
+params aren't JSON-able (inner models, UDFs, byte arrays).
+
+Layout on disk:
+    <path>/metadata.json            class name, uid, simple params, complex index
+    <path>/complex/<param>...       one entry per complex param, kind-tagged:
+        stage/        a nested PipelineStage (recursive save)
+        stage_list/0..N  list/tuple of stages
+        ndarray .npy  numpy array
+        pytree .msgpack  JAX/flax pytree (dict/list of arrays) via flax msgpack
+        pickle .pkl   anything else picklable
+
+Pytrees use flax.serialization msgpack — the TPU-native answer to the
+reference's save-model-to-bytes trick (SerializableFunction.scala:58-82).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+from typing import Any
+
+import numpy as np
+
+from .pipeline import PipelineStage, _qualname, lookup_stage_class
+
+_FORMAT_VERSION = 1
+
+
+def _ensure_registry_populated():
+    # importing the root package registers every stage subclass
+    import mmlspark_tpu  # noqa: F401
+
+
+def _save_complex(value: Any, path: str) -> dict:
+    if isinstance(value, PipelineStage):
+        save_stage(value, path)
+        return {"kind": "stage"}
+    if isinstance(value, (list, tuple)) and value and all(
+            isinstance(v, PipelineStage) for v in value):
+        os.makedirs(path, exist_ok=True)
+        for i, v in enumerate(value):
+            save_stage(v, os.path.join(path, str(i)))
+        return {"kind": "stage_list", "n": len(value)}
+    if isinstance(value, np.ndarray):
+        np.save(path + ".npy", value)
+        return {"kind": "ndarray"}
+    # try a flax-msgpack pytree (covers jax arrays / nested dicts of arrays)
+    try:
+        from flax import serialization
+        blob = serialization.msgpack_serialize(value)
+        with open(path + ".msgpack", "wb") as f:
+            f.write(blob)
+        return {"kind": "pytree"}
+    except Exception:
+        pass
+    with open(path + ".pkl", "wb") as f:
+        pickle.dump(value, f)
+    return {"kind": "pickle"}
+
+
+def _load_complex(tag: dict, path: str) -> Any:
+    kind = tag["kind"]
+    if kind == "stage":
+        return load_stage(path)
+    if kind == "stage_list":
+        return tuple(load_stage(os.path.join(path, str(i)))
+                     for i in range(tag["n"]))
+    if kind == "ndarray":
+        return np.load(path + ".npy", allow_pickle=False)
+    if kind == "pytree":
+        from flax import serialization
+        import jax.numpy as jnp
+
+        def _to_jax(x):
+            if isinstance(x, dict):
+                return {k: _to_jax(v) for k, v in x.items()}
+            if isinstance(x, (list, tuple)):
+                return type(x)(_to_jax(v) for v in x)
+            if isinstance(x, np.ndarray):
+                return jnp.asarray(x)
+            return x
+        with open(path + ".msgpack", "rb") as f:
+            return _to_jax(serialization.msgpack_restore(f.read()))
+    if kind == "pickle":
+        with open(path + ".pkl", "rb") as f:
+            return pickle.load(f)
+    raise ValueError(f"unknown complex-param kind {kind!r}")
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def save_stage(stage: PipelineStage, path: str, overwrite: bool = True):
+    if os.path.exists(path):
+        if not overwrite:
+            raise FileExistsError(path)
+        shutil.rmtree(path)
+    os.makedirs(path)
+
+    simple, complex_idx = {}, {}
+    complex_dir = os.path.join(path, "complex")
+    for name, value in stage._paramMap.items():
+        p = stage._params[name]
+        if p.jsonable and _jsonable(value):
+            simple[name] = value
+        else:
+            os.makedirs(complex_dir, exist_ok=True)
+            complex_idx[name] = _save_complex(
+                value, os.path.join(complex_dir, name))
+
+    meta = {"format": _FORMAT_VERSION, "class": _qualname(type(stage)),
+            "uid": stage.uid, "params": simple, "complex": complex_idx}
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+
+
+def load_stage(path: str) -> PipelineStage:
+    _ensure_registry_populated()
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    cls = lookup_stage_class(meta["class"])
+    # stages must be no-arg constructible (same contract as Spark ML stages);
+    # going through __init__ restores any non-param instance state
+    stage = cls()
+    stage.uid = meta["uid"]
+    # restore simple params through validation; tuples arrive as JSON lists
+    for k, v in meta["params"].items():
+        if isinstance(v, list) and isinstance(stage._params[k].default, tuple):
+            v = tuple(v)
+        stage.set(**{k: v})
+    for k, tag in meta["complex"].items():
+        stage._paramMap[k] = _load_complex(
+            tag, os.path.join(path, "complex", k))
+    return stage

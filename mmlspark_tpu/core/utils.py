@@ -1,0 +1,65 @@
+"""Small shared utilities (reference: core/env FileUtilities/StreamUtilities/
+Logging, core/utils CastUtilities)."""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+import time
+from typing import Iterator
+
+import numpy as np
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(f"mmlspark_tpu.{name}")
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s %(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(os.environ.get("MMLSPARK_TPU_LOGLEVEL", "WARNING"))
+    return logger
+
+
+@contextlib.contextmanager
+def timed(label: str, logger: logging.Logger | None = None) -> Iterator[dict]:
+    out = {"label": label}
+    t0 = time.perf_counter()
+    yield out
+    out["seconds"] = time.perf_counter() - t0
+    if logger:
+        logger.info("%s took %.3fs", label, out["seconds"])
+
+
+def sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def to_float32_matrix(col: np.ndarray) -> np.ndarray:
+    """Coerce a column of scalars / vectors / lists into an (n, d) float32
+    matrix — the device-feed analog of the reference's input coercion UDF
+    (CNTKModel.scala:232-241), done once per column instead of per element."""
+    if col.dtype.kind in "bifu":
+        if col.ndim == 1:
+            return col.astype(np.float32).reshape(-1, 1)
+        return col.astype(np.float32).reshape(len(col), -1)
+    return np.stack([np.asarray(v, dtype=np.float32).ravel() for v in col])
+
+
+def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int = 0):
+    """Pad axis to a multiple (XLA static shapes want bucketed batches).
+    Returns (padded, original_length)."""
+    n = arr.shape[axis]
+    rem = (-n) % multiple
+    if rem == 0:
+        return arr, n
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, rem)
+    return np.pad(arr, widths), n

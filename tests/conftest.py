@@ -1,0 +1,43 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh so every multi-chip sharding path
+(pjit/shard_map over Mesh) is exercised without TPU hardware — the JAX analog
+of the reference's "partitions-as-workers" local-mode trick (SURVEY.md §4:
+LightGBM tests make each Spark partition a network worker on localhost).
+
+Env must be set before jax import, hence module scope here.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def toy_df():
+    from mmlspark_tpu import DataFrame
+    rng = np.random.default_rng(0)
+    n = 64
+    return DataFrame({
+        "x1": rng.normal(size=n),
+        "x2": rng.normal(size=n),
+        "cat": np.array(list("abcd") * (n // 4), dtype=object),
+        "label": (rng.random(n) > 0.5).astype(np.float64),
+        "text": np.array(["hello world foo", "bar baz qux quux"] * (n // 2),
+                         dtype=object),
+    })

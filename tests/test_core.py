@@ -1,0 +1,212 @@
+"""Core substrate tests: params DSL, DataFrame, pipeline, schema, serialization.
+
+Models the reference's core test style (TestBase + per-component suites,
+SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core import (CategoricalUtilities, DataFrame, Estimator,
+                               FloatParam, IntParam, Model, Pipeline,
+                               PipelineStage, SparkSchema, StringParam,
+                               Transformer, UnaryTransformer,
+                               findUnusedColumnName, load_stage,
+                               registered_stages)
+from mmlspark_tpu.core.params import ParamValidationError
+from mmlspark_tpu.core.schema import SchemaConstants
+
+
+class _AddConst(UnaryTransformer):
+    """Toy stage used by the contract tests."""
+    inputCol = StringParam("input col", default="x1")
+    outputCol = StringParam("output col", default="out")
+    value = FloatParam("constant to add", default=1.0)
+
+    def _transform_column(self, values, df):
+        return np.asarray(values, dtype=np.float64) + self.getValue()
+
+
+class _MeanModel(Model):
+    inputCol = StringParam("in", default="x1")
+    outputCol = StringParam("out", default="centered")
+    mean = FloatParam("fitted mean", default=0.0)
+
+    def transform(self, df):
+        return df.withColumn(self.getOutputCol(),
+                             df.col(self.getInputCol()) - self.getMean())
+
+
+class _Center(Estimator):
+    inputCol = StringParam("in", default="x1")
+    outputCol = StringParam("out", default="centered")
+
+    def fit(self, df):
+        m = float(np.mean(df.col(self.getInputCol())))
+        return (_MeanModel().setInputCol(self.getInputCol())
+                .setOutputCol(self.getOutputCol()).setMean(m))
+
+
+class TestParams:
+    def test_defaults_and_set(self):
+        t = _AddConst()
+        assert t.getValue() == 1.0
+        t.setValue(2.5)
+        assert t.getValue() == 2.5
+        assert t.getInputCol() == "x1"
+
+    def test_type_checking(self):
+        with pytest.raises(ParamValidationError):
+            _AddConst().setValue("nope")
+
+    def test_domain_validation(self):
+        class Ranged(Transformer):
+            n = IntParam("bounded", default=1, min=0, max=10)
+
+            def transform(self, df):
+                return df
+        with pytest.raises(ParamValidationError):
+            Ranged().setN(11)
+        Ranged().setN(10)
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(KeyError):
+            _AddConst().set(bogus=1)
+
+    def test_copy_isolated(self):
+        a = _AddConst().setValue(3.0)
+        b = a.copy({"value": 4.0})
+        assert a.getValue() == 3.0 and b.getValue() == 4.0
+
+    def test_explain(self):
+        assert "constant to add" in _AddConst().explainParams()
+
+
+class TestDataFrame:
+    def test_select_drop_rename(self, toy_df):
+        assert toy_df.select("x1", "x2").columns == ["x1", "x2"]
+        assert "x1" not in toy_df.drop("x1").columns
+        assert "z" in toy_df.withColumnRenamed("x1", "z").columns
+
+    def test_with_column_and_filter(self, toy_df):
+        df = toy_df.withColumn("y", toy_df.col("x1") * 2)
+        np.testing.assert_allclose(df.col("y"), toy_df.col("x1") * 2)
+        sub = df.filter(df.col("x1") > 0)
+        assert (sub.col("x1") > 0).all()
+
+    def test_random_split_partition(self, toy_df):
+        a, b = toy_df.randomSplit([0.75, 0.25], seed=1)
+        assert a.count() + b.count() == toy_df.count()
+        parts = list(toy_df.repartition(4).partitions())
+        assert len(parts) == 4
+        assert sum(p.count() for p in parts) == toy_df.count()
+
+    def test_map_partitions(self, toy_df):
+        out = toy_df.repartition(3).mapPartitions(
+            lambda p: p.withColumn("n", np.full(p.count(), p.count())))
+        assert out.count() == toy_df.count()
+
+    def test_round_trips(self, toy_df):
+        pdf = toy_df.toPandas()
+        back = DataFrame.fromPandas(pdf)
+        assert back.count() == toy_df.count()
+        tbl = toy_df.select("x1", "cat").toArrow()
+        back2 = DataFrame.fromArrow(tbl)
+        np.testing.assert_allclose(back2.col("x1"), toy_df.col("x1"))
+
+    def test_union_sort_dropna(self):
+        df = DataFrame({"a": [3.0, np.nan, 1.0]})
+        assert df.dropna().count() == 2
+        assert df.dropna().sort("a").col("a")[0] == 1.0
+        assert df.union(df).count() == 6
+
+    def test_immutability(self, toy_df):
+        before = toy_df.col("x1").copy()
+        toy_df.withColumn("x1", toy_df.col("x1") * 0)
+        np.testing.assert_allclose(toy_df.col("x1"), before)
+
+
+class TestSchema:
+    def test_categorical_metadata(self, toy_df):
+        df = CategoricalUtilities.setLevels(toy_df, "cat", ["a", "b", "c", "d"])
+        assert CategoricalUtilities.getLevels(df, "cat") == ["a", "b", "c", "d"]
+        assert CategoricalUtilities.isCategorical(df, "cat")
+        assert not CategoricalUtilities.isCategorical(df, "x1")
+        # metadata survives unrelated transforms
+        df2 = df.withColumn("zz", np.zeros(df.count()))
+        assert CategoricalUtilities.getLevels(df2, "cat") == ["a", "b", "c", "d"]
+
+    def test_score_tagging(self, toy_df):
+        df = SparkSchema.setScoresColumnName(toy_df, "x2")
+        assert SparkSchema.findColumnByKind(
+            df, SchemaConstants.ScoresColumnKind) == "x2"
+
+    def test_unused_column_name(self, toy_df):
+        assert findUnusedColumnName("x1", toy_df) == "x1_1"
+        assert findUnusedColumnName("fresh", toy_df) == "fresh"
+
+
+class TestPipeline:
+    def test_fit_transform_chain(self, toy_df):
+        pipe = Pipeline().setStages((
+            _Center().setInputCol("x1").setOutputCol("c1"),
+            _AddConst().setInputCol("c1").setOutputCol("plus"),
+        ))
+        model = pipe.fit(toy_df)
+        out = model.transform(toy_df)
+        assert abs(np.mean(out.col("c1"))) < 1e-9
+        np.testing.assert_allclose(out.col("plus"), out.col("c1") + 1.0)
+
+    def test_registry_contains_stages(self):
+        reg = registered_stages()
+        bare = {q.rsplit(".", 1)[-1] for q in reg}
+        assert "Pipeline" in bare and "_AddConst" in bare
+
+    def test_transform_on_unfitted_estimator_pipeline_raises(self, toy_df):
+        pipe = Pipeline().setStages((_Center(), _AddConst()))
+        with pytest.raises(TypeError):
+            pipe.transform(toy_df)
+
+    def test_metadata_isolation_across_frames(self, toy_df):
+        df1 = SparkSchema.setScoresColumnName(toy_df, "x2")
+        df2 = SparkSchema.setColumnKind(
+            df1, "x2", SchemaConstants.TrueLabelsColumnKind)
+        assert SparkSchema.getColumnKind(
+            df1, "x2") == SchemaConstants.ScoresColumnKind
+        assert SparkSchema.getColumnKind(
+            df2, "x2") == SchemaConstants.TrueLabelsColumnKind
+
+    def test_random_split_never_drops_rows(self):
+        df = DataFrame({"a": np.arange(7.0)})
+        parts = df.randomSplit([0.511, 0.976, 0.081, 0.607], seed=3)
+        assert sum(p.count() for p in parts) == 7
+
+
+class TestSerialization:
+    def test_stage_roundtrip(self, toy_df, tmp_path):
+        t = _AddConst().setValue(7.0).setInputCol("x2")
+        p = str(tmp_path / "stage")
+        t.save(p)
+        t2 = load_stage(p)
+        assert isinstance(t2, _AddConst) and t2.getValue() == 7.0
+        np.testing.assert_allclose(t2.transform(toy_df).col("out"),
+                                   t.transform(toy_df).col("out"))
+
+    def test_fitted_pipeline_roundtrip(self, toy_df, tmp_path):
+        pipe = Pipeline().setStages((
+            _Center(), _AddConst().setInputCol("centered").setOutputCol("o")))
+        model = pipe.fit(toy_df)
+        p = str(tmp_path / "pm")
+        model.save(p)
+        model2 = load_stage(p)
+        a = model.transform(toy_df)
+        b = model2.transform(toy_df)
+        for c in a.columns:
+            if a.col(c).dtype.kind in "if":
+                np.testing.assert_allclose(a.col(c), b.col(c))
+
+    def test_unfitted_pipeline_roundtrip(self, tmp_path):
+        pipe = Pipeline().setStages((_Center(), _AddConst()))
+        p = str(tmp_path / "pipe")
+        pipe.save(p)
+        pipe2 = load_stage(p)
+        assert len(pipe2.getStages()) == 2
