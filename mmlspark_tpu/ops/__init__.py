@@ -1,0 +1,6 @@
+from . import image_ops, text_ops
+from .image_stages import ImageSetAugmenter, ImageTransformer, UnrollImage
+from .text_stages import TextFeaturizer, TextFeaturizerModel
+
+__all__ = ["image_ops", "text_ops", "ImageTransformer", "UnrollImage",
+           "ImageSetAugmenter", "TextFeaturizer", "TextFeaturizerModel"]

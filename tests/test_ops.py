@@ -1,0 +1,192 @@
+"""Image + text op tests (reference test model: ImageTransformerSuite,
+TextFeaturizerSpec — SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.schema import image_to_array, make_image_row
+from mmlspark_tpu.ops import (ImageSetAugmenter, ImageTransformer,
+                              TextFeaturizer, UnrollImage, image_ops, text_ops)
+
+
+def _image_df(n=4, h=8, w=6, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    rows = np.empty(n, dtype=object)
+    for i in range(n):
+        arr = rng.integers(0, 256, size=(h, w, c), dtype=np.uint8)
+        rows[i] = make_image_row(f"img{i}.png", h, w, c, arr)
+    return DataFrame({"image": rows, "idx": np.arange(n)})
+
+
+class TestImageOps:
+    def test_resize_shape_and_range(self):
+        x = np.random.default_rng(0).uniform(0, 255, (2, 8, 8, 3)).astype(np.float32)
+        out = np.asarray(image_ops.resize(x, 4, 6))
+        assert out.shape == (2, 4, 6, 3)
+        assert out.min() >= 0 and out.max() <= 255
+
+    def test_crop_opencv_rect_semantics(self):
+        # Rect(x, y, w, h): x = column offset, y = row offset
+        x = np.arange(2 * 8 * 8 * 1, dtype=np.float32).reshape(2, 8, 8, 1)
+        out = np.asarray(image_ops.crop(x, 2, 3, 4, 5))
+        np.testing.assert_array_equal(out, x[:, 3:7, 2:7, :])
+
+    def test_blur_kernel_larger_than_image(self):
+        x = np.full((1, 3, 10, 1), 5.0, dtype=np.float32)
+        out = np.asarray(image_ops.blur(x, 7, 7))
+        assert out.shape == (1, 3, 10, 1)
+        np.testing.assert_allclose(out, 5.0, rtol=1e-5)
+
+    def test_flip_codes(self):
+        x = np.arange(1 * 2 * 3 * 1, dtype=np.float32).reshape(1, 2, 3, 1)
+        np.testing.assert_array_equal(np.asarray(image_ops.flip(x, 0)), x[:, ::-1])
+        np.testing.assert_array_equal(np.asarray(image_ops.flip(x, 1)), x[:, :, ::-1])
+        np.testing.assert_array_equal(np.asarray(image_ops.flip(x, -1)),
+                                      x[:, ::-1, ::-1])
+
+    def test_blur_is_box_mean(self):
+        x = np.ones((1, 5, 5, 2), dtype=np.float32) * 10
+        out = np.asarray(image_ops.blur(x, 3, 3))
+        np.testing.assert_allclose(out, 10.0, rtol=1e-5)
+
+    def test_gaussian_blur_preserves_mean_of_constant(self):
+        x = np.full((1, 9, 9, 1), 7.0, dtype=np.float32)
+        out = np.asarray(image_ops.gaussian_blur(x, 5, 1.5))
+        np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+    def test_threshold_binary(self):
+        x = np.array([[[[10.0], [200.0]]]], dtype=np.float32)
+        out = np.asarray(image_ops.threshold(x, 100.0, 255.0, "binary"))
+        np.testing.assert_array_equal(out.ravel(), [0.0, 255.0])
+
+    def test_color_format_bgr2gray(self):
+        x = np.zeros((1, 2, 2, 3), dtype=np.float32)
+        x[..., 2] = 100.0  # red channel in BGR
+        out = np.asarray(image_ops.color_format(x, "BGR2GRAY"))
+        assert out.shape == (1, 2, 2, 1)
+        np.testing.assert_allclose(out, 29.9, rtol=1e-4)
+
+    def test_unroll_is_chw(self):
+        x = np.arange(1 * 2 * 2 * 3, dtype=np.float32).reshape(1, 2, 2, 3)
+        out = np.asarray(image_ops.unroll(x))
+        np.testing.assert_array_equal(
+            out[0], np.transpose(x[0], (2, 0, 1)).ravel())
+
+    def test_fused_chain(self):
+        x = np.random.default_rng(1).uniform(0, 255, (3, 16, 16, 3)).astype(np.float32)
+        out = image_ops.apply_op_chain(
+            x, [{"op": "resize", "height": 8, "width": 8},
+                {"op": "flip", "flipCode": 1},
+                {"op": "blur", "height": 3, "width": 3}])
+        assert out.shape == (3, 8, 8, 3)
+
+
+class TestImageStages:
+    def test_transformer_pipeline(self):
+        df = _image_df()
+        t = (ImageTransformer().setInputCol("image").setOutputCol("small")
+             .resize(4, 4).flip(1))
+        out = t.transform(df)
+        img = out.col("small")[0]
+        assert (img["height"], img["width"], img["type"]) == (4, 4, 3)
+        # flip(resize(x)) == what we get
+        src = image_to_array(df.col("image")[0]).astype(np.float32)[None]
+        ref = np.asarray(image_ops.flip(image_ops.resize(src, 4, 4), 1))[0]
+        got = image_to_array(img).astype(np.float32)
+        np.testing.assert_allclose(got, np.clip(np.rint(ref), 0, 255), atol=1)
+
+    def test_mixed_shapes_grouped(self):
+        rows = np.empty(3, dtype=object)
+        rng = np.random.default_rng(0)
+        for i, (h, w) in enumerate([(8, 8), (6, 4), (8, 8)]):
+            rows[i] = make_image_row(f"i{i}", h, w, 3,
+                                     rng.integers(0, 256, (h, w, 3), dtype=np.uint8))
+        df = DataFrame({"image": rows})
+        out = ImageTransformer().setInputCol("image").setOutputCol("o") \
+            .resize(5, 5).transform(df)
+        assert all(r["height"] == 5 and r["width"] == 5 for r in out.col("o"))
+
+    def test_unroll_stage(self):
+        df = _image_df(n=2, h=3, w=3, c=3)
+        out = UnrollImage().setInputCol("image").setOutputCol("v").transform(df)
+        v = out.col("v")[0]
+        assert v.shape == (27,)
+        arr = image_to_array(df.col("image")[0]).astype(np.float64)
+        np.testing.assert_array_equal(v, np.transpose(arr, (2, 0, 1)).ravel())
+
+    def test_augmenter_doubles_rows(self):
+        df = _image_df(n=3)
+        out = ImageSetAugmenter().setInputCol("image").setOutputCol("image") \
+            .setFlipLeftRight(True).setFlipUpDown(False).transform(df)
+        assert out.count() == 6
+
+    def test_serialization_roundtrip(self, tmp_path):
+        t = ImageTransformer().resize(4, 4).flip(1)
+        t.save(str(tmp_path / "it"))
+        from mmlspark_tpu.core import load_stage
+        t2 = load_stage(str(tmp_path / "it"))
+        assert [d["op"] for d in t2.getStages()] == ["resize", "flip"]
+
+
+class TestTextOps:
+    def test_tokenize_gaps_and_lowercase(self):
+        docs = text_ops.tokenize(["Hello  World", "Foo-bar"])
+        assert docs == [["hello", "world"], ["foo-bar"]]
+
+    def test_stopwords(self):
+        docs = text_ops.remove_stopwords([["the", "cat", "and", "dog"]])
+        assert docs == [["cat", "dog"]]
+
+    def test_ngrams(self):
+        assert text_ops.ngrams([["a", "b", "c"]], 2) == [["a b", "b c"]]
+
+    def test_hashing_tf_counts(self):
+        tf = text_ops.hashing_tf([["a", "b", "a"], ["b"]], 32)
+        assert tf.shape == (2, 32)
+        assert tf[0].sum() == 3 and tf[1].sum() == 1
+        ha = text_ops.hash_token("a", 32)
+        assert tf[0, ha] == 2
+
+    def test_idf_downweights_common_terms(self):
+        docs = [["common", "rare1"], ["common", "rare2"], ["common"]]
+        tf = text_ops.hashing_tf(docs, 64)
+        w = text_ops.idf_weights(tf)
+        hc = text_ops.hash_token("common", 64)
+        hr = text_ops.hash_token("rare1", 64)
+        assert w[hc] < w[hr]
+
+    def test_featurizer_end_to_end(self, toy_df):
+        model = (TextFeaturizer().setInputCol("text").setOutputCol("feats")
+                 .setNumFeatures(128).setUseIDF(True).fit(toy_df))
+        out = model.transform(toy_df)
+        row = out.col("feats")[0]
+        assert sp.issparse(row) and row.shape == (1, 128)
+        mat = text_ops.rows_to_matrix(out.col("feats"))
+        assert mat.shape == (toy_df.count(), 128)
+        assert mat.nnz > 0
+
+    def test_null_text_yields_empty_vector(self):
+        df = DataFrame({"text": np.array([None, "real words here"], dtype=object)})
+        m = TextFeaturizer().setNumFeatures(32).setUseIDF(False).fit(df)
+        mat = text_ops.rows_to_matrix(m.transform(df).col("features"))
+        assert mat[0].nnz == 0 and mat[1].nnz > 0
+
+    def test_pretokenized_requires_lists(self):
+        df = DataFrame({"text": np.array(["not a list"], dtype=object)})
+        with pytest.raises(TypeError):
+            TextFeaturizer().setUseTokenizer(False).setNumFeatures(8).fit(df)
+        df2 = DataFrame({"text": np.array([["tok1", "tok2"]], dtype=object)})
+        m = TextFeaturizer().setUseTokenizer(False).setNumFeatures(8).setUseIDF(False).fit(df2)
+        assert text_ops.rows_to_matrix(m.transform(df2).col("features")).nnz > 0
+
+    def test_featurizer_roundtrip(self, toy_df, tmp_path):
+        from mmlspark_tpu.core import load_stage
+        model = (TextFeaturizer().setInputCol("text").setNumFeatures(64)
+                 .fit(toy_df))
+        model.save(str(tmp_path / "tf"))
+        m2 = load_stage(str(tmp_path / "tf"))
+        a = text_ops.rows_to_matrix(model.transform(toy_df).col("features"))
+        b = text_ops.rows_to_matrix(m2.transform(toy_df).col("features"))
+        np.testing.assert_allclose(a.toarray(), b.toarray())
