@@ -1,0 +1,67 @@
+"""North-star benchmark: CIFAR-10 ResNet-20 training throughput (imgs/sec/chip).
+
+Runs on the real TPU chip (BASELINE.md: the reference publishes no throughput
+numbers — notebook 401 trains a CIFAR ConvNet via CNTK/MPI on GPU VMs; this
+is the TPU-native replacement path). Synthetic CIFAR-shaped data (the metric
+is compute throughput, not accuracy). Prints ONE JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.models.trainer import make_loss
+
+    batch = 1024
+    module = build_model({"type": "resnet", "num_classes": 10})
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=batch).astype(np.int32))
+    params = module.init(jax.random.PRNGKey(0), x[:1])
+    tx = optax.sgd(0.01, momentum=0.9)
+    opt_state = tx.init(params)
+    loss_fn = make_loss("cross_entropy")
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def compute(p):
+            return loss_fn(module.apply(p, xb), yb)
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt2, loss
+
+    # compile + warmup. NOTE: on the axon TPU tunnel block_until_ready()
+    # returns before the chain actually executes — a host-side value fetch
+    # (float()) is the only hard sync, so that is what brackets the timing.
+    params, opt_state, loss = step(params, opt_state, x, y)
+    float(loss)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    float(loss)
+
+    n_steps = 30
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    float(loss)  # hard sync: forces the whole 30-step chain to complete
+    dt = time.perf_counter() - t0
+
+    n_chips = len(jax.devices())
+    imgs_per_sec = n_steps * batch / dt / n_chips
+    print(json.dumps({
+        "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from . import modules
+from .modules import (BiLSTMTagger, ConvNet, MLPNet, ResNet, build_model,
+                      example_input)
+from .tpu_model import TpuModel
+from .trainer import TpuLearner
+
+__all__ = ["modules", "build_model", "example_input", "MLPNet", "ConvNet",
+           "ResNet", "BiLSTMTagger", "TpuModel", "TpuLearner"]

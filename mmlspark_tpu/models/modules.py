@@ -1,0 +1,239 @@
+"""Flax model zoo + declarative model configs.
+
+Plays the role BrainScript plays for the reference's trainer (cntk-train/...
+/BrainscriptBuilder.scala:16-100): a model is described by a small JSON-able
+config dict, built into a flax module by ``build_model``. The reference's
+model families (SURVEY.md §2.2): CIFAR ConvNet (notebook 401), ResNet for
+image featurization (cntk-model / image-featurizer, notebook 301), MLP
+(TrainClassifier), and a BiLSTM sequence tagger (notebook 304).
+
+Every module supports **layer-name truncation**: ``apply(..., output_layer=
+name)`` returns that intermediate activation — the mechanism behind headless-
+net transfer learning (reference: ImageFeaturizer.scala:117-142 selects
+``outputNodeName = layerNames(cutOutputLayers)``). ``layer_names()`` lists
+valid names in forward order.
+
+TPU notes: compute in bfloat16 (MXU-native) with float32 params; all shapes
+static; no Python control flow on data.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class _LayerTap:
+    """Collects named activations and answers early-exit queries. Because
+    output_layer is a *static* argument, the truncated net compiles to a
+    program that simply stops at the tapped layer — dead layers are never
+    built, matching the reference's AsComposite truncation for free."""
+
+    def __init__(self, output_layer: Optional[str]):
+        self.target = output_layer
+        self.result = None
+
+    def tap(self, name: str, value):
+        if self.target is not None and name == self.target and self.result is None:
+            self.result = value
+        return value
+
+    @property
+    def done(self) -> bool:
+        return self.result is not None
+
+
+class MLPNet(nn.Module):
+    """Multilayer perceptron (TrainClassifier's MLP algorithm analog)."""
+    hidden: Sequence[int] = (128, 64)
+    num_classes: int = 2
+    dtype: Any = jnp.bfloat16
+
+    def layer_names(self):
+        return [f"dense{i}" for i in range(len(self.hidden))] + ["logits"]
+
+    @nn.compact
+    def __call__(self, x, output_layer: Optional[str] = None):
+        tap = _LayerTap(output_layer)
+        x = x.astype(self.dtype).reshape(x.shape[0], -1)
+        for i, h in enumerate(self.hidden):
+            x = tap.tap(f"dense{i}", nn.relu(nn.Dense(h, dtype=self.dtype)(x)))
+            if tap.done:
+                return tap.result.astype(jnp.float32)
+        x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))
+        return x.astype(jnp.float32)
+
+
+class ConvNet(nn.Module):
+    """CIFAR-style ConvNet — the notebook-401 training target (the reference
+    trains it via BrainScript ConvNet config on GPU VMs)."""
+    channels: Sequence[int] = (32, 32, 64, 64)
+    dense: int = 512
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    def layer_names(self):
+        names = [f"conv{i}" for i in range(len(self.channels))]
+        return names + ["dense", "logits"]
+
+    @nn.compact
+    def __call__(self, x, output_layer: Optional[str] = None):
+        tap = _LayerTap(output_layer)
+        x = x.astype(self.dtype)
+        for i, ch in enumerate(self.channels):
+            x = nn.Conv(ch, (3, 3), dtype=self.dtype)(x)
+            x = tap.tap(f"conv{i}", nn.relu(x))
+            if tap.done:
+                return tap.result.astype(jnp.float32)
+            if i % 2 == 1:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = tap.tap("dense", nn.relu(nn.Dense(self.dense, dtype=self.dtype)(x)))
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))
+        return x.astype(jnp.float32)
+
+
+class _BasicBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
+                                 dtype=self.dtype)(y))
+        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
+                         dtype=self.dtype)(y)
+        if x.shape != y.shape:
+            x = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
+                        use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(x + y)
+
+
+class ResNet(nn.Module):
+    """CIFAR ResNet (depth = 6n+2: 20, 32, 56...) — the flagship model.
+
+    Uses per-channel GroupNorm (LayerNorm-style) instead of BatchNorm so the
+    forward pass is batch-independent and shards cleanly over the data axis
+    without cross-device batch statistics.
+    """
+    blocks_per_stage: int = 3          # n=3 -> ResNet-20
+    widths: Sequence[int] = (16, 32, 64)
+    num_classes: int = 10
+    dtype: Any = jnp.bfloat16
+
+    def layer_names(self):
+        names = ["stem"]
+        for s in range(len(self.widths)):
+            names += [f"stage{s}_block{b}" for b in range(self.blocks_per_stage)]
+        return names + ["pool", "logits"]
+
+    @nn.compact
+    def __call__(self, x, output_layer: Optional[str] = None):
+        tap = _LayerTap(output_layer)
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.widths[0], (3, 3), use_bias=False, dtype=self.dtype)(x)
+        x = tap.tap("stem", nn.relu(nn.GroupNorm(
+            num_groups=None, group_size=x.shape[-1], dtype=self.dtype)(x)))
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        for s, width in enumerate(self.widths):
+            for b in range(self.blocks_per_stage):
+                strides = 2 if (s > 0 and b == 0) else 1
+                x = tap.tap(f"stage{s}_block{b}",
+                            _BasicBlock(width, strides, self.dtype)(x))
+                if tap.done:
+                    return tap.result.astype(jnp.float32)
+        x = tap.tap("pool", jnp.mean(x, axis=(1, 2)))
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))
+        return x.astype(jnp.float32)
+
+
+class BiLSTMTagger(nn.Module):
+    """Bidirectional LSTM sequence tagger (notebook-304 analog: medical
+    entity extraction ran a pre-trained Keras BiLSTM through CNTKModel).
+
+    Input: int32 token ids (B, T). Output: per-token logits (B, T, classes).
+    Uses lax.scan-backed flax RNN (static unroll-free, jit-friendly).
+    """
+    vocab_size: int = 10000
+    embed_dim: int = 128
+    hidden: int = 128
+    num_classes: int = 8
+    dtype: Any = jnp.bfloat16
+
+    def layer_names(self):
+        return ["embed", "bilstm", "logits"]
+
+    @nn.compact
+    def __call__(self, tokens, output_layer: Optional[str] = None):
+        tap = _LayerTap(output_layer)
+        x = tap.tap("embed", nn.Embed(self.vocab_size, self.embed_dim,
+                                      dtype=self.dtype)(tokens))
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        fwd = nn.RNN(nn.LSTMCell(self.hidden, dtype=self.dtype))(x)
+        bwd = nn.RNN(nn.LSTMCell(self.hidden, dtype=self.dtype),
+                     reverse=True, keep_order=True)(x)
+        x = tap.tap("bilstm", jnp.concatenate([fwd, bwd], axis=-1))
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))
+        return x.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------- registry
+
+MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
+    "mlp": lambda cfg: MLPNet(
+        hidden=tuple(cfg.get("hidden", (128, 64))),
+        num_classes=cfg.get("num_classes", 2)),
+    "convnet": lambda cfg: ConvNet(
+        channels=tuple(cfg.get("channels", (32, 32, 64, 64))),
+        dense=cfg.get("dense", 512),
+        num_classes=cfg.get("num_classes", 10)),
+    "resnet": lambda cfg: ResNet(
+        blocks_per_stage=cfg.get("blocks_per_stage", 3),
+        widths=tuple(cfg.get("widths", (16, 32, 64))),
+        num_classes=cfg.get("num_classes", 10)),
+    "bilstm": lambda cfg: BiLSTMTagger(
+        vocab_size=cfg.get("vocab_size", 10000),
+        embed_dim=cfg.get("embed_dim", 128),
+        hidden=cfg.get("hidden", 128),
+        num_classes=cfg.get("num_classes", 8)),
+}
+
+
+def build_model(config: dict) -> nn.Module:
+    """config: {"type": <family>, ...family kwargs...} -> flax module."""
+    cfg = dict(config)
+    mtype = cfg.pop("type")
+    if mtype not in MODEL_BUILDERS:
+        raise KeyError(f"unknown model type {mtype!r}; "
+                       f"have {sorted(MODEL_BUILDERS)}")
+    return MODEL_BUILDERS[mtype](cfg)
+
+
+def example_input(config: dict, batch: int = 2):
+    """A tiny correctly-shaped input for init/compile checks."""
+    mtype = config["type"]
+    if mtype == "mlp":
+        return jnp.zeros((batch, config.get("input_dim", 16)), jnp.float32)
+    if mtype in ("convnet", "resnet"):
+        h = config.get("height", 32)
+        w = config.get("width", 32)
+        c = config.get("channels_in", 3)
+        return jnp.zeros((batch, h, w, c), jnp.float32)
+    if mtype == "bilstm":
+        return jnp.zeros((batch, config.get("seq_len", 16)), jnp.int32)
+    raise KeyError(mtype)

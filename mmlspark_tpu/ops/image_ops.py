@@ -31,7 +31,13 @@ def resize(batch: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
 
 def crop(batch: jnp.ndarray, x: int, y: int, height: int, width: int) -> jnp.ndarray:
     """Crop with OpenCV Rect(x, y, w, h) semantics — x is the column offset,
-    y the row offset (reference CropImage builds Rect(x, y, width, height))."""
+    y the row offset (reference CropImage builds Rect(x, y, width, height)).
+    Like OpenCV's Mat(image, rect), an out-of-bounds rect is an error rather
+    than a silent truncation."""
+    _, h, w, _ = batch.shape
+    if x < 0 or y < 0 or y + height > h or x + width > w:
+        raise ValueError(f"crop rect (x={x}, y={y}, h={height}, w={width}) "
+                         f"exceeds image bounds {h}x{w}")
     return batch[:, y:y + height, x:x + width, :]
 
 
@@ -79,8 +85,11 @@ def _depthwise_conv(batch: jnp.ndarray, kernel2d: jnp.ndarray) -> jnp.ndarray:
 
 
 def blur(batch: jnp.ndarray, height: int, width: int) -> jnp.ndarray:
-    """Normalized box filter (reference Blur via Imgproc.blur)."""
-    k = jnp.full((int(height), int(width)), 1.0 / (int(height) * int(width)),
+    """Normalized box filter. The reference passes ``new Size(height, width)``
+    to Imgproc.blur, and OpenCV Size is (width, height) — so the reference's
+    ``height`` param is the kernel's horizontal extent. Mirrored here:
+    kernel rows = width param, kernel cols = height param."""
+    k = jnp.full((int(width), int(height)), 1.0 / (int(height) * int(width)),
                  dtype=batch.dtype)
     return _depthwise_conv(batch, k)
 

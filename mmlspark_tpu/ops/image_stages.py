@@ -79,8 +79,7 @@ class ImageTransformer(Transformer):
             res = image_ops.apply_op_chain(batch, ops) if ops else batch.astype(np.float32)
             res = np.clip(np.rint(res), 0, 255).astype(np.uint8)
             for j, i in enumerate(idxs):
-                h, w = res[j].shape[:2]
-                c = res[j].shape[2] if res[j].ndim == 3 else 1
+                h, w, c = res[j].shape
                 out[i] = make_image_row(paths[j], h, w, c, res[j])
         return tag_image_column(df.withColumn(self.getOutputCol(), out),
                                 self.getOutputCol())

@@ -117,4 +117,5 @@ def rows_to_matrix(col: np.ndarray):
     (CSR if sparse, dense float32 otherwise)."""
     if len(col) and sp.issparse(col[0]):
         return sp.vstack(list(col), format="csr")
-    return np.stack([np.asarray(v, dtype=np.float32) for v in col])
+    from ..core.utils import to_float32_matrix
+    return to_float32_matrix(np.asarray(col))

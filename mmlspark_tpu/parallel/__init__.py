@@ -1,0 +1,6 @@
+from . import mesh
+from .mesh import (batch_sharding, create_mesh, pad_batch_to_devices,
+                   replicated, shard_batch, shard_params_tp)
+
+__all__ = ["mesh", "create_mesh", "batch_sharding", "replicated",
+           "shard_batch", "pad_batch_to_devices", "shard_params_tp"]

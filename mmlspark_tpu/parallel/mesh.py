@@ -1,0 +1,108 @@
+"""Device mesh + sharding helpers: the framework's distributed substrate.
+
+Replaces the reference's three communication mechanisms (SURVEY.md §2.7) with
+one: XLA collectives over an explicit ``jax.sharding.Mesh``.
+  * MPI ring over ssh (cntk-train/.../CommandBuilders.scala:149-267)  → data-
+    parallel gradient all-reduce inserted by XLA when params are replicated
+    and batches are sharded over the ``data`` axis;
+  * LightGBM socket collective (TrainUtils.scala:141-142)             → psum
+    of histograms over the mesh (models/gbdt);
+  * ssh/scp data movement                                             → one
+    ``jax.device_put`` of columnar batches with a NamedSharding.
+
+Axis conventions (used across the framework):
+  ``data``  — batch dimension (DP);
+  ``model`` — tensor-parallel dimension (TP, e.g. wide dense kernels);
+additional axes (pipeline/sequence/expert) compose the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def create_mesh(data: Optional[int] = None, model: int = 1,
+                devices: Optional[Sequence] = None,
+                axis_names: tuple[str, ...] = ("data", "model")) -> Mesh:
+    """Build a 2-D (data, model) mesh over the available devices.
+
+    With a single chip this degrades to a 1x1 mesh and every sharding becomes
+    a no-op — the same program runs unchanged from 1 chip to a pod, which is
+    the core TPU-first contract (vs. the reference's separate single-node and
+    MPI code paths, CommandBuilders.scala:90-100 vs :149-267).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        data = n // model
+    if data * model > n:
+        raise ValueError(f"mesh {data}x{model} needs {data*model} devices, have {n}")
+    dev_array = np.asarray(devices[:data * model]).reshape(data, model)
+    return Mesh(dev_array, axis_names)
+
+
+def batch_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:
+    """Shard dim 0 (batch) over the data axis, replicate the rest."""
+    return NamedSharding(mesh, P(batch_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(arrays, mesh: Mesh, batch_axis: str = "data"):
+    """device_put a pytree of host arrays with dim-0 sharded over `data` —
+    the one host->HBM hop that replaces the reference's per-element JNI
+    copies (CNTKModel.scala:67-74) and scp legs (CommandBuilders.scala:200-228)."""
+    sh = batch_sharding(mesh, batch_axis)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)
+
+
+def pad_batch_to_devices(arr: np.ndarray, mesh: Mesh,
+                         batch_axis: str = "data") -> tuple[np.ndarray, int]:
+    """Pad dim 0 to a multiple of the data-axis size (XLA needs equal shards).
+    Returns (padded, original_n)."""
+    n_shards = mesh.shape[batch_axis]
+    n = arr.shape[0]
+    rem = (-n) % n_shards
+    if rem == 0:
+        return arr, n
+    pad = np.repeat(arr[-1:], rem, axis=0)
+    return np.concatenate([arr, pad], axis=0), n
+
+
+def shard_params_tp(params, mesh: Mesh, rules: Sequence[tuple[str, P]] = (),
+                    default: Optional[P] = None):
+    """Apply tensor-parallel shardings to a param pytree by path substring.
+
+    rules: [(path_substring, PartitionSpec)] — first match wins; unmatched
+    leaves are replicated. This is the declarative knob the trainer uses to
+    put wide dense kernels on the ``model`` axis.
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    leaves, treedef = flat
+    out = []
+    def _divisible(leaf, spec: P) -> bool:
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            if leaf.shape[dim] % size != 0:
+                return False
+        return True
+
+    for path, leaf in leaves:
+        pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        spec = default if default is not None else P()
+        for sub, candidate in rules:
+            if (sub in pstr and len(candidate) <= np.ndim(leaf)
+                    and _divisible(leaf, candidate)):
+                spec = candidate
+                break
+        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)

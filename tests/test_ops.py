@@ -39,6 +39,20 @@ class TestImageOps:
         assert out.shape == (1, 3, 10, 1)
         np.testing.assert_allclose(out, 5.0, rtol=1e-5)
 
+    def test_crop_out_of_bounds_raises(self):
+        x = np.zeros((1, 8, 6, 3), dtype=np.float32)
+        with pytest.raises(ValueError):
+            image_ops.crop(x, 0, 0, 100, 100)
+
+    def test_blur_orientation_matches_reference_size_swap(self):
+        # reference: Imgproc.blur(img, Size(height, width)); OpenCV Size is
+        # (width, height) -> blur(1, 5) must smooth VERTICALLY
+        x = np.zeros((1, 5, 5, 1), dtype=np.float32)
+        x[0, 2, 2, 0] = 10.0
+        out = np.asarray(image_ops.blur(x, 1, 5))
+        assert out[0, 0, 2, 0] > 0  # spread along rows
+        assert out[0, 2, 0, 0] == 0  # not along cols
+
     def test_flip_codes(self):
         x = np.arange(1 * 2 * 3 * 1, dtype=np.float32).reshape(1, 2, 3, 1)
         np.testing.assert_array_equal(np.asarray(image_ops.flip(x, 0)), x[:, ::-1])
