@@ -1,4 +1,6 @@
-from . import modules
+from . import gbdt, modules
+from .gbdt import (LightGBMClassifier, LightGBMClassificationModel,
+                   LightGBMRegressionModel, LightGBMRegressor)
 from .modules import (BiLSTMTagger, ConvNet, MLPNet, ResNet, build_model,
                       example_input)
 from .tpu_model import TpuModel

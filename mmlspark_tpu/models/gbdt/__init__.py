@@ -1,0 +1,8 @@
+from . import engine
+from .engine import GBDTParams, TreeEnsemble, fit_gbdt, predict, predict_raw
+from .stages import (LightGBMClassificationModel, LightGBMClassifier,
+                     LightGBMRegressionModel, LightGBMRegressor)
+
+__all__ = ["engine", "GBDTParams", "TreeEnsemble", "fit_gbdt", "predict",
+           "predict_raw", "LightGBMClassifier", "LightGBMClassificationModel",
+           "LightGBMRegressor", "LightGBMRegressionModel"]

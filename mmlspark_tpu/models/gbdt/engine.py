@@ -1,0 +1,399 @@
+"""Gradient-boosted decision trees as pure XLA programs.
+
+The LightGBM replacement (reference: src/lightgbm — LGBM_BoosterUpdateOneIter
+loop at TrainUtils.scala:63-77, socket all-reduce ring at :141-142). The
+reference ships rows into native C buffers and lets LightGBM's C++ build
+255-bin histograms with a socket collective between workers. Here the whole
+algorithm is data-parallel XLA:
+
+  * features are quantile-binned once to uint8 bins (maxBin=255);
+  * trees grow LEVEL-WISE to a fixed depth — every level is one batched
+    histogram build (`segment_sum` over node*feature*bin ids, an MXU/VPU-
+    friendly scatter-add) + a vectorized split-gain argmax. Static shapes,
+    no per-node recursion: XLA sees a fixed program per level;
+  * with the bin matrix sharded over the mesh's ``data`` axis the histogram
+    sum becomes a cross-device all-reduce inserted by XLA — the moral
+    equivalent of LightGBM's `tree_learner=data` ring, but over ICI;
+  * multiclass trains K trees per iteration via vmap over class gradients.
+
+Trees are stored heap-ordered in dense arrays (node i -> children 2i+1/2i+2),
+so prediction is `depth` gathers — no pointer chasing, fully vectorized.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GBDTParams(NamedTuple):
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    max_depth: int = 5              # numLeaves ~ 2^max_depth (level-wise)
+    max_bin: int = 255
+    lambda_l2: float = 1.0
+    lambda_l1: float = 0.0
+    min_child_weight: float = 1e-3
+    min_split_gain: float = 0.0
+    bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    feature_fraction: float = 1.0
+    objective: str = "binary"       # binary|regression|quantile|mae|multiclass
+    alpha: float = 0.9              # quantile level
+    num_class: int = 1
+    seed: int = 0
+    early_stopping_round: int = 0
+
+
+class TreeEnsemble(NamedTuple):
+    """All trees of a fitted booster, dense heap layout.
+
+    feature:  (T, K, 2^depth-1) int32 — split feature per internal node
+    threshold:(T, K, 2^depth-1) int32 — split bin (go right if bin > thr)
+    leaf:     (T, K, 2^depth)   f32   — leaf values (learning rate applied)
+    bin_edges:(d, max_bin-1)    f32   — quantile edges for binning new data
+    base:     (K,)              f32   — initial raw score
+    objective: str
+    """
+    feature: jnp.ndarray
+    threshold: jnp.ndarray
+    leaf: jnp.ndarray
+    bin_edges: np.ndarray
+    base: np.ndarray
+    objective: str
+
+
+# ------------------------------------------------------------------ binning
+
+def compute_bin_edges(x: np.ndarray, max_bin: int) -> np.ndarray:
+    """Per-feature quantile edges, shape (d, max_bin-1). NaNs ignored."""
+    qs = np.linspace(0, 1, max_bin + 1)[1:-1]
+    edges = np.nanquantile(x.astype(np.float64), qs, axis=0).T  # (d, B-1)
+    # strictly increasing edges are unnecessary; searchsorted handles ties
+    return np.ascontiguousarray(edges.astype(np.float32))
+
+
+def bin_data(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """(n, d) floats -> (n, d) int32 bin ids in [0, max_bin). NaN -> bin 0."""
+    n, d = x.shape
+    out = np.empty((n, d), dtype=np.int32)
+    xf = x.astype(np.float32)
+    for j in range(d):
+        out[:, j] = np.searchsorted(edges[j], xf[:, j], side="left")
+    out[np.isnan(xf)] = 0
+    return out
+
+
+# ------------------------------------------------------------- tree builder
+
+def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
+                     n_bins: int, lambda_l2, lambda_l1, min_child_weight,
+                     min_split_gain):
+    """One level-wise tree for one output class.
+
+    bins (n, d) int32; grad/hess (n,) f32; row_mask (n,) f32 bagging mask;
+    feat_mask (d,) f32 feature-fraction mask.
+    Returns (feature (2^depth-1,), threshold (2^depth-1,), leaf (2^depth,)).
+    """
+    n, d = bins.shape
+    g = grad * row_mask
+    h = hess * row_mask
+
+    node = jnp.zeros(n, dtype=jnp.int32)
+    feat_arr = jnp.zeros(2 ** depth - 1, dtype=jnp.int32)
+    thr_arr = jnp.full(2 ** depth - 1, n_bins, dtype=jnp.int32)  # default: all left
+
+    feat_ids = jnp.arange(d, dtype=jnp.int32)
+
+    for level in range(depth):
+        n_nodes = 2 ** level
+        # --- histogram: scatter-add grads into (node, feature, bin) ---
+        seg = (node[:, None] * (d * n_bins)
+               + feat_ids[None, :] * n_bins + bins).reshape(-1)
+        num_seg = n_nodes * d * n_bins
+        hg = jax.ops.segment_sum(jnp.broadcast_to(g[:, None], (n, d)).reshape(-1),
+                                 seg, num_segments=num_seg).reshape(n_nodes, d, n_bins)
+        hh = jax.ops.segment_sum(jnp.broadcast_to(h[:, None], (n, d)).reshape(-1),
+                                 seg, num_segments=num_seg).reshape(n_nodes, d, n_bins)
+        # --- split gain over all (node, feature, bin) at once ---
+        gl = jnp.cumsum(hg, axis=2)
+        hl = jnp.cumsum(hh, axis=2)
+        gt = gl[:, :, -1:]
+        ht = hl[:, :, -1:]
+        gr = gt - gl
+        hr = ht - hl
+
+        def score(gsum, hsum):
+            # L1/L2-regularized leaf objective: (|g|-l1)^2 soft-thresholded
+            gs = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - lambda_l1, 0.0)
+            return gs * gs / (hsum + lambda_l2)
+
+        gain = score(gl, hl) + score(gr, hr) - score(gt, ht)
+        valid = ((hl >= min_child_weight) & (hr >= min_child_weight)
+                 & (feat_mask[None, :, None] > 0))
+        gain = jnp.where(valid, gain, -jnp.inf)
+        flat = gain.reshape(n_nodes, d * n_bins)
+        best = jnp.argmax(flat, axis=1)
+        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        bf = (best // n_bins).astype(jnp.int32)
+        bb = (best % n_bins).astype(jnp.int32)
+        # nodes with no usable split: route everything left (thr = n_bins)
+        use = best_gain > min_split_gain
+        bf = jnp.where(use, bf, 0)
+        bb = jnp.where(use, bb, n_bins)
+
+        off = 2 ** level - 1
+        feat_arr = jax.lax.dynamic_update_slice(feat_arr, bf, (off,))
+        thr_arr = jax.lax.dynamic_update_slice(thr_arr, bb, (off,))
+
+        # --- route rows ---
+        nf = bf[node]
+        nt = bb[node]
+        go_right = bins[jnp.arange(n), nf] > nt
+        node = node * 2 + go_right.astype(jnp.int32)
+
+    # --- leaves ---
+    lg = jax.ops.segment_sum(g, node, num_segments=2 ** depth)
+    lh = jax.ops.segment_sum(h, node, num_segments=2 ** depth)
+    lgs = jnp.sign(lg) * jnp.maximum(jnp.abs(lg) - lambda_l1, 0.0)
+    leaf = -lgs / (lh + lambda_l2)
+    return feat_arr, thr_arr, leaf
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _build_tree_multi(bins, grad, hess, row_mask, feat_mask, *, depth: int,
+                      n_bins: int, lambda_l2, lambda_l1, min_child_weight,
+                      min_split_gain):
+    """vmap the tree builder over the class axis of grad/hess (K trees per
+    boosting iteration for multiclass; K=1 otherwise)."""
+    return jax.vmap(
+        lambda g, h: _build_tree_impl(bins, g, h, row_mask, feat_mask,
+                                      depth, n_bins, lambda_l2, lambda_l1,
+                                      min_child_weight, min_split_gain),
+        in_axes=1, out_axes=0)(grad, hess)
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _predict_tree(bins, feature, threshold, leaf, depth: int):
+    """bins (n,d); tree arrays for one class -> (n,) leaf values."""
+    n = bins.shape[0]
+    pos = jnp.zeros(n, dtype=jnp.int32)
+    for level in range(depth):
+        heap = 2 ** level - 1 + pos
+        f = feature[heap]
+        t = threshold[heap]
+        go_right = bins[jnp.arange(n), f] > t
+        pos = pos * 2 + go_right.astype(jnp.int32)
+    return leaf[pos]
+
+
+# ------------------------------------------------------------- objectives
+
+def _init_score(y: np.ndarray, p: GBDTParams) -> np.ndarray:
+    if p.objective == "binary":
+        pos = np.clip(y.mean(), 1e-6, 1 - 1e-6)
+        return np.array([np.log(pos / (1 - pos))], dtype=np.float32)
+    if p.objective == "multiclass":
+        return np.zeros(p.num_class, dtype=np.float32)
+    if p.objective == "quantile":
+        return np.array([np.quantile(y, p.alpha)], dtype=np.float32)
+    if p.objective == "mae":
+        return np.array([np.median(y)], dtype=np.float32)
+    return np.array([y.mean()], dtype=np.float32)  # regression l2
+
+
+@functools.partial(jax.jit, static_argnames=("objective", "num_class"))
+def _grad_hess(raw, y, objective: str, num_class: int, alpha):
+    """raw (n, K), y (n,) -> grad/hess (n, K)."""
+    if objective == "binary":
+        prob = jax.nn.sigmoid(raw[:, 0])
+        g = (prob - y)[:, None]
+        h = (prob * (1 - prob))[:, None]
+    elif objective == "multiclass":
+        prob = jax.nn.softmax(raw, axis=1)
+        onehot = jax.nn.one_hot(y.astype(jnp.int32), num_class)
+        g = prob - onehot
+        h = prob * (1 - prob)
+    elif objective == "quantile":
+        err = y - raw[:, 0]
+        g = jnp.where(err >= 0, -alpha, 1.0 - alpha)[:, None]
+        h = jnp.ones_like(g)
+    elif objective == "mae":
+        g = jnp.sign(raw[:, 0] - y)[:, None]
+        h = jnp.ones_like(g)
+    else:  # regression (l2)
+        g = (raw[:, 0] - y)[:, None]
+        h = jnp.ones_like(g)
+    return g.astype(jnp.float32), h.astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("objective",))
+def _loss(raw, y, objective: str, alpha):
+    if objective == "binary":
+        z = raw[:, 0]
+        return jnp.mean(jnp.logaddexp(0.0, z) - y * z)
+    if objective == "multiclass":
+        logp = jax.nn.log_softmax(raw, axis=1)
+        return -jnp.mean(jnp.take_along_axis(
+            logp, y.astype(jnp.int32)[:, None], axis=1))
+    if objective == "quantile":
+        err = y - raw[:, 0]
+        return jnp.mean(jnp.maximum(alpha * err, (alpha - 1) * err))
+    if objective == "mae":
+        return jnp.mean(jnp.abs(raw[:, 0] - y))
+    return 0.5 * jnp.mean((raw[:, 0] - y) ** 2)
+
+
+# ------------------------------------------------------------------ fitting
+
+def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
+             mesh=None, sample_weight: Optional[np.ndarray] = None,
+             eval_set: Optional[tuple] = None) -> TreeEnsemble:
+    """Train a boosted ensemble. If `mesh` is given, the bin matrix and
+    per-row state are sharded over its ``data`` axis, turning every
+    histogram segment_sum into an ICI all-reduce (LightGBM's
+    `tree_learner=data` over XLA collectives)."""
+    p = params
+    n, d = x.shape
+    K = p.num_class if p.objective == "multiclass" else 1
+    edges = compute_bin_edges(x, p.max_bin)
+    bins = bin_data(x, edges)
+    yj = jnp.asarray(y.astype(np.float32))
+    base = _init_score(y, p)
+    raw = jnp.broadcast_to(jnp.asarray(base)[None, :], (n, K)).astype(jnp.float32)
+    bins_j = jnp.asarray(bins)
+
+    if mesh is not None:
+        from ...parallel import mesh as meshlib
+        bins_j = meshlib.shard_batch(bins_j, mesh)
+        raw = meshlib.shard_batch(raw, mesh)
+        yj = meshlib.shard_batch(yj, mesh)
+
+    rng = np.random.default_rng(p.seed)
+    feats, thrs, leaves = [], [], []
+    best_loss, since_best, best_iter = np.inf, 0, None
+    # early stopping monitors a held-out set (LightGBM's valid_sets contract;
+    # train loss is monotone in boosting so it can never trigger a stop)
+    if p.early_stopping_round > 0 and eval_set is None:
+        # draw the holdout only from real rows (weight > 0): mesh padding and
+        # user-masked rows must not enter the validation metric
+        candidates = (np.arange(n) if sample_weight is None
+                      else np.flatnonzero(sample_weight > 0))
+        idx = rng.permutation(candidates)
+        n_val = max(1, len(candidates) // 5)
+        eval_set = (x[idx[:n_val]], y[idx[:n_val]])
+        # held-out rows must not train: zero them in the weight mask
+        holdout = np.ones(n, dtype=np.float32)
+        holdout[idx[:n_val]] = 0.0
+        sample_weight = (holdout if sample_weight is None
+                         else sample_weight * holdout)
+    if eval_set is not None:
+        bins_val = jnp.asarray(bin_data(
+            np.asarray(eval_set[0], dtype=np.float32), edges))
+        y_val = jnp.asarray(np.asarray(eval_set[1], dtype=np.float32))
+        raw_val = jnp.broadcast_to(jnp.asarray(base)[None, :],
+                                   (bins_val.shape[0], K)).astype(jnp.float32)
+
+    for it in range(p.num_iterations):
+        g, h = _grad_hess(raw, yj, p.objective, K, p.alpha)
+        if p.bagging_fraction < 1.0 and p.bagging_freq > 0:
+            if it % p.bagging_freq == 0:
+                bag_mask = (rng.random(n) < p.bagging_fraction).astype(np.float32)
+            # else reuse previous bag_mask
+        else:
+            bag_mask = np.ones(n, dtype=np.float32)
+        # combine fresh each iteration — a reused bag mask must not compound
+        # sample_weight geometrically
+        row_mask = (bag_mask if sample_weight is None
+                    else bag_mask * sample_weight.astype(np.float32))
+        if p.feature_fraction < 1.0:
+            fm = (rng.random(d) < p.feature_fraction)
+            if not fm.any():
+                fm[rng.integers(0, d)] = True
+            feat_mask = fm.astype(np.float32)
+        else:
+            feat_mask = np.ones(d, dtype=np.float32)
+        rm = jnp.asarray(row_mask)
+        if mesh is not None:
+            from ...parallel import mesh as meshlib
+            rm = meshlib.shard_batch(rm, mesh)
+
+        f, t, lv = _build_tree_multi(
+            bins_j, g, h, rm, jnp.asarray(feat_mask),
+            depth=p.max_depth, n_bins=p.max_bin,
+            lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+            min_child_weight=p.min_child_weight,
+            min_split_gain=p.min_split_gain)
+        lv = lv * p.learning_rate
+        contrib = jnp.stack(
+            [_predict_tree(bins_j, f[k], t[k], lv[k], depth=p.max_depth)
+             for k in range(K)], axis=1)
+        raw = raw + contrib
+        feats.append(f)
+        thrs.append(t)
+        leaves.append(lv)
+
+        if p.early_stopping_round > 0:
+            raw_val = raw_val + jnp.stack(
+                [_predict_tree(bins_val, f[k], t[k], lv[k], depth=p.max_depth)
+                 for k in range(K)], axis=1)
+            cur = float(_loss(raw_val, y_val, p.objective, p.alpha))
+            if cur < best_loss - 1e-9:
+                best_loss, since_best, best_iter = cur, 0, it + 1
+            else:
+                since_best += 1
+                if since_best >= p.early_stopping_round:
+                    break
+
+    if best_iter is not None:
+        feats, thrs, leaves = (feats[:best_iter], thrs[:best_iter],
+                               leaves[:best_iter])
+    return TreeEnsemble(
+        feature=jnp.stack(feats), threshold=jnp.stack(thrs),
+        leaf=jnp.stack(leaves), bin_edges=edges, base=base,
+        objective=p.objective)
+
+
+def predict_raw(ens: TreeEnsemble, x: np.ndarray,
+                num_iteration: Optional[int] = None) -> np.ndarray:
+    """Raw ensemble scores (n, K)."""
+    bins = jnp.asarray(bin_data(x, ens.bin_edges))
+    T, K, _ = ens.feature.shape
+    depth = int(np.log2(ens.leaf.shape[2]))
+    T = min(T, num_iteration) if num_iteration else T
+
+    @jax.jit
+    def run(bins, feature, threshold, leaf):
+        def body(raw, tree):
+            f, t, lv = tree
+            contrib = jnp.stack(
+                [_predict_tree(bins, f[k], t[k], lv[k], depth=depth)
+                 for k in range(K)], axis=1)
+            return raw + contrib, None
+        init = jnp.broadcast_to(jnp.asarray(ens.base)[None, :],
+                                (bins.shape[0], K)).astype(jnp.float32)
+        raw, _ = jax.lax.scan(body, init, (feature, threshold, leaf))
+        return raw
+
+    return np.asarray(run(bins, ens.feature[:T], ens.threshold[:T],
+                          ens.leaf[:T]))
+
+
+def prob_from_raw(objective: str, raw: np.ndarray) -> np.ndarray:
+    """Raw margins -> probabilities (classification) or values (regression)."""
+    if objective == "binary":
+        p1 = 1.0 / (1.0 + np.exp(-raw[:, 0]))
+        return np.stack([1 - p1, p1], axis=1)
+    if objective == "multiclass":
+        e = np.exp(raw - raw.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+    return raw[:, 0]
+
+
+def predict(ens: TreeEnsemble, x: np.ndarray) -> np.ndarray:
+    """Probabilities for classification, values for regression."""
+    return prob_from_raw(ens.objective, predict_raw(ens, x))

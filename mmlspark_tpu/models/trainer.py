@@ -55,18 +55,22 @@ def make_optimizer(name: str, lr: float, momentum: float = 0.9,
     return tx
 
 
-def make_loss(name: str):
+def make_loss(name: str, per_example: bool = False):
+    """Loss on (preds, labels); per_example=True returns the (n,) vector so
+    callers can weight out padding rows."""
     if name == "cross_entropy":
-        def loss_fn(logits, labels):
+        def vec(logits, labels):
             return optax.softmax_cross_entropy_with_integer_labels(
-                logits, labels.astype(jnp.int32)).mean()
+                logits, labels.astype(jnp.int32))
     elif name == "mse":
-        def loss_fn(preds, labels):
+        def vec(preds, labels):
             preds = preds.squeeze(-1) if preds.ndim > labels.ndim else preds
-            return jnp.mean((preds - labels.astype(preds.dtype)) ** 2)
+            return (preds - labels.astype(preds.dtype)) ** 2
     else:
         raise ValueError(f"unknown loss {name!r}")
-    return loss_fn
+    if per_example:
+        return vec
+    return lambda p, l: vec(p, l).mean()
 
 
 class TpuLearner(Estimator):
@@ -139,7 +143,7 @@ class TpuLearner(Estimator):
         tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
                             self.getMomentum(), self.getWeightDecay())
         opt_state = tx.init(params)
-        loss_fn = make_loss(self.getLoss())
+        loss_fn = make_loss(self.getLoss(), per_example=True)
 
         # placement: params/opt replicated (TP rules shard wide dense kernels
         # over `model`); batch sharded over `data`. XLA derives the gradient
@@ -153,9 +157,11 @@ class TpuLearner(Estimator):
         opt_state = jax.device_put(opt_state, meshlib.replicated(mesh))
 
         @jax.jit
-        def train_step(params, opt_state, xb, yb):
+        def train_step(params, opt_state, xb, yb, wb):
+            # weighted mean so mesh-padding rows (weight 0) carry no gradient
             def compute(p):
-                return loss_fn(module.apply(p, xb), yb)
+                losses = loss_fn(module.apply(p, xb), yb)
+                return jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
             loss, grads = jax.value_and_grad(compute)(params)
             updates, opt2 = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt2, loss
@@ -177,11 +183,15 @@ class TpuLearner(Estimator):
                      else np.arange(n))
             for s in range(steps):
                 idx = order[s * bs:(s + 1) * bs]
-                xb, _ = meshlib.pad_batch_to_devices(x[idx], mesh)
+                xb, nb = meshlib.pad_batch_to_devices(x[idx], mesh)
                 yb, _ = meshlib.pad_batch_to_devices(y[idx], mesh)
+                wb = np.zeros(len(xb), dtype=np.float32)
+                wb[:nb] = 1.0
                 xb = meshlib.shard_batch(xb, mesh)
                 yb = meshlib.shard_batch(yb, mesh)
-                params, opt_state, loss = train_step(params, opt_state, xb, yb)
+                wb = meshlib.shard_batch(wb, mesh)
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     xb, yb, wb)
             last_loss = float(loss)
             log.info("epoch %d loss %.4f", epoch, last_loss)
             if self.getCheckpointDir():

@@ -35,7 +35,8 @@ def crop(batch: jnp.ndarray, x: int, y: int, height: int, width: int) -> jnp.nda
     Like OpenCV's Mat(image, rect), an out-of-bounds rect is an error rather
     than a silent truncation."""
     _, h, w, _ = batch.shape
-    if x < 0 or y < 0 or y + height > h or x + width > w:
+    if height <= 0 or width <= 0 or x < 0 or y < 0 \
+            or y + height > h or x + width > w:
         raise ValueError(f"crop rect (x={x}, y={y}, h={height}, w={width}) "
                          f"exceeds image bounds {h}x{w}")
     return batch[:, y:y + height, x:x + width, :]

@@ -39,6 +39,9 @@ def create_mesh(data: Optional[int] = None, model: int = 1,
     n = len(devices)
     if data is None:
         data = n // model
+    if data < 1 or model < 1:
+        raise ValueError(f"mesh {data}x{model} is empty: {n} devices cannot "
+                         f"host a model axis of {model}")
     if data * model > n:
         raise ValueError(f"mesh {data}x{model} needs {data*model} devices, have {n}")
     dev_array = np.asarray(devices[:data * model]).reshape(data, model)

@@ -1,0 +1,46 @@
+"""Test infrastructure: golden accuracy benchmarks.
+
+The reference gates accuracy regressions by diffing `dataset,learner,metric`
+lines against committed CSVs (core/test/benchmarks/.../Benchmarks.scala:12-77,
+e.g. lightgbm classificationBenchmarkMetrics.csv). Same mechanism here:
+`assert_golden` compares a measured metric against the committed value within
+a tolerance; set GOLDEN_UPDATE=1 to (re)write the CSV.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+
+def _read_goldens(path: str) -> dict[tuple[str, str, str], float]:
+    out = {}
+    if os.path.exists(path):
+        with open(path) as f:
+            for row in csv.reader(f):
+                if len(row) == 4:
+                    out[(row[0], row[1], row[2])] = float(row[3])
+    return out
+
+
+def assert_golden(path: str, dataset: str, learner: str, metric: str,
+                  value: float, tolerance: float = 0.02):
+    """Compare `value` against the committed golden line, reference-style."""
+    goldens = _read_goldens(path)
+    key = (dataset, learner, metric)
+    if os.environ.get("GOLDEN_UPDATE"):
+        goldens[key] = round(float(value), 4)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            for (d, l, m), v in sorted(goldens.items()):
+                w.writerow([d, l, m, v])
+        return
+    if key not in goldens:
+        raise AssertionError(
+            f"no golden for {key} in {path}; run with GOLDEN_UPDATE=1")
+    expected = goldens[key]
+    if abs(value - expected) > tolerance:
+        raise AssertionError(
+            f"{key}: measured {value:.4f} vs golden {expected:.4f} "
+            f"(tolerance {tolerance})")

@@ -1,0 +1,180 @@
+"""GBDT engine + LightGBM-surface stage tests.
+
+Mirrors the reference's lightgbm suite strategy (SURVEY.md §4): real datasets
+with committed AUC/RMSE goldens (classificationBenchmarkMetrics.csv analog in
+tests/goldens/), plus the 'partitions-as-workers' distributed path — here the
+8-device CPU mesh shards the histogram build."""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_diabetes, make_classification
+from sklearn.metrics import roc_auc_score
+from sklearn.model_selection import train_test_split
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.models.gbdt import (GBDTParams, LightGBMClassifier,
+                                      LightGBMRegressor, engine)
+from mmlspark_tpu.testing import assert_golden
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens",
+                       "gbdt_benchmark_metrics.csv")
+
+
+def _df_from_matrix(x, y):
+    feats = np.empty(len(x), dtype=object)
+    for i in range(len(x)):
+        feats[i] = x[i].astype(np.float32)
+    return DataFrame({"features": feats, "label": y})
+
+
+@pytest.fixture(scope="module")
+def breast_cancer():
+    x, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(x.astype(np.float32), y, test_size=0.3,
+                            random_state=0)
+
+
+class TestEngine:
+    def test_binary_separable(self):
+        x, y = make_classification(n_samples=800, n_features=10,
+                                   n_informative=6, random_state=0)
+        p = GBDTParams(num_iterations=30, max_depth=4, max_bin=63)
+        ens = engine.fit_gbdt(x.astype(np.float32), y.astype(np.float32), p)
+        auc = roc_auc_score(y, engine.predict(ens, x.astype(np.float32))[:, 1])
+        assert auc > 0.97
+
+    def test_quantile_coverage(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2000, 5)).astype(np.float32)
+        y = (x[:, 0] * 2 + rng.normal(size=2000)).astype(np.float32)
+        for alpha in (0.1, 0.9):
+            p = GBDTParams(num_iterations=60, objective="quantile",
+                           alpha=alpha, max_depth=3, max_bin=63)
+            ens = engine.fit_gbdt(x, y, p)
+            cov = float((y <= engine.predict(ens, x)).mean())
+            assert abs(cov - alpha) < 0.08, (alpha, cov)
+
+    def test_multiclass(self):
+        x, y = make_classification(n_samples=900, n_features=12,
+                                   n_informative=8, n_classes=3,
+                                   random_state=0)
+        p = GBDTParams(num_iterations=30, objective="multiclass", num_class=3,
+                       max_depth=4, max_bin=63)
+        ens = engine.fit_gbdt(x.astype(np.float32), y.astype(np.float32), p)
+        acc = (engine.predict(ens, x.astype(np.float32)).argmax(1) == y).mean()
+        assert acc > 0.85
+
+    def test_early_stopping_reduces_trees(self):
+        x, y = make_classification(n_samples=300, n_features=6, random_state=1)
+        p = GBDTParams(num_iterations=200, early_stopping_round=5,
+                       max_depth=3, max_bin=31)
+        ens = engine.fit_gbdt(x.astype(np.float32), y.astype(np.float32), p)
+        assert ens.feature.shape[0] < 200
+
+    def test_bagging_and_feature_fraction(self):
+        x, y = make_classification(n_samples=400, n_features=10, random_state=2)
+        p = GBDTParams(num_iterations=20, bagging_fraction=0.7, bagging_freq=1,
+                       feature_fraction=0.6, max_depth=3, max_bin=31)
+        ens = engine.fit_gbdt(x.astype(np.float32), y.astype(np.float32), p)
+        auc = roc_auc_score(y, engine.predict(ens, x.astype(np.float32))[:, 1])
+        assert auc > 0.9
+
+    def test_sample_weight_excludes_rows(self):
+        # rows with weight 0 must not influence the fit: poison half the data
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(400, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        x2 = np.concatenate([x, x])
+        y2 = np.concatenate([y, 1 - y])  # contradictory labels, weight 0
+        w = np.concatenate([np.ones(400), np.zeros(400)]).astype(np.float32)
+        p = GBDTParams(num_iterations=20, max_depth=3, max_bin=31)
+        ens = engine.fit_gbdt(x2, y2, p, sample_weight=w)
+        auc = roc_auc_score(y, engine.predict(ens, x)[:, 1])
+        assert auc > 0.95
+
+    def test_distributed_matches_serial(self):
+        from mmlspark_tpu.parallel import create_mesh
+        x, y = make_classification(n_samples=512, n_features=8, random_state=3)
+        x = x.astype(np.float32)
+        y = y.astype(np.float32)
+        p = GBDTParams(num_iterations=10, max_depth=3, max_bin=31)
+        ens_s = engine.fit_gbdt(x, y, p)
+        ens_d = engine.fit_gbdt(x, y, p, mesh=create_mesh())
+        ps = engine.predict(ens_s, x)[:, 1]
+        pd = engine.predict(ens_d, x)[:, 1]
+        np.testing.assert_allclose(ps, pd, atol=1e-3)
+
+    def test_constant_feature_no_crash(self):
+        x = np.ones((100, 3), dtype=np.float32)
+        y = np.random.default_rng(0).integers(0, 2, 100).astype(np.float32)
+        p = GBDTParams(num_iterations=3, max_depth=2, max_bin=15)
+        ens = engine.fit_gbdt(x, y, p)
+        assert np.isfinite(engine.predict(ens, x)).all()
+
+
+class TestStages:
+    def test_classifier_golden_breast_cancer(self, breast_cancer):
+        xtr, xte, ytr, yte = breast_cancer
+        clf = (LightGBMClassifier().setNumIterations(60).setNumLeaves(16)
+               .setMaxBin(63).setLearningRate(0.1))
+        model = clf.fit(_df_from_matrix(xtr, ytr))
+        out = model.transform(_df_from_matrix(xte, yte))
+        prob = np.stack(list(out.col("probability")))[:, 1]
+        auc = roc_auc_score(yte, prob)
+        # reference commits AUC floors per dataset
+        # (classificationBenchmarkMetrics.csv: breast-cancer.train -> 1.0)
+        assert_golden(GOLDENS, "breast_cancer", "LightGBMClassifier",
+                      "auc", auc, tolerance=0.02)
+        assert auc > 0.97
+        preds = out.col("prediction")
+        assert set(np.unique(preds)) <= {0.0, 1.0}
+
+    def test_regressor_golden_diabetes(self):
+        x, y = load_diabetes(return_X_y=True)
+        xtr, xte, ytr, yte = train_test_split(
+            x.astype(np.float32), y.astype(np.float32), test_size=0.3,
+            random_state=0)
+        reg = (LightGBMRegressor().setNumIterations(80).setNumLeaves(8)
+               .setMaxBin(63).setLearningRate(0.05))
+        model = reg.fit(_df_from_matrix(xtr, ytr))
+        pred = model.transform(_df_from_matrix(xte, yte)).col("prediction")
+        rmse = float(np.sqrt(np.mean((pred - yte) ** 2)))
+        assert_golden(GOLDENS, "diabetes", "LightGBMRegressor", "rmse",
+                      rmse, tolerance=3.0)
+        assert rmse < np.std(yte)  # beats predicting the mean
+
+    def test_quantile_regressor_stage(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1000, 4)).astype(np.float32)
+        y = (x[:, 0] + rng.normal(size=1000)).astype(np.float32)
+        reg = (LightGBMRegressor().setApplication("quantile").setAlpha(0.9)
+               .setNumIterations(40).setMaxBin(31))
+        model = reg.fit(_df_from_matrix(x, y))
+        pred = model.transform(_df_from_matrix(x, y)).col("prediction")
+        assert abs(float((y <= pred).mean()) - 0.9) < 0.1
+
+    def test_multiclass_classifier_stage(self):
+        x, y = make_classification(n_samples=600, n_features=10,
+                                   n_informative=6, n_classes=3,
+                                   random_state=0)
+        model = (LightGBMClassifier().setNumIterations(25).setMaxBin(31)
+                 .fit(_df_from_matrix(x.astype(np.float32), y.astype(np.int64))))
+        out = model.transform(_df_from_matrix(x.astype(np.float32), y))
+        assert len(out.col("probability")[0]) == 3
+        acc = (out.col("prediction") == y).mean()
+        assert acc > 0.8
+
+    def test_model_roundtrip(self, breast_cancer, tmp_path):
+        from mmlspark_tpu.core import load_stage
+        xtr, xte, ytr, yte = breast_cancer
+        model = (LightGBMClassifier().setNumIterations(10).setMaxBin(31)
+                 .fit(_df_from_matrix(xtr, ytr)))
+        model.save(str(tmp_path / "lgbm"))
+        m2 = load_stage(str(tmp_path / "lgbm"))
+        a = np.stack(list(model.transform(_df_from_matrix(xte, yte))
+                          .col("probability")))
+        b = np.stack(list(m2.transform(_df_from_matrix(xte, yte))
+                          .col("probability")))
+        np.testing.assert_allclose(a, b, atol=1e-6)
