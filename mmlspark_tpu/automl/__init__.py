@@ -1,0 +1,13 @@
+from . import metrics
+from .featurize import Featurize, FeaturizeModel
+from .model_statistics import (ComputeModelStatistics,
+                               ComputePerInstanceStatistics)
+from .train_classifier import (TrainClassifier, TrainRegressor,
+                               TrainedClassifierModel, TrainedRegressorModel)
+from .tune import (BestModel, DefaultHyperparams, DiscreteHyperParam,
+                   FindBestModel, GridSpace, HyperparamBuilder,
+                   RandomSpace, RangeHyperParam, TuneHyperparameters,
+                   TuneHyperparametersModel)
+from .value_indexer import IndexToValue, ValueIndexer, ValueIndexerModel
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,231 @@
+"""TuneHyperparameters + FindBestModel (reference: tune-hyperparameters/...
+/TuneHyperparameters.scala:111-184, HyperparamBuilder.scala, ParamSpace.scala,
+DefaultHyperparams.scala; find-best-model/.../FindBestModel.scala:50,
+EvaluationUtils.scala:13).
+
+Randomized k-fold search over declared param distributions, parallelized with
+a thread pool exactly like the reference (:78-94 — fits release the GIL into
+XLA, so threads genuinely overlap device work). Best setting is refit on the
+full data."""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Sequence
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import (ComplexParam, HasLabelCol, IntParam, StringParam)
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.schema import SchemaConstants, SparkSchema
+from . import metrics as M
+from .model_statistics import ComputeModelStatistics
+
+
+# ----------------------------------------------------------- param space
+
+class DiscreteHyperParam:
+    def __init__(self, values: Sequence):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return self.values[rng.integers(0, len(self.values))]
+
+
+class RangeHyperParam:
+    def __init__(self, lo, hi, is_int: bool = False, log: bool = False):
+        self.lo, self.hi, self.is_int, self.log = lo, hi, is_int, log
+
+    def sample(self, rng):
+        if self.log:
+            v = float(np.exp(rng.uniform(np.log(self.lo), np.log(self.hi))))
+        else:
+            v = float(rng.uniform(self.lo, self.hi))
+        return int(round(v)) if self.is_int else v
+
+
+class HyperparamBuilder:
+    """Collects (param name -> distribution) per estimator."""
+
+    def __init__(self):
+        self._dists: list[tuple[str, object]] = []
+
+    def addHyperparam(self, name: str, dist) -> "HyperparamBuilder":
+        self._dists.append((name, dist))
+        return self
+
+    def build(self):
+        return list(self._dists)
+
+
+class GridSpace:
+    """Full cartesian grid over discrete values."""
+
+    def __init__(self, dists: list[tuple[str, DiscreteHyperParam]]):
+        self.dists = dists
+
+    def settings(self, rng=None):
+        import itertools
+        names = [n for n, _ in self.dists]
+        for combo in itertools.product(*[d.values for _, d in self.dists]):
+            yield dict(zip(names, combo))
+
+
+class RandomSpace:
+    """Random samples from the declared distributions."""
+
+    def __init__(self, dists: list[tuple[str, object]]):
+        self.dists = dists
+
+    def sample(self, rng):
+        return {n: d.sample(rng) for n, d in self.dists}
+
+
+class DefaultHyperparams:
+    """Per-algorithm default search spaces (reference
+    DefaultHyperparams.scala)."""
+
+    @staticmethod
+    def for_estimator(est) -> list[tuple[str, object]]:
+        name = type(est).__name__
+        if "LogisticRegression" in name or "LinearRegression" in name:
+            return [("regParam", RangeHyperParam(1e-4, 1.0, log=True)),
+                    ("maxIter", DiscreteHyperParam([100, 200]))]
+        if "LightGBM" in name or "GBT" in name or "RandomForest" in name \
+                or "DecisionTree" in name:
+            return [("numLeaves", DiscreteHyperParam([8, 16, 32])),
+                    ("learningRate", RangeHyperParam(0.02, 0.3, log=True)),
+                    ("numIterations", DiscreteHyperParam([30, 60, 100]))]
+        if "Perceptron" in name or "MLP" in name:
+            return [("stepSize", RangeHyperParam(0.005, 0.1, log=True)),
+                    ("maxIter", DiscreteHyperParam([20, 40]))]
+        return []
+
+
+# ------------------------------------------------------------ evaluation
+
+def _metric_for(df_scored: DataFrame, label_col: str, metric: str) -> float:
+    stats = (ComputeModelStatistics()
+             .setLabelCol(label_col)
+             .setEvaluationMetric("classification"
+                                  if metric in M.CLASSIFICATION_METRICS
+                                  else "regression")
+             .transform(df_scored))
+    if metric not in stats.columns:
+        raise ValueError(f"metric {metric!r} not computed; have {stats.columns}")
+    return float(stats.col(metric)[0])
+
+
+def _kfold_indices(n: int, k: int, seed: int):
+    rng = np.random.default_rng(seed)
+    perm = rng.permutation(n)
+    return np.array_split(perm, k)
+
+
+class TuneHyperparametersModel(Model):
+    bestModel = ComplexParam("refit best model", default=None)
+    bestMetric = ComplexParam("cv metric of the winner", default=None)
+    bestSetting = ComplexParam("winning param setting", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.getBestModel().transform(df)
+
+
+class TuneHyperparameters(Estimator, HasLabelCol):
+    models = ComplexParam("estimators to search over", default=None)
+    paramSpace = ComplexParam("list of (estimator_idx, name, dist) or None "
+                              "for per-algorithm defaults", default=None)
+    evaluationMetric = StringParam("metric name", default="accuracy")
+    numFolds = IntParam("cross-validation folds", default=3, min=2)
+    numRuns = IntParam("random settings sampled per estimator", default=8, min=1)
+    parallelism = IntParam("thread-pool width", default=4, min=1)
+    seed = IntParam("seed", default=0)
+
+    def fit(self, df: DataFrame) -> TuneHyperparametersModel:
+        metric = self.getEvaluationMetric()
+        maximize = M.METRIC_MAXIMIZE[metric]
+        rng = np.random.default_rng(self.getSeed())
+        folds = _kfold_indices(df.count(), self.getNumFolds(), self.getSeed())
+        label = self.getLabelCol()
+
+        candidates = []  # (estimator, setting)
+        for est in self.getModels():
+            dists = DefaultHyperparams.for_estimator(est)
+            space = RandomSpace(dists)
+            seen = set()
+            for _ in range(self.getNumRuns()):
+                setting = space.sample(rng) if dists else {}
+                key = tuple(sorted(setting.items()))
+                if key in seen:
+                    continue
+                seen.add(key)
+                candidates.append((est, setting))
+
+        mask_cache = {}
+
+        def eval_fold(est, setting, fold_i):
+            val_idx = folds[fold_i]
+            if fold_i not in mask_cache:
+                m = np.zeros(df.count(), dtype=bool)
+                m[val_idx] = True
+                mask_cache[fold_i] = m
+            val_mask = mask_cache[fold_i]
+            train = df.filter(~val_mask)
+            val = df.filter(val_mask)
+            model = est.copy(dict(setting, labelCol=label)).fit(train)
+            return _metric_for(model.transform(val), label, metric)
+
+        jobs = [(ci, fi) for ci in range(len(candidates))
+                for fi in range(self.getNumFolds())]
+        results = np.zeros(len(jobs))
+        with ThreadPoolExecutor(self.getParallelism()) as pool:
+            futs = {pool.submit(eval_fold, candidates[ci][0],
+                                candidates[ci][1], fi): j
+                    for j, (ci, fi) in enumerate(jobs)}
+            for fut, j in futs.items():
+                results[j] = fut.result()
+
+        per_candidate = results.reshape(len(candidates), self.getNumFolds())
+        means = per_candidate.mean(axis=1)
+        best_i = int(np.argmax(means) if maximize else np.argmin(means))
+        best_est, best_setting = candidates[best_i]
+        best_model = best_est.copy(
+            dict(best_setting, labelCol=label)).fit(df)
+        return (TuneHyperparametersModel()
+                .setBestModel(best_model)
+                .setBestMetric(float(means[best_i]))
+                .setBestSetting(dict(best_setting)))
+
+
+# ---------------------------------------------------------- find best model
+
+class BestModel(Model):
+    bestModel = ComplexParam("winning fitted model", default=None)
+    bestModelMetrics = ComplexParam("metric value of the winner", default=None)
+    allModelMetrics = ComplexParam("metric per candidate", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return self.getBestModel().transform(df)
+
+
+class FindBestModel(Estimator, HasLabelCol):
+    """Evaluate FITTED models on a dataframe, keep the best (reference:
+    FindBestModel.scala:50)."""
+
+    models = ComplexParam("fitted Transformers to compare", default=None)
+    evaluationMetric = StringParam("metric name", default="accuracy")
+
+    def fit(self, df: DataFrame) -> BestModel:
+        metric = self.getEvaluationMetric()
+        maximize = M.METRIC_MAXIMIZE[metric]
+        scores = []
+        for model in self.getModels():
+            scored = model.transform(df)
+            scores.append(_metric_for(scored, self.getLabelCol(), metric))
+        best_i = int(np.argmax(scores) if maximize else np.argmin(scores))
+        return (BestModel()
+                .setBestModel(self.getModels()[best_i])
+                .setBestModelMetrics(scores[best_i])
+                .setAllModelMetrics(list(zip(
+                    [type(m).__name__ for m in self.getModels()], scores))))

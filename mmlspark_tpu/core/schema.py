@@ -120,6 +120,12 @@ class SparkSchema:
         return df.withMetadata(column, md)
 
     @staticmethod
+    def clearColumnKind(df: DataFrame, column: str) -> DataFrame:
+        md = df.metadata(column)
+        md.get(MML_TAG, {}).pop("kind", None)
+        return df.withMetadata(column, md)
+
+    @staticmethod
     def getColumnKind(df: DataFrame, column: str) -> Optional[str]:
         return df.metadata(column).get(MML_TAG, {}).get("kind")
 

@@ -3,8 +3,20 @@ from .gbdt import (LightGBMClassifier, LightGBMClassificationModel,
                    LightGBMRegressionModel, LightGBMRegressor)
 from .modules import (BiLSTMTagger, ConvNet, MLPNet, ResNet, build_model,
                       example_input)
+from .classical import (DecisionTreeClassifier, DecisionTreeRegressor,
+                        GBTClassifier, GBTRegressor, LinearRegression,
+                        LogisticRegression, MultilayerPerceptronClassifier,
+                        NaiveBayes, RandomForestClassifier,
+                        RandomForestRegressor)
 from .tpu_model import TpuModel
 from .trainer import TpuLearner
 
-__all__ = ["modules", "build_model", "example_input", "MLPNet", "ConvNet",
-           "ResNet", "BiLSTMTagger", "TpuModel", "TpuLearner"]
+__all__ = ["modules", "gbdt", "build_model", "example_input", "MLPNet",
+           "ConvNet", "ResNet", "BiLSTMTagger", "TpuModel", "TpuLearner",
+           "LightGBMClassifier", "LightGBMClassificationModel",
+           "LightGBMRegressor", "LightGBMRegressionModel",
+           "LogisticRegression", "LinearRegression", "NaiveBayes",
+           "DecisionTreeClassifier", "DecisionTreeRegressor",
+           "RandomForestClassifier", "RandomForestRegressor",
+           "GBTClassifier", "GBTRegressor",
+           "MultilayerPerceptronClassifier"]

@@ -1,0 +1,289 @@
+"""Classical learners on XLA: the algorithm families TrainClassifier /
+TrainRegressor expose (reference: train-classifier/.../TrainClassifier.scala:
+45-56 supports LR/DT/RF/GBT/NB/MLP via Spark ML; train-regressor similarly).
+
+TPU-native versions:
+  * LogisticRegression / LinearRegression — full-batch jitted Adam on the
+    (optionally L2-regularized) convex objective; one fused XLA program per
+    step, features live in HBM for the whole fit;
+  * NaiveBayes — Gaussian NB, closed form (one pass of jnp reductions);
+  * DecisionTree / RandomForest / GBT — thin settings over the XLA GBDT
+    engine (RF = LightGBM-style boosting_type=rf bagged mode);
+  * MultilayerPerceptron — TpuLearner with an MLP config.
+
+All estimators share the fit(df) -> Model(transform) contract and emit
+probability/prediction columns like the GBDT stages.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ..core.dataframe import DataFrame
+from ..core.params import (ComplexParam, FloatParam, HasFeaturesCol,
+                           HasLabelCol, IntParam, ListParam, StringParam)
+from ..core.pipeline import Estimator, Model
+from ..core.schema import SparkSchema
+from ..ops.text_ops import rows_to_matrix
+from .gbdt import engine as gbdt_engine
+from .gbdt.stages import (LightGBMClassificationModel, LightGBMClassifier,
+                          LightGBMRegressionModel, LightGBMRegressor,
+                          _features_matrix)
+
+
+def _vec_col(values: np.ndarray) -> np.ndarray:
+    col = np.empty(len(values), dtype=object)
+    for i in range(len(values)):
+        col[i] = values[i]
+    return col
+
+
+class _ProbClassifierModel(Model, HasFeaturesCol):
+    """Shared transform for linear/NB/MLP classification models."""
+    probabilityCol = StringParam("probability column", default="probability")
+    predictionCol = StringParam("predicted label column", default="prediction")
+
+    def _probs(self, x: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = _features_matrix(df, self.getFeaturesCol())
+        prob = self._probs(x)
+        out = (df.withColumn(self.getProbabilityCol(), _vec_col(prob))
+                 .withColumn(self.getPredictionCol(),
+                             prob.argmax(axis=1).astype(np.float64)))
+        out = SparkSchema.setScoresColumnName(out, self.getProbabilityCol(),
+                                              "classification")
+        return SparkSchema.setScoredLabelsColumnName(
+            out, self.getPredictionCol(), "classification")
+
+
+# ------------------------------------------------------------------ linear
+
+def _fit_linear(x: np.ndarray, y: np.ndarray, num_out: int, objective: str,
+                reg_param: float, max_iter: int, lr: float, seed: int):
+    """Full-batch Adam on softmax/linear regression. Returns (W, b)."""
+    n, d = x.shape
+    xj = jnp.asarray(x)
+    yj = jnp.asarray(y)
+    key = jax.random.PRNGKey(seed)
+    W = jnp.zeros((d, num_out), jnp.float32)
+    b = jnp.zeros((num_out,), jnp.float32)
+    tx = optax.adam(lr)
+    opt = tx.init((W, b))
+
+    def loss(params):
+        W, b = params
+        z = xj @ W + b
+        if objective == "classification":
+            ll = optax.softmax_cross_entropy_with_integer_labels(
+                z, yj.astype(jnp.int32)).mean()
+        else:
+            ll = jnp.mean((z[:, 0] - yj) ** 2)
+        return ll + reg_param * jnp.sum(W * W)
+
+    @jax.jit
+    def step(params, opt):
+        l, g = jax.value_and_grad(loss)(params)
+        up, opt2 = tx.update(g, opt, params)
+        return optax.apply_updates(params, up), opt2, l
+
+    params = (W, b)
+    for _ in range(max_iter):
+        params, opt, l = step(params, opt)
+    return np.asarray(params[0]), np.asarray(params[1])
+
+
+class LogisticRegressionModel(_ProbClassifierModel):
+    coefficients = ComplexParam("weight matrix (d, K)", default=None)
+    intercept = ComplexParam("bias (K,)", default=None)
+
+    def _probs(self, x):
+        z = x @ np.asarray(self.getCoefficients()) + np.asarray(self.getIntercept())
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+
+class LogisticRegression(Estimator, HasFeaturesCol, HasLabelCol):
+    regParam = FloatParam("L2 regularization", default=0.0, min=0.0)
+    maxIter = IntParam("optimizer iterations", default=200, min=1)
+    stepSize = FloatParam("Adam learning rate", default=0.05, min=0.0)
+    seed = IntParam("seed", default=0)
+
+    def fit(self, df: DataFrame) -> LogisticRegressionModel:
+        x = _features_matrix(df, self.getFeaturesCol())
+        y = np.asarray(df.col(self.getLabelCol())).astype(np.int64)
+        k = int(y.max()) + 1
+        W, b = _fit_linear(x, y, max(k, 2), "classification",
+                           self.getRegParam(), self.getMaxIter(),
+                           self.getStepSize(), self.getSeed())
+        return (LogisticRegressionModel()
+                .setFeaturesCol(self.getFeaturesCol())
+                .setCoefficients(W).setIntercept(b))
+
+
+class LinearRegressionModel(Model, HasFeaturesCol):
+    predictionCol = StringParam("prediction column", default="prediction")
+    coefficients = ComplexParam("weights (d, 1)", default=None)
+    intercept = ComplexParam("bias (1,)", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        x = _features_matrix(df, self.getFeaturesCol())
+        pred = (x @ np.asarray(self.getCoefficients())
+                + np.asarray(self.getIntercept()))[:, 0].astype(np.float64)
+        out = df.withColumn(self.getPredictionCol(), pred)
+        return SparkSchema.setScoresColumnName(out, self.getPredictionCol(),
+                                               "regression")
+
+
+class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol):
+    regParam = FloatParam("L2 regularization", default=0.0, min=0.0)
+    maxIter = IntParam("optimizer iterations", default=300, min=1)
+    stepSize = FloatParam("Adam learning rate", default=0.05, min=0.0)
+    seed = IntParam("seed", default=0)
+
+    def fit(self, df: DataFrame) -> LinearRegressionModel:
+        x = _features_matrix(df, self.getFeaturesCol())
+        y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
+        W, b = _fit_linear(x, y, 1, "regression", self.getRegParam(),
+                           self.getMaxIter(), self.getStepSize(),
+                           self.getSeed())
+        return (LinearRegressionModel()
+                .setFeaturesCol(self.getFeaturesCol())
+                .setCoefficients(W).setIntercept(b))
+
+
+# -------------------------------------------------------------- naive bayes
+
+class NaiveBayesModel(_ProbClassifierModel):
+    classLogPriors = ComplexParam("(K,) log priors", default=None)
+    means = ComplexParam("(K, d) per-class means", default=None)
+    variances = ComplexParam("(K, d) per-class variances", default=None)
+
+    def _probs(self, x):
+        mu = np.asarray(self.getMeans())
+        var = np.asarray(self.getVariances())
+        lp = np.asarray(self.getClassLogPriors())
+        # gaussian log-likelihood per class, vectorized (n, K)
+        ll = -0.5 * (np.log(2 * np.pi * var)[None]
+                     + (x[:, None, :] - mu[None]) ** 2 / var[None]).sum(axis=2)
+        z = ll + lp[None]
+        e = np.exp(z - z.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+
+
+class NaiveBayes(Estimator, HasFeaturesCol, HasLabelCol):
+    """Gaussian naive Bayes (one jnp pass of per-class moments)."""
+    smoothing = FloatParam("variance smoothing", default=1e-6, min=0.0)
+
+    def fit(self, df: DataFrame) -> NaiveBayesModel:
+        x = _features_matrix(df, self.getFeaturesCol())
+        y = np.asarray(df.col(self.getLabelCol())).astype(np.int32)
+        k = int(y.max()) + 1
+        xj, yj = jnp.asarray(x), jnp.asarray(y)
+        counts = jax.ops.segment_sum(jnp.ones_like(yj, jnp.float32), yj, k)
+        sums = jax.ops.segment_sum(xj, yj, k)
+        sqs = jax.ops.segment_sum(xj * xj, yj, k)
+        mu = sums / counts[:, None]
+        var = sqs / counts[:, None] - mu * mu + self.getSmoothing() \
+            + 1e-9 * jnp.var(xj, axis=0)[None]
+        priors = jnp.log(counts / counts.sum())
+        return (NaiveBayesModel().setFeaturesCol(self.getFeaturesCol())
+                .setClassLogPriors(np.asarray(priors))
+                .setMeans(np.asarray(mu))
+                .setVariances(np.maximum(np.asarray(var), 1e-9)))
+
+
+# ------------------------------------------------------------ tree wrappers
+
+class DecisionTreeClassifier(LightGBMClassifier):
+    """Single tree = one boosting iteration at learning rate 1."""
+    numIterations = IntParam("fixed to 1 for a single tree", default=1)
+    learningRate = FloatParam("fixed to 1 for a single tree", default=1.0)
+    maxDepth = IntParam("tree depth", default=5, min=1)
+
+
+class DecisionTreeRegressor(LightGBMRegressor):
+    numIterations = IntParam("fixed to 1 for a single tree", default=1)
+    learningRate = FloatParam("fixed to 1 for a single tree", default=1.0)
+    maxDepth = IntParam("tree depth", default=5, min=1)
+
+
+class RandomForestClassifier(LightGBMClassifier):
+    """Bagged trees (engine boosting_type=rf), averaged."""
+    numIterations = IntParam("number of trees", default=50, min=1)
+    baggingFraction = FloatParam("bootstrap fraction", default=0.7)
+    baggingFreq = IntParam("resample every tree", default=1)
+    featureFraction = FloatParam("features per tree", default=0.7)
+
+    def _engine_params(self, objective, num_class=1, alpha=0.9):
+        return super()._engine_params(objective, num_class, alpha) \
+            ._replace(boosting_type="rf")
+
+
+class RandomForestRegressor(LightGBMRegressor):
+    numIterations = IntParam("number of trees", default=50, min=1)
+    baggingFraction = FloatParam("bootstrap fraction", default=0.7)
+    baggingFreq = IntParam("resample every tree", default=1)
+    featureFraction = FloatParam("features per tree", default=0.7)
+
+    def _engine_params(self, objective, num_class=1, alpha=0.9):
+        return super()._engine_params(objective, num_class, alpha) \
+            ._replace(boosting_type="rf")
+
+
+class GBTClassifier(LightGBMClassifier):
+    """Gradient-boosted trees, Spark ML surface name."""
+
+
+class GBTRegressor(LightGBMRegressor):
+    pass
+
+
+# ---------------------------------------------------------------------- mlp
+
+class MultilayerPerceptronClassifier(Estimator, HasFeaturesCol, HasLabelCol):
+    layers = ListParam("hidden layer sizes", default=(64,))
+    maxIter = IntParam("epochs", default=30, min=1)
+    stepSize = FloatParam("learning rate", default=0.02, min=0.0)
+    batchSize = IntParam("batch size", default=128, min=1)
+    seed = IntParam("seed", default=0)
+
+    def fit(self, df: DataFrame):
+        from .trainer import TpuLearner
+        y = np.asarray(df.col(self.getLabelCol())).astype(np.int64)
+        k = int(y.max()) + 1
+        learner = (TpuLearner()
+                   .setFeaturesCol(self.getFeaturesCol())
+                   .setLabelCol(self.getLabelCol())
+                   .setModelConfig({"type": "mlp",
+                                    "hidden": list(self.getLayers()),
+                                    "num_classes": max(k, 2)})
+                   .setEpochs(self.getMaxIter())
+                   .setBatchSize(self.getBatchSize())
+                   .setLearningRate(self.getStepSize())
+                   .setOptimizer("adam")
+                   .setSeed(self.getSeed()))
+        inner = learner.fit(df)
+        return (MLPClassificationModel()
+                .setFeaturesCol(self.getFeaturesCol())
+                .setInner(inner))
+
+
+class MLPClassificationModel(_ProbClassifierModel):
+    inner = ComplexParam("fitted TpuModel", default=None)
+
+    def _probs(self, x):
+        import scipy.special
+        tm = self.getInner()
+        feats = _vec_col(x.astype(np.float32))
+        tmp = DataFrame({"features": feats})
+        logits = np.stack(list(
+            tm.setInputCol("features").setOutputCol("scores")
+            .transform(tmp).col("scores")))
+        return scipy.special.softmax(logits, axis=1)

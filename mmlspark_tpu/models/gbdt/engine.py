@@ -47,6 +47,7 @@ class GBDTParams(NamedTuple):
     num_class: int = 1
     seed: int = 0
     early_stopping_round: int = 0
+    boosting_type: str = "gbdt"     # gbdt | rf (bagged trees, LightGBM rf mode)
 
 
 class TreeEnsemble(NamedTuple):
@@ -260,10 +261,20 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     p = params
     n, d = x.shape
     K = p.num_class if p.objective == "multiclass" else 1
-    edges = compute_bin_edges(x, p.max_bin)
+    is_rf = p.boosting_type == "rf"
+    if is_rf and not ((p.bagging_fraction < 1.0 and p.bagging_freq > 0)
+                      or p.feature_fraction < 1.0):
+        raise ValueError("boosting_type='rf' without bagging or feature "
+                         "subsampling trains identical trees; set "
+                         "bagging_fraction<1 + bagging_freq>=1 (LightGBM "
+                         "rejects this combination too)")
+    # global statistics (bin edges, init score) must come from REAL rows only
+    # — mesh padding / user-masked rows are weight 0
+    real = slice(None) if sample_weight is None else sample_weight > 0
+    edges = compute_bin_edges(x[real], p.max_bin)
     bins = bin_data(x, edges)
     yj = jnp.asarray(y.astype(np.float32))
-    base = _init_score(y, p)
+    base = _init_score(y[real], p)
     raw = jnp.broadcast_to(jnp.asarray(base)[None, :], (n, K)).astype(jnp.float32)
     bins_j = jnp.asarray(bins)
 
@@ -276,6 +287,10 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     rng = np.random.default_rng(p.seed)
     feats, thrs, leaves = [], [], []
     best_loss, since_best, best_iter = np.inf, 0, None
+    if is_rf:
+        # rf averages a fixed-size forest; a partial average is not a
+        # comparable validation series, so early stopping does not apply
+        p = p._replace(early_stopping_round=0)
     # early stopping monitors a held-out set (LightGBM's valid_sets contract;
     # train loss is monotone in boosting so it can never trigger a stop)
     if p.early_stopping_round > 0 and eval_set is None:
@@ -299,6 +314,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                                    (bins_val.shape[0], K)).astype(jnp.float32)
 
     for it in range(p.num_iterations):
+        # rf mode (LightGBM boosting=rf): every tree fits the INITIAL
+        # gradients on its own bootstrap sample; raw never moves during the
+        # fit and leaves are averaged (scaled 1/T) at the end
         g, h = _grad_hess(raw, yj, p.objective, K, p.alpha)
         if p.bagging_fraction < 1.0 and p.bagging_freq > 0:
             if it % p.bagging_freq == 0:
@@ -328,14 +346,17 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
             min_child_weight=p.min_child_weight,
             min_split_gain=p.min_split_gain)
-        lv = lv * p.learning_rate
-        contrib = jnp.stack(
-            [_predict_tree(bins_j, f[k], t[k], lv[k], depth=p.max_depth)
-             for k in range(K)], axis=1)
-        raw = raw + contrib
+        # rf leaves stay unscaled here; the 1/T average is applied at the end
+        # over the ACTUAL forest size
+        lv = lv * (1.0 if is_rf else p.learning_rate)
         feats.append(f)
         thrs.append(t)
         leaves.append(lv)
+        if not is_rf:
+            contrib = jnp.stack(
+                [_predict_tree(bins_j, f[k], t[k], lv[k], depth=p.max_depth)
+                 for k in range(K)], axis=1)
+            raw = raw + contrib
 
         if p.early_stopping_round > 0:
             raw_val = raw_val + jnp.stack(
@@ -352,6 +373,8 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     if best_iter is not None:
         feats, thrs, leaves = (feats[:best_iter], thrs[:best_iter],
                                leaves[:best_iter])
+    if is_rf:
+        leaves = [lv / len(leaves) for lv in leaves]
     return TreeEnsemble(
         feature=jnp.stack(feats), threshold=jnp.stack(thrs),
         leaf=jnp.stack(leaves), bin_edges=edges, base=base,

@@ -20,7 +20,6 @@ from ...core.params import (ComplexParam, FloatParam, HasFeaturesCol,
                             HasLabelCol, IntParam, StringParam)
 from ...core.pipeline import Estimator, Model
 from ...core.schema import SparkSchema
-from ...core.utils import to_float32_matrix
 from ...ops.text_ops import rows_to_matrix
 from ...parallel import mesh as meshlib
 from . import engine
@@ -87,7 +86,6 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
     p = params_holder._engine_params(objective, num_class, alpha)
     mesh = params_holder._mesh()
     if mesh is not None:
-        shards = mesh.shape["data"]
         x, n = meshlib.pad_batch_to_devices(x, mesh)
         y = np.concatenate([y, np.zeros(len(x) - n, y.dtype)])
         w = np.concatenate([np.ones(n, np.float32),

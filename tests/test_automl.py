@@ -1,0 +1,210 @@
+"""AutoML layer tests (reference: train-classifier benchmarkMetrics.csv grid
+of dataset x algorithm goldens, tune-hyperparameters suite, Featurize
+benchmark JSONs — SURVEY.md §4)."""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer, load_iris
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import (ComputeModelStatistics,
+                                 ComputePerInstanceStatistics, Featurize,
+                                 FindBestModel, IndexToValue,
+                                 TrainClassifier, TrainRegressor,
+                                 TuneHyperparameters, ValueIndexer)
+from mmlspark_tpu.automl.metrics import auc_score, classification_metrics
+from mmlspark_tpu.models import (DecisionTreeClassifier, GBTClassifier,
+                                 LinearRegression, LogisticRegression,
+                                 MultilayerPerceptronClassifier, NaiveBayes,
+                                 RandomForestClassifier)
+from mmlspark_tpu.testing import assert_golden
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens",
+                       "train_classifier_benchmark_metrics.csv")
+
+
+@pytest.fixture(scope="module")
+def mixed_df():
+    rng = np.random.default_rng(0)
+    n = 240
+    y = rng.integers(0, 2, n)
+    return DataFrame({
+        "num": rng.normal(size=n) + y * 2,
+        "intcol": rng.integers(0, 5, n),
+        "cat": np.array(["red", "green", "blue"], dtype=object)[
+            (y + rng.integers(0, 2, n)) % 3],
+        "text": np.array([f"token{v} filler words row{i}" for i, v in
+                          enumerate(y * 3 + rng.integers(0, 2, n))],
+                         dtype=object),
+        "income": y.astype(object),  # object labels exercise indexing
+    })
+
+
+class TestValueIndexer:
+    def test_roundtrip(self):
+        df = DataFrame({"c": np.array(["b", "a", "b", "c"], dtype=object)})
+        model = ValueIndexer().setInputCol("c").setOutputCol("i").fit(df)
+        out = model.transform(df)
+        np.testing.assert_array_equal(out.col("i"), [1.0, 0.0, 1.0, 2.0])
+        back = IndexToValue().setInputCol("i").setOutputCol("c2").transform(out)
+        assert list(back.col("c2")) == ["b", "a", "b", "c"]
+
+    def test_unseen_value_raises(self):
+        df = DataFrame({"c": np.array(["a", "b"], dtype=object)})
+        model = ValueIndexer().setInputCol("c").setOutputCol("i").fit(df)
+        df2 = DataFrame({"c": np.array(["z"], dtype=object)})
+        with pytest.raises(ValueError):
+            model.transform(df2)
+
+
+class TestFeaturize:
+    def test_mixed_columns(self, mixed_df):
+        model = (Featurize().setOutputCol("features")
+                 .setExcludeCols(("income",)).setNumberOfFeatures(64)
+                 .fit(mixed_df))
+        out = model.transform(mixed_df)
+        v = out.col("features")[0]
+        # num(1) + intcol(1) + cat one-hot(3) + text hash(64)
+        assert v.shape == (69,)
+        assert v.dtype == np.float32
+
+    def test_roundtrip_serialization(self, mixed_df, tmp_path):
+        from mmlspark_tpu.core import load_stage
+        model = (Featurize().setOutputCol("f").setExcludeCols(("income",))
+                 .setNumberOfFeatures(32).fit(mixed_df))
+        model.save(str(tmp_path / "feat"))
+        m2 = load_stage(str(tmp_path / "feat"))
+        a = np.stack(list(model.transform(mixed_df).col("f")))
+        b = np.stack(list(m2.transform(mixed_df).col("f")))
+        np.testing.assert_allclose(a, b)
+
+
+ALGOS = {
+    "LogisticRegression": lambda: LogisticRegression().setMaxIter(80),
+    "DecisionTree": lambda: DecisionTreeClassifier().setMaxBin(31),
+    "RandomForest": lambda: RandomForestClassifier()
+        .setNumIterations(20).setMaxBin(31),
+    "GBT": lambda: GBTClassifier().setNumIterations(20).setMaxBin(31),
+    "NaiveBayes": lambda: NaiveBayes(),
+    "MLP": lambda: MultilayerPerceptronClassifier().setMaxIter(15),
+}
+
+
+class TestTrainClassifier:
+    @pytest.mark.parametrize("algo", list(ALGOS))
+    def test_breast_cancer_golden_grid(self, algo):
+        # the reference's benchmarkMetrics.csv grid: dataset x algorithm
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = {f"f{i}": x[:, i].astype(np.float32) for i in range(10)}
+        df = DataFrame({**feats, "Label": y.astype(np.int64)})
+        model = (TrainClassifier().setLabelCol("Label")
+                 .setModel(ALGOS[algo]()).fit(df))
+        out = model.transform(df)
+        acc = float((out.col("scored_labels").astype(np.float64) == y).mean())
+        assert_golden(GOLDENS, "breast_cancer", algo, "accuracy", acc,
+                      tolerance=0.03)
+        assert acc > 0.85, f"{algo}: {acc}"
+
+    def test_object_labels_decoded(self, mixed_df):
+        model = (TrainClassifier().setLabelCol("income")
+                 .setModel(LogisticRegression().setMaxIter(40)).fit(mixed_df))
+        out = model.transform(mixed_df)
+        assert set(np.unique([str(v) for v in out.col("scored_labels")])) \
+            <= {"0", "1"}
+
+    def test_multiclass_iris(self):
+        x, y = load_iris(return_X_y=True)
+        df = DataFrame({f"f{i}": x[:, i].astype(np.float32) for i in range(4)}
+                       | {"label": y.astype(np.int64)})
+        model = (TrainClassifier().setLabelCol("label")
+                 .setModel(GBTClassifier().setNumIterations(20).setMaxBin(31))
+                 .fit(df))
+        out = model.transform(df)
+        acc = (out.col("scored_labels").astype(np.float64) == y).mean()
+        assert acc > 0.9
+
+
+class TestTrainRegressor:
+    def test_linear_target(self):
+        rng = np.random.default_rng(0)
+        n = 300
+        x1 = rng.normal(size=n)
+        x2 = rng.normal(size=n)
+        y = 3 * x1 - 2 * x2 + rng.normal(size=n) * 0.1
+        df = DataFrame({"x1": x1, "x2": x2, "label": y})
+        model = (TrainRegressor().setLabelCol("label")
+                 .setModel(LinearRegression().setMaxIter(300)).fit(df))
+        pred = model.transform(df).col("prediction")
+        assert float(np.corrcoef(pred, y)[0, 1]) > 0.98
+
+
+class TestModelStatistics:
+    def test_classification_stats(self, mixed_df):
+        model = (TrainClassifier().setLabelCol("income")
+                 .setModel(LogisticRegression().setMaxIter(40)).fit(mixed_df))
+        scored = model.transform(mixed_df)
+        scored = scored.withColumn("income",
+                                   mixed_df.col("income"))
+        stats = (ComputeModelStatistics().setLabelCol("income")
+                 .setEvaluationMetric("classification").transform(scored))
+        row = stats.first()
+        assert 0.5 <= row["accuracy"] <= 1.0
+        assert row["confusion_matrix"].shape == (2, 2)
+        assert "AUC" in stats.columns
+
+    def test_regression_stats(self):
+        df = DataFrame({"label": [1.0, 2.0, 3.0, 4.0],
+                        "prediction": [1.1, 1.9, 3.2, 3.8]})
+        stats = (ComputeModelStatistics().setLabelCol("label")
+                 .setScoredLabelsCol("prediction")
+                 .setEvaluationMetric("regression").transform(df))
+        row = stats.first()
+        assert row["rmse"] < 0.25 and row["r2"] > 0.95
+
+    def test_auc_matches_sklearn(self):
+        from sklearn.metrics import roc_auc_score
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 2, 200)
+        s = rng.random(200) + y * 0.3
+        np.testing.assert_allclose(auc_score(y, s), roc_auc_score(y, s),
+                                   atol=1e-10)
+
+    def test_per_instance(self):
+        df = DataFrame({"label": [1.0, 2.0], "prediction": [1.5, 1.0]})
+        out = (ComputePerInstanceStatistics().setLabelCol("label")
+               .setScoresCol("prediction").transform(df))
+        np.testing.assert_allclose(out.col("L1_loss"), [0.5, 1.0])
+        np.testing.assert_allclose(out.col("L2_loss"), [0.25, 1.0])
+
+
+class TestTuneAndFindBest:
+    def test_tune_hyperparameters(self):
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+        tuned = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(40),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(3).setParallelism(2)
+                 .fit(df))
+        assert tuned.getBestMetric() > 0.85
+        assert "regParam" in tuned.getBestSetting()
+        out = tuned.transform(df)
+        assert "prediction" in out.columns
+
+    def test_find_best_model(self):
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+        m1 = LogisticRegression().setMaxIter(60).fit(df)
+        m2 = NaiveBayes().fit(df)
+        best = (FindBestModel().setModels((m1, m2))
+                .setEvaluationMetric("AUC").fit(df))
+        assert best.getBestModelMetrics() > 0.9
+        assert len(best.getAllModelMetrics()) == 2
