@@ -28,7 +28,18 @@ import numpy as np
 def _as_column(values) -> np.ndarray:
     if isinstance(values, np.ndarray):
         return values
-    arr = np.asarray(values)
+    # any python sequence of per-row sequences/arrays becomes an object
+    # column — ONE canonical representation for vector-valued columns,
+    # regardless of whether rows arrive as lists, tuples, or ndarrays
+    if isinstance(values, (list, tuple)) and values and \
+            isinstance(values[0], (list, tuple, np.ndarray)):
+        from .utils import object_column
+        return object_column(values)
+    try:
+        arr = np.asarray(values)
+    except ValueError:
+        from .utils import object_column
+        return object_column(values)
     if arr.dtype.kind == "U":  # normalize unicode to object for cheap appends
         arr = arr.astype(object)
     if arr.dtype.kind not in "bifuOSU" and arr.ndim == 0:

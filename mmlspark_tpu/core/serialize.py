@@ -51,8 +51,20 @@ def _save_complex(value: Any, path: str) -> dict:
     if isinstance(value, np.ndarray):
         np.save(path + ".npy", value)
         return {"kind": "ndarray"}
-    # try a flax-msgpack pytree (covers jax arrays / nested dicts of arrays)
+    # try a flax-msgpack pytree (covers jax arrays / nested dicts of arrays);
+    # msgpack restore rejects non-string map keys, so only use it for
+    # string-keyed trees
+    def _str_keyed(v):
+        if isinstance(v, dict):
+            return all(isinstance(k, str) and _str_keyed(x)
+                       for k, x in v.items())
+        if isinstance(v, (list, tuple)):
+            return all(_str_keyed(x) for x in v)
+        return True
+
     try:
+        if not _str_keyed(value):
+            raise TypeError("non-string map keys")
         from flax import serialization
         blob = serialization.msgpack_serialize(value)
         with open(path + ".msgpack", "wb") as f:

@@ -42,6 +42,15 @@ def sha256_file(path: str) -> str:
     return h.hexdigest()
 
 
+def object_column(values) -> np.ndarray:
+    """Build a 1-D object ndarray holding one (possibly vector) value per
+    row — the canonical representation of vector-valued columns."""
+    out = np.empty(len(values), dtype=object)
+    for i, v in enumerate(values):
+        out[i] = v
+    return out
+
+
 def to_float32_matrix(col: np.ndarray) -> np.ndarray:
     """Coerce a column of scalars / vectors / lists into an (n, d) float32
     matrix — the device-feed analog of the reference's input coercion UDF

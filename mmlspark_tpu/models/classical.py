@@ -37,14 +37,13 @@ from .gbdt.stages import (LightGBMClassificationModel, LightGBMClassifier,
 
 
 def _vec_col(values: np.ndarray) -> np.ndarray:
-    col = np.empty(len(values), dtype=object)
-    for i in range(len(values)):
-        col[i] = values[i]
-    return col
+    from ..core.utils import object_column
+    return object_column(values)
 
 
 class _ProbClassifierModel(Model, HasFeaturesCol):
     """Shared transform for linear/NB/MLP classification models."""
+    _abstract = True
     probabilityCol = StringParam("probability column", default="probability")
     predictionCol = StringParam("predicted label column", default="prediction")
 

@@ -129,11 +129,9 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
         ens = self._ensemble()
         raw = engine.predict_raw(ens, x)
         prob = engine.prob_from_raw(ens.objective, raw)
-        raw_col = np.empty(len(x), dtype=object)
-        prob_col = np.empty(len(x), dtype=object)
-        for i in range(len(x)):
-            raw_col[i] = raw[i]
-            prob_col[i] = prob[i]
+        from ...core.utils import object_column
+        raw_col = object_column(raw)
+        prob_col = object_column(prob)
         out = (df.withColumn(self.getRawPredictionCol(), raw_col)
                  .withColumn(self.getProbabilityCol(), prob_col)
                  .withColumn(self.getPredictionCol(),

@@ -106,10 +106,8 @@ def apply_idf(tf: sp.csr_matrix, weights: np.ndarray) -> sp.csr_matrix:
 
 def csr_to_row_objects(mat: sp.csr_matrix) -> np.ndarray:
     """CSR matrix -> object column of 1-row CSR slices (sparse row vectors)."""
-    out = np.empty(mat.shape[0], dtype=object)
-    for i in range(mat.shape[0]):
-        out[i] = mat.getrow(i)
-    return out
+    from ..core.utils import object_column
+    return object_column([mat.getrow(i) for i in range(mat.shape[0])])
 
 
 def rows_to_matrix(col: np.ndarray):

@@ -1,0 +1,9 @@
+from .basic import (Cacher, CheckpointData, ClassBalancer, ClassBalancerModel,
+                    DropColumns, MultiColumnAdapter, RenameColumn,
+                    Repartition, SelectColumns, Timer, UDFTransformer)
+from .data_stages import (CleanMissingData, CleanMissingDataModel,
+                          DataConversion, EnsembleByKey, PartitionSample,
+                          SummarizeData, TextPreprocessor)
+from .minibatch import FlattenBatch, MiniBatchTransformer
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,164 @@
+"""Generic pipeline stages (reference: src/pipeline-stages — Cacher.scala:12,
+DropColumns:19, SelectColumns:21, RenameColumn:18, Repartition:18,
+UDFTransformer:21, ClassBalancer:25, Timer.scala:54; checkpoint-data/...
+CheckpointData.scala:47; multi-column-adapter/.../MultiColumnAdapter.scala:17)."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import (BooleanParam, ComplexParam, HasInputCol,
+                           HasOutputCol, IntParam, ListParam, StringParam)
+from ..core.pipeline import Estimator, Model, Transformer
+from ..core.utils import get_logger
+
+log = get_logger("stages")
+
+
+class Cacher(Transformer):
+    """Materialize + cache (reference Cacher.scala:12). The columnar frame is
+    already materialized; this pins it (no-op hook kept for API parity)."""
+    disable = BooleanParam("pass through without caching", default=False)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df if self.getDisable() else df.cache()
+
+
+class CheckpointData(Transformer):
+    """Persist to memory/disk (reference CheckpointData.scala:47)."""
+    diskIncluded = BooleanParam("also spill to disk", default=False)
+    removeCheckpoint = BooleanParam("unpersist instead", default=False)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.unpersist() if self.getRemoveCheckpoint() else df.persist()
+
+
+class DropColumns(Transformer):
+    cols = ListParam("columns to drop", default=())
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        missing = [c for c in self.getCols() if c not in df.columns]
+        if missing:
+            raise ValueError(f"cannot drop missing columns {missing}")
+        return df.drop(*self.getCols())
+
+
+class SelectColumns(Transformer):
+    cols = ListParam("columns to keep", default=())
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.select(*self.getCols())
+
+
+class RenameColumn(Transformer, HasInputCol, HasOutputCol):
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df.withColumnRenamed(self.getInputCol(), self.getOutputCol())
+
+
+class Repartition(Transformer):
+    """Adjust logical partition count (reference Repartition.scala:18 with its
+    `disable` flag)."""
+    n = IntParam("target partition count", default=1, min=1)
+    disable = BooleanParam("pass through unchanged", default=False)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        return df if self.getDisable() else df.repartition(self.getN())
+
+
+class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Apply a python function per row value, or to the whole column when
+    vectorized=True (reference UDFTransformer.scala:21; the python-UDF path
+    of UDPyFParam)."""
+    udf = ComplexParam("function value->value (or column->column)", default=None)
+    vectorized = BooleanParam("udf takes the whole column array", default=False)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn = self.getUdf()
+        col = df.col(self.getInputCol())
+        if self.getVectorized():
+            out = fn(col)
+        else:
+            out = np.array([fn(v) for v in col])
+        return df.withColumn(self.getOutputCol(), out)
+
+
+class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
+    """Inverse-frequency instance weights (reference ClassBalancer.scala:25):
+    weight = max_count / count(class), optionally normalized so the largest
+    class gets 1.0."""
+    broadcastJoin = BooleanParam("kept for API parity", default=True)
+
+    def fit(self, df: DataFrame) -> "ClassBalancerModel":
+        col = df.col(self.getInputCol())
+        values, counts = np.unique(col, return_counts=True)
+        weights = counts.max() / counts.astype(np.float64)
+        return (ClassBalancerModel()
+                .setInputCol(self.getInputCol())
+                .setOutputCol(self.getOutputCol() or "weight")
+                .setWeightTable({v: float(w) for v, w in zip(values.tolist(),
+                                                             weights)}))
+
+
+class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
+    weightTable = ComplexParam("class value -> weight", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        table = self.getWeightTable()
+        col = df.col(self.getInputCol())
+        out = np.array([table.get(v, 1.0) for v in col.tolist()],
+                       dtype=np.float64)
+        return df.withColumn(self.getOutputCol(), out)
+
+
+class MultiColumnAdapter(Transformer):
+    """Map a unary stage over (inputCol, outputCol) pairs (reference
+    MultiColumnAdapter.scala:17)."""
+    baseStage = ComplexParam("unary PipelineStage to replicate", default=None)
+    inputCols = ListParam("input columns", default=())
+    outputCols = ListParam("output columns", default=())
+
+    def _pairs(self):
+        ins, outs = self.getInputCols(), self.getOutputCols()
+        if len(ins) != len(outs):
+            raise ValueError("inputCols and outputCols must align")
+        return list(zip(ins, outs))
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        for i, o in self._pairs():
+            stage = self.getBaseStage().copy({"inputCol": i, "outputCol": o})
+            if isinstance(stage, Estimator):
+                df = stage.fit(df).transform(df)
+            else:
+                df = stage.transform(df)
+        return df
+
+
+class Timer(Transformer):
+    """Wrap a stage, log wall-clock of fit/transform (reference
+    Timer.scala:36-70 materializes to defeat laziness; our frames are eager so
+    timing is direct). TPU upgrade: logToProfiler=True brackets the stage in a
+    jax.profiler trace annotation for xplane tooling."""
+    stage = ComplexParam("inner PipelineStage", default=None)
+    logToConsole = BooleanParam("print timing", default=True)
+    logToProfiler = BooleanParam("emit a jax.profiler annotation", default=False)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        inner = self.getStage()
+        t0 = time.perf_counter()
+        if self.getLogToProfiler():
+            import jax.profiler
+            with jax.profiler.TraceAnnotation(
+                    f"Timer/{type(inner).__name__}"):
+                out = (inner.fit(df).transform(df)
+                       if isinstance(inner, Estimator) else inner.transform(df))
+        else:
+            out = (inner.fit(df).transform(df)
+                   if isinstance(inner, Estimator) else inner.transform(df))
+        dt = time.perf_counter() - t0
+        if self.getLogToConsole():
+            log.warning("%s took %.3fs", type(inner).__name__, dt)
+        self._last_seconds = dt
+        return out

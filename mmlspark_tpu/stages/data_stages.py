@@ -1,0 +1,237 @@
+"""Data-shaping stages (reference: clean-missing-data/.../
+CleanMissingData.scala:46, data-conversion/.../DataConversion.scala:23,
+partition-sample/.../PartitionSample.scala:131, summarize-data/...
+SummarizeData.scala:98, ensemble/.../EnsembleByKey.scala:21,
+pipeline-stages TextPreprocessor.scala:97)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.params import (BooleanParam, ComplexParam, DictParam, FloatParam,
+                           HasInputCol, HasOutputCol, IntParam, ListParam,
+                           StringParam)
+from ..core.pipeline import Estimator, Model, Transformer
+
+
+class CleanMissingData(Estimator):
+    """Impute missing values: mean/median/custom (reference
+    CleanMissingData.scala:46)."""
+    inputCols = ListParam("columns to clean", default=())
+    outputCols = ListParam("output columns (default: in place)", default=())
+    cleaningMode = StringParam("Mean|Median|Custom", default="Mean",
+                               choices=("Mean", "Median", "Custom"))
+    customValue = FloatParam("fill value for Custom mode", default=0.0)
+
+    def fit(self, df: DataFrame) -> "CleanMissingDataModel":
+        cols = list(self.getInputCols()) or [
+            c for c in df.columns if df.col(c).dtype.kind == "f"]
+        fills = {}
+        for c in cols:
+            vals = df.col(c).astype(np.float64)
+            ok = vals[~np.isnan(vals)]
+            if self.getCleaningMode() == "Mean":
+                fills[c] = float(ok.mean()) if len(ok) else 0.0
+            elif self.getCleaningMode() == "Median":
+                fills[c] = float(np.median(ok)) if len(ok) else 0.0
+            else:
+                fills[c] = self.getCustomValue()
+        outs = list(self.getOutputCols()) or cols
+        return (CleanMissingDataModel().setFillValues(fills)
+                .setOutputCols(tuple(outs)).setInputCols(tuple(cols)))
+
+
+class CleanMissingDataModel(Model):
+    inputCols = ListParam("columns to clean", default=())
+    outputCols = ListParam("output columns", default=())
+    fillValues = ComplexParam("column -> fill value", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fills = self.getFillValues()
+        for c, o in zip(self.getInputCols(), self.getOutputCols()):
+            vals = df.col(c).astype(np.float64)
+            df = df.withColumn(o, np.where(np.isnan(vals), fills[c], vals))
+        return df
+
+
+class DataConversion(Transformer):
+    """Column type casts + date reformat (reference DataConversion.scala:23).
+    convertTo: boolean|byte|short|integer|long|float|double|string|date."""
+    cols = ListParam("columns to convert", default=())
+    convertTo = StringParam("target type", default="double")
+    dateTimeFormat = StringParam("strftime format for date conversion",
+                                 default="%Y-%m-%d %H:%M:%S")
+
+    _NUMPY_TYPES = {"boolean": np.bool_, "byte": np.int8, "short": np.int16,
+                    "integer": np.int32, "long": np.int64,
+                    "float": np.float32, "double": np.float64}
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        target = self.getConvertTo()
+        for c in self.getCols():
+            col = df.col(c)
+            if target in self._NUMPY_TYPES:
+                df = df.withColumn(c, col.astype(self._NUMPY_TYPES[target]))
+            elif target == "string":
+                df = df.withColumn(
+                    c, np.array([str(v) for v in col], dtype=object))
+            elif target == "date":
+                import datetime
+                fmt = self.getDateTimeFormat()
+                out = np.array([datetime.datetime.strptime(str(v), fmt)
+                                for v in col], dtype=object)
+                df = df.withColumn(c, out)
+            elif target == "toCategorical":
+                from ..core.schema import CategoricalUtilities
+                levels = sorted({v for v in col.tolist()}, key=str)
+                df = CategoricalUtilities.setLevels(df, c, levels)
+            else:
+                raise ValueError(f"unknown conversion target {target!r}")
+        return df
+
+
+class PartitionSample(Transformer):
+    """head / random % / assign-to-partition sampling (reference
+    PartitionSample.scala:131)."""
+    mode = StringParam("Head|RandomSample|AssignToPartition",
+                       default="RandomSample",
+                       choices=("Head", "RandomSample", "AssignToPartition"))
+    count = IntParam("rows for Head mode", default=1000, min=0)
+    percent = FloatParam("fraction for RandomSample", default=0.1)
+    seed = IntParam("random seed", default=0)
+    newColName = StringParam("partition-id column for AssignToPartition",
+                             default="Partition")
+    numParts = IntParam("partitions for AssignToPartition", default=10, min=1)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        mode = self.getMode()
+        if mode == "Head":
+            return df.limit(self.getCount())
+        if mode == "RandomSample":
+            return df.sample(self.getPercent(), seed=self.getSeed())
+        rng = np.random.default_rng(self.getSeed())
+        ids = rng.integers(0, self.getNumParts(), df.count())
+        return df.withColumn(self.getNewColName(), ids.astype(np.int64))
+
+
+class SummarizeData(Transformer):
+    """Per-column stats table (reference SummarizeData.scala:98): counts,
+    basic moments, percentiles, error-count toggles."""
+    counts = BooleanParam("row/missing counts", default=True)
+    basic = BooleanParam("mean/std/min/max", default=True)
+    percentiles = BooleanParam("p25/p50/p75", default=True)
+    errorThreshold = FloatParam("kept for parity", default=0.0)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        rows = []
+        for c in df.columns:
+            col = df.col(c)
+            row = {"Feature": c}
+            numeric = col.dtype.kind in "bifu"
+            vals = col.astype(np.float64) if numeric else None
+            if self.getCounts():
+                row["Count"] = float(len(col))
+                if numeric:
+                    row["Unique Value Count"] = float(len(np.unique(
+                        vals[~np.isnan(vals)])))
+                    row["Missing Value Count"] = float(np.isnan(vals).sum())
+                else:
+                    row["Unique Value Count"] = float(len(set(col.tolist())))
+                    row["Missing Value Count"] = float(
+                        sum(v is None for v in col.tolist()))
+            if self.getBasic():
+                ok = vals[~np.isnan(vals)] if numeric else None
+                row["Mean"] = float(ok.mean()) if numeric and len(ok) else np.nan
+                row["Standard Deviation"] = (float(ok.std(ddof=1))
+                                             if numeric and len(ok) > 1 else np.nan)
+                row["Min"] = float(ok.min()) if numeric and len(ok) else np.nan
+                row["Max"] = float(ok.max()) if numeric and len(ok) else np.nan
+            if self.getPercentiles():
+                ok = vals[~np.isnan(vals)] if numeric else None
+                for q, name in ((25, "P25"), (50, "Median"), (75, "P75")):
+                    row[name] = (float(np.percentile(ok, q))
+                                 if numeric and len(ok) else np.nan)
+            rows.append(row)
+        return DataFrame.fromRows(rows)
+
+
+class EnsembleByKey(Transformer):
+    """Group rows by key column(s) and aggregate vector/double columns by
+    mean or collect (reference EnsembleByKey.scala:21)."""
+    keys = ListParam("key columns", default=())
+    cols = ListParam("value columns to aggregate", default=())
+    strategy = StringParam("mean|collect", default="mean",
+                           choices=("mean", "collect"))
+    collapseGroup = BooleanParam("one row per key (vs broadcast back)",
+                                 default=True)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        keys = list(self.getKeys())
+        vcols = list(self.getCols())
+        if not keys or not vcols:
+            raise ValueError("keys and cols must both be set")
+        key_vals = [tuple(df.col(k)[i] for k in keys)
+                    for i in range(df.count())]
+        groups: dict[tuple, list[int]] = {}
+        for i, kv in enumerate(key_vals):
+            groups.setdefault(kv, []).append(i)
+        rows = []
+        for kv, idxs in groups.items():
+            row = dict(zip(keys, kv))
+            for c in vcols:
+                col = df.col(c)
+                vals = [col[i] for i in idxs]
+                if self.getStrategy() == "collect":
+                    row[c] = list(vals)
+                elif np.ndim(vals[0]) >= 1:
+                    row[c] = np.mean(np.stack(vals), axis=0)
+                else:
+                    row[c] = float(np.mean(vals))
+            rows.append(row)
+        out = DataFrame.fromRows(rows)
+        if self.getCollapseGroup():
+            return out
+        # broadcast aggregates back onto every original row
+        agg = {tuple(r[k] for k in keys): r for r in rows}
+        res = df
+        for c in vcols:
+            col = np.empty(df.count(), dtype=object)
+            for i, kv in enumerate(key_vals):
+                col[i] = agg[kv][c]
+            res = res.withColumn(c, col)
+        return res
+
+
+class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
+    """Longest-match substring replacement via a trie (reference
+    TextPreprocessor.scala:97 builds a char trie over the map keys)."""
+    map = DictParam("substring -> replacement", default=None)
+    normFunc = StringParam("identity|lowerCase|upperCase", default="identity",
+                           choices=("identity", "lowerCase", "upperCase"))
+
+    def _normalize(self, s: str) -> str:
+        f = self.getNormFunc()
+        return s.lower() if f == "lowerCase" else \
+            s.upper() if f == "upperCase" else s
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        table = dict(self.getMap() or {})
+        # longest-match-first scan (trie semantics without the trie)
+        keys = sorted(table, key=len, reverse=True)
+        col = df.col(self.getInputCol())
+        out = np.empty(len(col), dtype=object)
+        for r, text in enumerate(col):
+            s = self._normalize("" if text is None else str(text))
+            buf, i = [], 0
+            while i < len(s):
+                for k in keys:
+                    if s.startswith(k, i):
+                        buf.append(table[k])
+                        i += len(k)
+                        break
+                else:
+                    buf.append(s[i])
+                    i += 1
+            out[r] = "".join(buf)
+        return df.withColumn(self.getOutputCol(), out)

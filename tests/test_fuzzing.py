@@ -1,0 +1,271 @@
+"""Registry-driven stage fuzzing + coverage gate.
+
+The reference's FuzzingTest.scala:25-130 reflects over every PipelineStage in
+the built jars and fails if any stage lacks a fuzzer or breaks serialization.
+Here the stage registry is the reflection source; every concrete
+non-Model framework stage must register a TestObject factory below (Models
+are exercised through their estimators, as in the reference)."""
+
+import numpy as np
+import pytest
+
+import mmlspark_tpu  # populates registry
+from mmlspark_tpu import DataFrame, Pipeline
+from mmlspark_tpu.core.pipeline import Model, registered_stages
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.testing.fuzzing import (FUZZING_REGISTRY, TestObject,
+                                          experiment_fuzz, register_fuzzing,
+                                          serialization_fuzz)
+
+from mmlspark_tpu.ops import (ImageSetAugmenter, ImageTransformer,
+                              TextFeaturizer, UnrollImage)
+from mmlspark_tpu.models import (DecisionTreeClassifier, DecisionTreeRegressor,
+                                 GBTClassifier, GBTRegressor,
+                                 LightGBMClassifier, LightGBMRegressor,
+                                 LinearRegression, LogisticRegression,
+                                 MultilayerPerceptronClassifier, NaiveBayes,
+                                 RandomForestClassifier, RandomForestRegressor,
+                                 TpuLearner, TpuModel, build_model)
+from mmlspark_tpu.automl import (ComputeModelStatistics,
+                                 ComputePerInstanceStatistics, Featurize,
+                                 FindBestModel, IndexToValue,
+                                 TrainClassifier, TrainRegressor,
+                                 TuneHyperparameters, ValueIndexer)
+from mmlspark_tpu.stages import (Cacher, CheckpointData, ClassBalancer,
+                                 CleanMissingData, DataConversion,
+                                 DropColumns, EnsembleByKey, FlattenBatch,
+                                 MiniBatchTransformer, MultiColumnAdapter,
+                                 PartitionSample, RenameColumn, Repartition,
+                                 SelectColumns, SummarizeData,
+                                 TextPreprocessor, Timer, UDFTransformer)
+
+# ---------------------------------------------------------------- fixtures
+
+_rng = np.random.default_rng(0)
+_N = 48
+
+
+def _tab_df():
+    y = _rng.integers(0, 2, _N)
+    feats = np.empty(_N, dtype=object)
+    xm = _rng.normal(size=(_N, 4)) + y[:, None]
+    for i in range(_N):
+        feats[i] = xm[i].astype(np.float32)
+    return DataFrame({
+        "a": _rng.normal(size=_N),
+        "b": _rng.normal(size=_N) + y,
+        "cat": np.array(["u", "v"], dtype=object)[_rng.integers(0, 2, _N)],
+        "text": np.array([f"w{i} common tok{i%3}" for i in range(_N)],
+                         dtype=object),
+        "features": feats,
+        "label": y.astype(np.int64),
+        "rlabel": (xm[:, 0] * 2 + _rng.normal(size=_N) * 0.1),
+    })
+
+
+def _img_df(n=3):
+    rows = np.empty(n, dtype=object)
+    for i in range(n):
+        rows[i] = make_image_row(
+            f"i{i}", 8, 8, 3, _rng.integers(0, 255, (8, 8, 3), dtype=np.uint8))
+    return DataFrame({"image": rows, "label": np.arange(n, dtype=np.int64)})
+
+
+TAB = _tab_df()
+IMG = _img_df()
+
+
+def _double(v):  # module-level so the UDF pickles by reference
+    return float(v) * 2
+
+
+# ------------------------------------------------------- TestObject factories
+
+def _t(cls, factory):
+    register_fuzzing(cls)(factory)
+
+
+_t(Pipeline, lambda: TestObject(
+    Pipeline().setStages((CleanMissingData().setInputCols(("a",)),
+                          RenameColumn().setInputCol("b").setOutputCol("b2"))),
+    TAB))
+_t(ImageTransformer, lambda: TestObject(
+    ImageTransformer().setInputCol("image").setOutputCol("o").resize(4, 4), IMG))
+_t(UnrollImage, lambda: TestObject(
+    UnrollImage().setInputCol("image").setOutputCol("o"), IMG))
+_t(ImageSetAugmenter, lambda: TestObject(
+    ImageSetAugmenter().setInputCol("image").setOutputCol("image"), IMG))
+_t(TextFeaturizer, lambda: TestObject(
+    TextFeaturizer().setInputCol("text").setNumFeatures(32), TAB))
+
+
+def _tpu_model():
+    cfg = {"type": "mlp", "hidden": [4], "num_classes": 2}
+    m = build_model(cfg)
+    import jax
+    import jax.numpy as jnp
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    return TestObject(TpuModel().setModelConfig(cfg).setModelParams(p)
+                      .setInputCol("features"), TAB)
+
+
+_t(TpuModel, _tpu_model)
+_t(TpuLearner, lambda: TestObject(
+    TpuLearner().setModelConfig({"type": "mlp", "hidden": [4],
+                                 "num_classes": 2})
+    .setEpochs(1).setBatchSize(16), TAB))
+_t(LightGBMClassifier, lambda: TestObject(
+    LightGBMClassifier().setNumIterations(3).setMaxBin(15), TAB))
+_t(LightGBMRegressor, lambda: TestObject(
+    LightGBMRegressor().setLabelCol("rlabel").setNumIterations(3)
+    .setMaxBin(15), TAB))
+_t(LogisticRegression, lambda: TestObject(
+    LogisticRegression().setMaxIter(10), TAB))
+_t(LinearRegression, lambda: TestObject(
+    LinearRegression().setLabelCol("rlabel").setMaxIter(10), TAB))
+_t(NaiveBayes, lambda: TestObject(NaiveBayes(), TAB))
+_t(DecisionTreeClassifier, lambda: TestObject(
+    DecisionTreeClassifier().setMaxBin(15), TAB))
+_t(DecisionTreeRegressor, lambda: TestObject(
+    DecisionTreeRegressor().setLabelCol("rlabel").setMaxBin(15), TAB))
+_t(RandomForestClassifier, lambda: TestObject(
+    RandomForestClassifier().setNumIterations(3).setMaxBin(15), TAB))
+_t(RandomForestRegressor, lambda: TestObject(
+    RandomForestRegressor().setLabelCol("rlabel").setNumIterations(3)
+    .setMaxBin(15), TAB))
+_t(GBTClassifier, lambda: TestObject(
+    GBTClassifier().setNumIterations(3).setMaxBin(15), TAB))
+_t(GBTRegressor, lambda: TestObject(
+    GBTRegressor().setLabelCol("rlabel").setNumIterations(3).setMaxBin(15),
+    TAB))
+_t(MultilayerPerceptronClassifier, lambda: TestObject(
+    MultilayerPerceptronClassifier().setMaxIter(2).setLayers((4,)), TAB))
+_t(ValueIndexer, lambda: TestObject(
+    ValueIndexer().setInputCol("cat").setOutputCol("ci"), TAB))
+
+
+def _index_to_value():
+    from mmlspark_tpu.core.schema import CategoricalUtilities
+    df = TAB.withColumn("ci", TAB.col("label").astype(np.float64))
+    df = CategoricalUtilities.setLevels(df, "ci", ["n", "y"])
+    return TestObject(IndexToValue().setInputCol("ci").setOutputCol("cv"), df)
+
+
+_t(IndexToValue, _index_to_value)
+_t(Featurize, lambda: TestObject(
+    Featurize().setOutputCol("f")
+    .setInputCols(("a", "b", "cat")).setNumberOfFeatures(16), TAB))
+_t(TrainClassifier, lambda: TestObject(
+    TrainClassifier().setLabelCol("label")
+    .setModel(LogisticRegression().setMaxIter(5)),
+    TAB.select("a", "b", "cat", "label")))
+_t(TrainRegressor, lambda: TestObject(
+    TrainRegressor().setLabelCol("rlabel")
+    .setModel(LinearRegression().setMaxIter(5)),
+    TAB.select("a", "b", "rlabel")))
+
+
+def _stats_df():
+    return DataFrame({"label": TAB.col("label").astype(np.float64),
+                      "prediction": TAB.col("label").astype(np.float64)})
+
+
+_t(ComputeModelStatistics, lambda: TestObject(
+    ComputeModelStatistics().setLabelCol("label")
+    .setScoredLabelsCol("prediction").setEvaluationMetric("classification"),
+    _stats_df()))
+_t(ComputePerInstanceStatistics, lambda: TestObject(
+    ComputePerInstanceStatistics().setLabelCol("label")
+    .setScoresCol("prediction"), _stats_df()))
+_t(TuneHyperparameters, lambda: TestObject(
+    TuneHyperparameters().setModels((NaiveBayes(),))
+    .setEvaluationMetric("accuracy").setNumFolds(2).setNumRuns(1)
+    .setParallelism(1), TAB.select("features", "label")))
+
+
+def _find_best():
+    df = TAB.select("features", "label")
+    m1 = NaiveBayes().fit(df)
+    return TestObject(FindBestModel().setModels((m1,))
+                      .setEvaluationMetric("accuracy"), df)
+
+
+_t(FindBestModel, _find_best)
+_t(Cacher, lambda: TestObject(Cacher(), TAB))
+_t(CheckpointData, lambda: TestObject(CheckpointData(), TAB))
+_t(DropColumns, lambda: TestObject(DropColumns().setCols(("a",)), TAB))
+_t(SelectColumns, lambda: TestObject(SelectColumns().setCols(("a", "b")), TAB))
+_t(RenameColumn, lambda: TestObject(
+    RenameColumn().setInputCol("a").setOutputCol("a2"), TAB))
+_t(Repartition, lambda: TestObject(Repartition().setN(3), TAB))
+_t(UDFTransformer, lambda: TestObject(
+    UDFTransformer().setInputCol("a").setOutputCol("a2").setUdf(_double), TAB))
+_t(ClassBalancer, lambda: TestObject(
+    ClassBalancer().setInputCol("label").setOutputCol("w"), TAB))
+_t(MultiColumnAdapter, lambda: TestObject(
+    MultiColumnAdapter().setBaseStage(
+        RenameColumn()).setInputCols(("a",)).setOutputCols(("a9",)), TAB))
+_t(Timer, lambda: TestObject(
+    Timer().setStage(DropColumns().setCols(("a",))).setLogToConsole(False),
+    TAB))
+_t(CleanMissingData, lambda: TestObject(
+    CleanMissingData().setInputCols(("a",)).setCleaningMode("Median"), TAB))
+_t(DataConversion, lambda: TestObject(
+    DataConversion().setCols(("label",)).setConvertTo("double"), TAB))
+_t(PartitionSample, lambda: TestObject(
+    PartitionSample().setMode("RandomSample").setPercent(0.5), TAB))
+_t(SummarizeData, lambda: TestObject(SummarizeData(), TAB.select("a", "b")))
+_t(EnsembleByKey, lambda: TestObject(
+    EnsembleByKey().setKeys(("cat",)).setCols(("a",)), TAB))
+_t(TextPreprocessor, lambda: TestObject(
+    TextPreprocessor().setInputCol("text").setOutputCol("t2")
+    .setMap({"common": "rare"}), TAB))
+_t(MiniBatchTransformer, lambda: TestObject(
+    MiniBatchTransformer().setBatchSize(8), TAB.select("a", "label")))
+
+
+def _flatten():
+    batched = MiniBatchTransformer().setBatchSize(8).transform(
+        TAB.select("a", "label"))
+    return TestObject(FlattenBatch(), batched)
+
+
+_t(FlattenBatch, _flatten)
+
+# ------------------------------------------------------------ coverage gate
+
+EXEMPT = {
+    # serving/io stages get their own live-socket suites (like the reference's
+    # DistributedHTTPSuite) — added as they land
+}
+
+
+def _framework_stages():
+    out = {}
+    for qual, cls in registered_stages().items():
+        if not qual.startswith("mmlspark_tpu."):
+            continue
+        if issubclass(cls, Model):
+            continue  # fitted models are exercised via their estimators
+        out[qual] = cls
+    return out
+
+
+def test_every_stage_has_a_fuzzer():
+    missing = [q for q in _framework_stages()
+               if q not in FUZZING_REGISTRY
+               and q.rsplit(".", 1)[-1] not in EXEMPT]
+    assert not missing, f"stages without fuzzing TestObjects: {missing}"
+
+
+FUZZ_KEYS = sorted(k for k in FUZZING_REGISTRY)
+
+
+@pytest.mark.parametrize("key", FUZZ_KEYS)
+def test_experiment_fuzzing(key):
+    experiment_fuzz(FUZZING_REGISTRY[key]())
+
+
+@pytest.mark.parametrize("key", FUZZ_KEYS)
+def test_serialization_fuzzing(key):
+    serialization_fuzz(FUZZING_REGISTRY[key]())

@@ -1,0 +1,128 @@
+"""Behavioral tests for pipeline stages (fuzzing covers the contract; these
+pin semantics)."""
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.stages import (ClassBalancer, CleanMissingData,
+                                 DataConversion, EnsembleByKey, FlattenBatch,
+                                 MiniBatchTransformer, MultiColumnAdapter,
+                                 PartitionSample, RenameColumn, SummarizeData,
+                                 TextPreprocessor, Timer, UDFTransformer)
+
+
+def test_class_balancer_weights():
+    df = DataFrame({"y": [0, 0, 0, 1]})
+    out = (ClassBalancer().setInputCol("y").setOutputCol("w")
+           .fit(df).transform(df))
+    np.testing.assert_allclose(out.col("w"), [1.0, 1.0, 1.0, 3.0])
+
+
+def test_clean_missing_median():
+    df = DataFrame({"a": [1.0, np.nan, 3.0, 100.0]})
+    out = (CleanMissingData().setInputCols(("a",)).setCleaningMode("Median")
+           .fit(df).transform(df))
+    assert out.col("a")[1] == 3.0
+
+
+def test_data_conversion_casts():
+    df = DataFrame({"a": [1.7, 2.2]})
+    out = DataConversion().setCols(("a",)).setConvertTo("integer").transform(df)
+    assert out.col("a").dtype == np.int32
+    out2 = DataConversion().setCols(("a",)).setConvertTo("string").transform(df)
+    assert out2.col("a")[0] == "1.7"
+
+
+def test_data_conversion_date():
+    df = DataFrame({"d": np.array(["2026-07-29 10:00:00"], dtype=object)})
+    out = DataConversion().setCols(("d",)).setConvertTo("date").transform(df)
+    assert out.col("d")[0].year == 2026
+
+
+def test_ensemble_by_key_mean_and_collect():
+    df = DataFrame({"k": np.array(["a", "a", "b"], dtype=object),
+                    "v": [1.0, 3.0, 5.0]})
+    out = EnsembleByKey().setKeys(("k",)).setCols(("v",)).transform(df)
+    got = {r["k"]: r["v"] for r in out.collect()}
+    assert got == {"a": 2.0, "b": 5.0}
+    out2 = (EnsembleByKey().setKeys(("k",)).setCols(("v",))
+            .setStrategy("collect").transform(df))
+    got2 = {r["k"]: r["v"] for r in out2.collect()}
+    assert got2["a"] == [1.0, 3.0]
+
+
+def test_ensemble_by_key_vectors_broadcast():
+    vs = np.empty(4, dtype=object)
+    for i in range(4):
+        vs[i] = np.full(2, float(i))
+    df = DataFrame({"k": [0, 0, 1, 1], "v": vs})
+    out = (EnsembleByKey().setKeys(("k",)).setCols(("v",))
+           .setCollapseGroup(False).transform(df))
+    assert out.count() == 4
+    np.testing.assert_allclose(out.col("v")[0], [0.5, 0.5])
+
+
+def test_text_preprocessor_longest_match():
+    df = DataFrame({"t": np.array(["abcd"], dtype=object)})
+    out = (TextPreprocessor().setInputCol("t").setOutputCol("o")
+           .setMap({"ab": "1", "abc": "2"}).transform(df))
+    assert out.col("o")[0] == "2d"  # longest key wins
+
+
+def test_minibatch_roundtrip():
+    df = DataFrame({"a": np.arange(10.0), "b": np.arange(10)})
+    batched = MiniBatchTransformer().setBatchSize(4).transform(df)
+    assert batched.count() == 3
+    assert len(batched.col("a")[0]) == 4 and len(batched.col("a")[2]) == 2
+    flat = FlattenBatch().transform(batched)
+    np.testing.assert_allclose(np.asarray(flat.col("a"), dtype=np.float64),
+                               df.col("a"))
+
+
+def test_partition_sample_modes():
+    df = DataFrame({"a": np.arange(100.0)})
+    assert PartitionSample().setMode("Head").setCount(7).transform(df).count() == 7
+    s = PartitionSample().setMode("RandomSample").setPercent(0.3) \
+        .setSeed(1).transform(df)
+    assert 10 < s.count() < 50
+    p = (PartitionSample().setMode("AssignToPartition").setNumParts(4)
+         .transform(df))
+    assert set(np.unique(p.col("Partition"))) <= {0, 1, 2, 3}
+
+
+def test_summarize_data_values():
+    df = DataFrame({"x": [1.0, 2.0, 3.0, np.nan]})
+    out = SummarizeData().transform(df)
+    row = out.first()
+    assert row["Count"] == 4 and row["Missing Value Count"] == 1
+    assert row["Mean"] == 2.0 and row["Median"] == 2.0
+
+
+def test_multi_column_adapter():
+    df = DataFrame({"a": [1.0], "b": [2.0]})
+    out = (MultiColumnAdapter().setBaseStage(RenameColumn())
+           .setInputCols(("a", "b")).setOutputCols(("x", "y")).transform(df))
+    assert set(out.columns) == {"x", "y"}
+
+
+def test_udf_vectorized():
+    df = DataFrame({"a": np.arange(4.0)})
+    out = (UDFTransformer().setInputCol("a").setOutputCol("o")
+           .setVectorized(True).setUdf(lambda col: col * 10).transform(df))
+    np.testing.assert_allclose(out.col("o"), df.col("a") * 10)
+
+
+def test_timer_records_seconds():
+    from mmlspark_tpu.stages import DropColumns
+    df = DataFrame({"a": [1.0], "b": [2.0]})
+    t = Timer().setStage(DropColumns().setCols(("a",))).setLogToConsole(False)
+    out = t.transform(df)
+    assert out.columns == ["b"]
+    assert t._last_seconds >= 0
+
+
+def test_drop_missing_column_raises():
+    from mmlspark_tpu.stages import DropColumns
+    with pytest.raises(ValueError):
+        DropColumns().setCols(("zzz",)).transform(DataFrame({"a": [1.0]}))
