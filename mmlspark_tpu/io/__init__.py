@@ -1,0 +1,13 @@
+"""IO layer (reference: src/io). `readImages`/`readBinaryFiles` mirror the
+reference's session implicits (io/src/main/scala/Readers.scala:14-45)."""
+
+from . import binary, http, image, powerbi
+from .binary import read_binary_files, recurse_path
+from .image import decode_image, read_images, write_images
+
+readImages = read_images
+readBinaryFiles = read_binary_files
+
+__all__ = ["binary", "http", "image", "powerbi", "read_binary_files",
+           "read_images", "write_images", "decode_image", "recurse_path",
+           "readImages", "readBinaryFiles"]

@@ -1,0 +1,6 @@
+from .server import HTTPSink, HTTPSource, ServingLoop, serve_pipeline
+from .transformer import (CustomInputParser, CustomOutputParser,
+                          HTTPTransformer, JSONInputParser, JSONOutputParser,
+                          SimpleHTTPTransformer, StringOutputParser)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,199 @@
+"""HTTP serving source/sink (reference: io/http — HTTPSource.scala:43,147,
+DistributedHTTPSource.scala:100-260 JVMSharedServer with port probing and the
+MultiChannelMap of in-flight exchanges, DistributedHTTPSink:418).
+
+The reference turns every Spark executor into a web server whose requests
+become streaming rows and whose replies are sent by the sink calling
+``server.respond(batch, uuid, code, body)``. Here one process hosts the
+server; the same three-piece contract is kept:
+
+  * ``HTTPSource``   — threaded HTTP server; pending requests become rows
+                       ``(id, value)`` via ``getBatch`` (continuous batching:
+                       a batch is whatever arrived since the last drain, up
+                       to max_rows — exactly what a pjit inference step
+                       wants);
+  * ``HTTPSink``     — ``addBatch(df)`` completes the stored exchanges by id;
+  * ``serve_pipeline`` — source -> transformer -> sink loop on a thread.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...core.dataframe import DataFrame
+from ...core.utils import get_logger, object_column
+
+log = get_logger("io.http")
+
+
+class _Exchange:
+    """One in-flight request awaiting a reply (the HttpExchange analog)."""
+
+    __slots__ = ("id", "value", "event", "code", "body")
+
+    def __init__(self, value: str):
+        self.id = uuid.uuid4().hex
+        self.value = value
+        self.event = threading.Event()
+        self.code = 500
+        self.body = b""
+
+
+class HTTPSource:
+    """Threaded HTTP server collecting requests for batch processing."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 api_path: str = "/", name: str = "source",
+                 max_port_probes: int = 20):
+        self._pending: "queue.Queue[_Exchange]" = queue.Queue()
+        self._inflight: dict[str, _Exchange] = {}
+        self._lock = threading.Lock()
+        source = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                if api_path not in ("/", self.path):
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length).decode("utf-8")
+                ex = _Exchange(body)
+                with source._lock:
+                    source._inflight[ex.id] = ex
+                source._pending.put(ex)
+                if not ex.event.wait(timeout=source.reply_timeout):
+                    self.send_error(504, "batch processing timed out")
+                    with source._lock:
+                        source._inflight.pop(ex.id, None)
+                    return
+                self.send_response(ex.code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(ex.body)))
+                self.end_headers()
+                self.wfile.write(ex.body)
+
+            def log_message(self, *a):
+                pass
+
+        # port probing (reference DistributedHTTPSource.scala:237-250)
+        last_err = None
+        for probe in range(max_port_probes):
+            try:
+                self.server = ThreadingHTTPServer(
+                    (host, port + probe if port else 0), Handler)
+                break
+            except OSError as e:
+                last_err = e
+        else:
+            raise OSError(f"no free port after {max_port_probes} probes: "
+                          f"{last_err}")
+        self.host, self.port = self.server.server_address[:2]
+        self.reply_timeout = 30.0
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name=f"http-{name}")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def getBatch(self, max_rows: int = 1024,
+                 timeout: float = 0.05) -> DataFrame:
+        """Drain up to max_rows pending requests into an (id, value) frame."""
+        rows = []
+        try:
+            rows.append(self._pending.get(timeout=timeout))
+            while len(rows) < max_rows:
+                rows.append(self._pending.get_nowait())
+        except queue.Empty:
+            pass
+        if not rows:
+            return DataFrame({"id": np.array([], dtype=object),
+                              "value": np.array([], dtype=object)})
+        return DataFrame({"id": object_column([r.id for r in rows]),
+                          "value": object_column([r.value for r in rows])})
+
+    def respond(self, ex_id: str, code: int, body: bytes | str):
+        with self._lock:
+            ex = self._inflight.pop(ex_id, None)
+        if ex is None:
+            log.warning("respond: unknown or timed-out exchange %s", ex_id)
+            return
+        ex.code = code
+        ex.body = body.encode("utf-8") if isinstance(body, str) else body
+        ex.event.set()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class HTTPSink:
+    """Completes exchanges from a replies dataframe (reference
+    DistributedHTTPSink.addBatch at :418-450)."""
+
+    def __init__(self, source: HTTPSource, id_col: str = "id",
+                 reply_col: str = "reply", code_col: Optional[str] = None):
+        self.source = source
+        self.id_col = id_col
+        self.reply_col = reply_col
+        self.code_col = code_col
+
+    def addBatch(self, df: DataFrame):
+        codes = df.col(self.code_col) if self.code_col else None
+        ids = df.col(self.id_col)
+        replies = df.col(self.reply_col)
+        for i in range(df.count()):
+            code = int(codes[i]) if codes is not None else 200
+            self.source.respond(str(ids[i]), code, str(replies[i]))
+
+
+class ServingLoop:
+    """source -> pipeline -> sink continuous-batching loop. The transformer
+    sees a DataFrame with columns (id, value); it must produce `reply`."""
+
+    def __init__(self, source: HTTPSource, transformer,
+                 max_batch: int = 1024):
+        self.source = source
+        self.sink = HTTPSink(source)
+        self.transformer = transformer
+        self.max_batch = max_batch
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            batch = self.source.getBatch(self.max_batch)
+            if batch.count() == 0:
+                continue
+            try:
+                out = self.transformer.transform(batch)
+                self.sink.addBatch(out)
+            except Exception as e:  # reply 500s rather than hanging clients
+                log.warning("serving batch failed: %s", e)
+                for ex_id in batch.col("id"):
+                    self.source.respond(str(ex_id), 500,
+                                        json.dumps({"error": str(e)}))
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def serve_pipeline(transformer, host: str = "127.0.0.1", port: int = 0,
+                   max_batch: int = 1024) -> tuple[HTTPSource, ServingLoop]:
+    """Convenience: spin up source + loop for a fitted transformer."""
+    source = HTTPSource(host=host, port=port)
+    loop = ServingLoop(source, transformer, max_batch).start()
+    return source, loop

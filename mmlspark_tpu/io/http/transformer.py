@@ -1,0 +1,132 @@
+"""HTTP client stages (reference: io/http — HTTPTransformer.scala:20-70 with
+its concurrency param, SimpleHTTPTransformer.scala:15, Parsers.scala:28-155
+JSONInputParser/JSONOutputParser/StringOutputParser/Custom*)."""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import requests
+
+from ...core.dataframe import DataFrame
+from ...core.params import (ComplexParam, HasInputCol, HasOutputCol, IntParam,
+                            FloatParam, StringParam)
+from ...core.pipeline import Transformer
+from ...core.utils import object_column
+
+
+# ------------------------------------------------------------------ parsers
+
+class JSONInputParser(Transformer, HasInputCol, HasOutputCol):
+    """Column value -> request dict with a JSON body (reference
+    Parsers.scala JSONInputParser)."""
+    url = StringParam("target url", default="")
+    method = StringParam("HTTP method", default="POST")
+    headers = ComplexParam("extra headers", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        col = df.col(self.getInputCol())
+        out = []
+        for v in col:
+            body = v if isinstance(v, (dict, list)) else \
+                json.loads(v) if isinstance(v, str) else \
+                np.asarray(v).tolist()
+            # json content type is always present; user headers merge on top
+            # (reference Parsers.scala:52-53 appends it unconditionally)
+            headers = {"Content-Type": "application/json"}
+            headers.update(self.getHeaders() or {})
+            out.append({"url": self.getUrl(), "method": self.getMethod(),
+                        "headers": headers, "body": json.dumps(body)})
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+class CustomInputParser(Transformer, HasInputCol, HasOutputCol):
+    udf = ComplexParam("value -> request dict", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn = self.getUdf()
+        out = [fn(v) for v in df.col(self.getInputCol())]
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+class JSONOutputParser(Transformer, HasInputCol, HasOutputCol):
+    """Response dict -> parsed JSON body (reference JSONOutputParser)."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        out = []
+        for r in df.col(self.getInputCol()):
+            body = r.get("body") if isinstance(r, dict) else r
+            if not body:
+                out.append(None)
+                continue
+            try:
+                out.append(json.loads(body))
+            except (json.JSONDecodeError, TypeError):
+                # one bad response (e.g. an HTML 504 page) must not lose the
+                # whole batch
+                out.append(None)
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+class StringOutputParser(Transformer, HasInputCol, HasOutputCol):
+    def transform(self, df: DataFrame) -> DataFrame:
+        out = [r.get("body") if isinstance(r, dict) else str(r)
+               for r in df.col(self.getInputCol())]
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+class CustomOutputParser(Transformer, HasInputCol, HasOutputCol):
+    udf = ComplexParam("response dict -> value", default=None)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        fn = self.getUdf()
+        out = [fn(r) for r in df.col(self.getInputCol())]
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+# ------------------------------------------------------------------ clients
+
+class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    """Execute request dicts concurrently (reference HTTPTransformer.scala:20
+    — async client with `concurrency`; Clients.scala:186-189)."""
+    concurrency = IntParam("parallel in-flight requests", default=8, min=1)
+    timeout = FloatParam("per-request timeout seconds", default=30.0)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        reqs = df.col(self.getInputCol())
+
+        def run(r: dict) -> dict:
+            try:
+                resp = requests.request(
+                    r.get("method", "POST"), r["url"],
+                    data=r.get("body"), headers=r.get("headers"),
+                    timeout=self.getTimeout())
+                return {"statusCode": resp.status_code, "body": resp.text,
+                        "headers": dict(resp.headers)}
+            except requests.RequestException as e:
+                return {"statusCode": 0, "body": None, "error": str(e)}
+
+        with ThreadPoolExecutor(self.getConcurrency()) as pool:
+            out = list(pool.map(run, reqs))
+        return df.withColumn(self.getOutputCol(), object_column(out))
+
+
+class SimpleHTTPTransformer(Transformer, HasInputCol, HasOutputCol):
+    """JSONInputParser -> HTTPTransformer -> JSONOutputParser in one stage
+    (reference SimpleHTTPTransformer.scala:15)."""
+    url = StringParam("target url", default="")
+    concurrency = IntParam("parallel in-flight requests", default=8, min=1)
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from ...core.schema import findUnusedColumnName
+        tmp_req = findUnusedColumnName("__req", df)
+        tmp_resp = findUnusedColumnName("__resp", df)
+        out = (JSONInputParser().setInputCol(self.getInputCol())
+               .setOutputCol(tmp_req).setUrl(self.getUrl()).transform(df))
+        out = (HTTPTransformer().setInputCol(tmp_req).setOutputCol(tmp_resp)
+               .setConcurrency(self.getConcurrency()).transform(out))
+        out = (JSONOutputParser().setInputCol(tmp_resp)
+               .setOutputCol(self.getOutputCol()).transform(out))
+        return out.drop(tmp_req, tmp_resp)

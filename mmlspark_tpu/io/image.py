@@ -1,0 +1,80 @@
+"""Image ingest (reference: io/image — Image.scala:58-125 decodes via OpenCV
+Imgcodecs.imdecode into ImageSchema rows; ImageFileFormat.scala:27-95 adds
+subsampling; ImageWriter).
+
+read_images decodes to the reference's layout: HWC uint8, BGR channel order
+(OpenCV default), one ImageSchema struct per row. Undecodable files follow
+the reference's contract: dropped when drop_invalid, else a null row."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import cv2
+import numpy as np
+
+from ..core.dataframe import DataFrame
+from ..core.schema import make_image_row, tag_image_column
+from ..core.utils import object_column
+from .binary import read_binary_files
+
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".tif", ".tiff",
+                    ".webp")
+
+
+def decode_image(path: str, data: bytes) -> Optional[dict]:
+    """bytes -> ImageSchema row (BGR HWC uint8), None if undecodable."""
+    buf = np.frombuffer(data, dtype=np.uint8)
+    img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+    if img is None:
+        return None
+    h, w, c = img.shape
+    return make_image_row(path, h, w, c, img)
+
+
+def read_images(path: str, recursive: bool = True, sample_ratio: float = 1.0,
+                seed: int = 0, drop_invalid: bool = True,
+                inspect_zip: bool = True, npartitions: int = 1,
+                image_col: str = "image") -> DataFrame:
+    """Directory (or zip) of images -> DataFrame with one ImageSchema column."""
+    binary = read_binary_files(path, recursive=recursive,
+                               sample_ratio=sample_ratio, seed=seed,
+                               inspect_zip=inspect_zip)
+    rows, paths = [], []
+    for r in binary.iterRows():
+        p = str(r["path"])
+        if not p.lower().endswith(IMAGE_EXTENSIONS):
+            continue
+        decoded = decode_image(p, r["bytes"])
+        if decoded is None and drop_invalid:
+            continue
+        rows.append(decoded)
+        paths.append(p)
+    df = DataFrame({image_col: object_column(rows),
+                    "path": object_column(paths)}, npartitions=npartitions)
+    return tag_image_column(df, image_col)
+
+
+def write_images(df: DataFrame, out_dir: str, image_col: str = "image",
+                 format: str = "png") -> list[str]:
+    """ImageSchema rows -> encoded files (reference ImageWriter)."""
+    from ..core.schema import image_to_array
+    os.makedirs(out_dir, exist_ok=True)
+    written, used = [], set()
+    for i, row in enumerate(df.col(image_col)):
+        if row is None:
+            continue
+        arr = image_to_array(row)
+        name = os.path.splitext(os.path.basename(str(row["path"])) or
+                                f"img{i}")[0]
+        # basenames can collide across source directories — never clobber
+        candidate, k = name, 0
+        while candidate in used:
+            k += 1
+            candidate = f"{name}_{k}"
+        used.add(candidate)
+        out = os.path.join(out_dir, f"{candidate}.{format}")
+        cv2.imwrite(out, arr)
+        written.append(out)
+    return written

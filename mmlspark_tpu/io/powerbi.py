@@ -1,0 +1,46 @@
+"""PowerBI writer (reference: io/powerbi/.../PowerBIWriter.scala:21-45 —
+JSON POST of row batches per partition to a push-dataset url)."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import requests
+
+from ..core.dataframe import DataFrame
+from ..core.utils import get_logger
+
+log = get_logger("io.powerbi")
+
+
+def _jsonable_rows(df: DataFrame) -> list[dict]:
+    rows = []
+    for r in df.iterRows():
+        out = {}
+        for k, v in r.items():
+            if isinstance(v, (np.generic,)):
+                v = v.item()
+            elif isinstance(v, np.ndarray):
+                v = v.tolist()
+            out[k] = v
+        rows.append(out)
+    return rows
+
+
+def write(df: DataFrame, url: str, batch_size: int = 1000,
+          timeout: float = 30.0) -> int:
+    """POST rows as JSON arrays in batches per partition; returns the number
+    of batches sent. Raises on non-2xx like the reference's writer."""
+    sent = 0
+    for part in df.partitions():
+        for batch in part.iterBatches(batch_size):
+            payload = json.dumps({"rows": _jsonable_rows(batch)})
+            resp = requests.post(
+                url, data=payload,
+                headers={"Content-Type": "application/json"}, timeout=timeout)
+            if not (200 <= resp.status_code < 300):
+                raise IOError(f"PowerBI POST failed: {resp.status_code} "
+                              f"{resp.text[:200]}")
+            sent += 1
+    return sent
