@@ -109,9 +109,14 @@ class HTTPSource:
         """Drain up to max_rows pending requests into an (id, value) frame."""
         rows = []
         try:
-            rows.append(self._pending.get(timeout=timeout))
             while len(rows) < max_rows:
-                rows.append(self._pending.get_nowait())
+                ex = self._pending.get(timeout=timeout if not rows else 0)
+                # a client whose wait timed out was dropped from _inflight;
+                # its exchange is dead — don't hand it to the pipeline
+                with self._lock:
+                    alive = ex.id in self._inflight
+                if alive:
+                    rows.append(ex)
         except queue.Empty:
             pass
         if not rows:

@@ -105,7 +105,9 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                     timeout=self.getTimeout())
                 return {"statusCode": resp.status_code, "body": resp.text,
                         "headers": dict(resp.headers)}
-            except requests.RequestException as e:
+            except Exception as e:  # malformed request dicts (e.g. no
+                # 'url') must fail their row, not the whole batch — same
+                # per-row contract as a network error
                 return {"statusCode": 0, "body": None, "error": str(e)}
 
         with ThreadPoolExecutor(self.getConcurrency()) as pool:

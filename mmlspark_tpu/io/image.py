@@ -61,7 +61,9 @@ def write_images(df: DataFrame, out_dir: str, image_col: str = "image",
     """ImageSchema rows -> encoded files (reference ImageWriter)."""
     from ..core.schema import image_to_array
     os.makedirs(out_dir, exist_ok=True)
-    written, used = [], set()
+    # seed with files already on disk so repeated writes never clobber either
+    used = {os.path.splitext(f)[0] for f in os.listdir(out_dir)}
+    written = []
     for i, row in enumerate(df.col(image_col)):
         if row is None:
             continue

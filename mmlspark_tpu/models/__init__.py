@@ -10,9 +10,16 @@ from .classical import (DecisionTreeClassifier, DecisionTreeRegressor,
                         RandomForestRegressor)
 from .tpu_model import TpuModel
 from .trainer import TpuLearner
+from .downloader import (LocalRepo, ModelDownloader, ModelNotFoundException,
+                         ModelSchema, RemoteRepo, canonical_model_filename,
+                         pack_model, unpack_model)
+from .image_featurizer import ImageFeaturizer
 
 __all__ = ["modules", "gbdt", "build_model", "example_input", "MLPNet",
            "ConvNet", "ResNet", "BiLSTMTagger", "TpuModel", "TpuLearner",
+           "ModelDownloader", "ModelSchema", "LocalRepo", "RemoteRepo",
+           "ModelNotFoundException", "canonical_model_filename",
+           "pack_model", "unpack_model", "ImageFeaturizer",
            "LightGBMClassifier", "LightGBMClassificationModel",
            "LightGBMRegressor", "LightGBMRegressionModel",
            "LogisticRegression", "LinearRegression", "NaiveBayes",

@@ -1,0 +1,236 @@
+"""Model repository + downloader: the reference's `downloader` module rebuilt.
+
+Reference surface (src/downloader/src/main/scala/):
+  * ``ModelSchema`` — name/dataset/modelType/uri/hash/size/inputNode/numLayers/
+    layerNames (Schema.scala:54-72), sha256 verification (Schema.scala:34-40);
+  * ``Repository`` — listSchemas/getBytes/addBytes over HDFS or an HTTP CDN
+    with a MANIFEST index (ModelDownloader.scala:23-155);
+  * ``ModelDownloader`` — remote→local transfer feeding
+    ``ImageFeaturizer.setModel`` (ModelDownloader.scala:194+).
+
+TPU-native redesign: a model artifact is a single ``<name>_<dataset>.model``
+zip holding ``config.json`` (declarative model config, models.build_model)
+and ``params.msgpack`` (flax pytree) — no CNTK protobufs. The layerNames in
+the schema come straight from the module's ``layer_names()``, which is what
+``ImageFeaturizer`` truncates on (the reference stores them in the schema for
+the same reason, Schema.scala:70).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import urllib.request
+import zipfile
+from dataclasses import dataclass, field, asdict, replace
+from typing import Iterable, Optional
+
+MANIFEST = "MANIFEST"
+
+
+def canonical_model_filename(name: str, dataset: str) -> str:
+    """NamingConventions.canonicalModelFilename (Schema.scala:16-21)."""
+    return f"{name}_{dataset}.model"
+
+
+@dataclass
+class ModelSchema:
+    """Schema of a repository model (reference: Schema.scala:54-72)."""
+    name: str
+    dataset: str = ""
+    modelType: str = "image"
+    uri: str = ""
+    hash: str = ""
+    size: int = 0
+    inputNode: int = 0
+    numLayers: int = 0
+    layerNames: list = field(default_factory=list)
+
+    def toJson(self) -> str:
+        return json.dumps(asdict(self), indent=2)
+
+    @staticmethod
+    def fromJson(s: str) -> "ModelSchema":
+        return ModelSchema(**json.loads(s))
+
+    def updateURI(self, uri: str) -> "ModelSchema":
+        return replace(self, uri=uri)
+
+    def assertMatchingHash(self, data: bytes):
+        """sha256 gate on every transfer (reference: Schema.scala:34-40)."""
+        got = hashlib.sha256(data).hexdigest()
+        if got != self.hash:
+            raise ValueError(
+                f"downloaded hash: {got} does not match given hash: {self.hash}")
+
+
+class ModelNotFoundException(FileNotFoundError):
+    pass
+
+
+# ------------------------------------------------------------- artifacts
+
+def pack_model(config: dict, params) -> bytes:
+    """{config, params pytree} -> one .model zip blob."""
+    import numpy as np
+    import jax
+    from flax import serialization
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("config.json", json.dumps(config))
+        z.writestr("params.msgpack", serialization.msgpack_serialize(
+            jax.tree_util.tree_map(np.asarray, params)))
+    return buf.getvalue()
+
+
+def unpack_model(blob: bytes) -> tuple[dict, object]:
+    from flax import serialization
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        config = json.loads(z.read("config.json"))
+        params = serialization.msgpack_restore(z.read("params.msgpack"))
+    return config, params
+
+
+# ----------------------------------------------------------- repositories
+
+class Repository:
+    """listSchemas/getBytes/addBytes contract (ModelDownloader.scala:23-35)."""
+
+    def listSchemas(self) -> Iterable[ModelSchema]:
+        raise NotImplementedError
+
+    def getBytes(self, schema: ModelSchema) -> bytes:
+        raise NotImplementedError
+
+    def addBytes(self, schema: ModelSchema, data: bytes) -> ModelSchema:
+        raise NotImplementedError
+
+
+class LocalRepo(Repository):
+    """Directory of ``*.model`` blobs + ``*.model.meta`` schema JSONs — the
+    HDFSRepo analog (ModelDownloader.scala:39-106) on a plain filesystem
+    (TPU-VM local disk / NFS; there is no HDFS in the TPU stack)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def listSchemas(self) -> list[ModelSchema]:
+        out = []
+        for fn in sorted(os.listdir(self.root)):
+            if fn.endswith(".meta"):
+                with open(os.path.join(self.root, fn)) as f:
+                    s = ModelSchema.fromJson(f.read())
+                # metas store the relative canonical filename so repos are
+                # portable (rsync/serve the dir as-is); resolve for callers
+                if s.uri and not os.path.isabs(s.uri):
+                    s = s.updateURI(os.path.join(self.root, s.uri))
+                out.append(s)
+        return out
+
+    def getBytes(self, schema: ModelSchema) -> bytes:
+        path = schema.uri if os.path.isabs(schema.uri) else \
+            os.path.join(self.root, os.path.basename(schema.uri))
+        if not os.path.exists(path):
+            raise ModelNotFoundException(path)
+        with open(path, "rb") as f:
+            return f.read()
+
+    def addBytes(self, schema: ModelSchema, data: bytes) -> ModelSchema:
+        fn = canonical_model_filename(schema.name, schema.dataset)
+        path = os.path.join(self.root, fn)
+        with open(path, "wb") as f:
+            f.write(data)
+        with open(path, "rb") as f:  # verify the write, as the reference does
+            schema.assertMatchingHash(f.read())
+        # the .meta carries the relative filename (portable across hosts and
+        # straight-servable over HTTP); the returned schema is absolute
+        with open(path + ".meta", "w") as f:
+            f.write(schema.updateURI(fn).toJson())
+        return schema.updateURI(path)
+
+
+class RemoteRepo(Repository):
+    """HTTP repo with a MANIFEST of schema files — the DefaultModelRepo CDN
+    layout (ModelDownloader.scala:109-155). Read-only."""
+
+    def __init__(self, base_url: str, timeout: float = 60.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _fetch(self, rel: str) -> bytes:
+        if "://" not in rel:
+            # metas carry repo-relative names; tolerate absolute local paths
+            # from hand-written metas by falling back to the basename
+            rel = rel.lstrip("/") if not os.path.isabs(rel) else \
+                os.path.basename(rel)
+            rel = f"{self.base_url}/{rel}"
+        with urllib.request.urlopen(rel, timeout=self.timeout) as r:
+            return r.read()
+
+    def listSchemas(self) -> list[ModelSchema]:
+        names = self._fetch(MANIFEST).decode().split()
+        return [ModelSchema.fromJson(self._fetch(n).decode()) for n in names]
+
+    def getBytes(self, schema: ModelSchema) -> bytes:
+        return self._fetch(schema.uri)
+
+    def addBytes(self, schema, data):
+        raise NotImplementedError("remote repo is read-only "
+                                  "(ModelDownloader.scala:153-154)")
+
+
+# ------------------------------------------------------------- downloader
+
+class ModelDownloader:
+    """Transfer models remote→local with hash verification, then hand them to
+    TpuModel / ImageFeaturizer (reference: ModelDownloader.scala:157-230).
+
+    ``local_path`` is the local repo directory; ``server_url`` the remote
+    repo base URL (the reference's CDN baseURL, DefaultModelRepo:109).
+    """
+
+    def __init__(self, local_path: str, server_url: Optional[str] = None):
+        self.local = LocalRepo(local_path)
+        self.remote = RemoteRepo(server_url) if server_url else None
+
+    def localModels(self) -> list[ModelSchema]:
+        return self.local.listSchemas()
+
+    def remoteModels(self) -> list[ModelSchema]:
+        if self.remote is None:
+            raise ValueError("no server_url configured")
+        return self.remote.listSchemas()
+
+    def downloadModel(self, schema: ModelSchema) -> ModelSchema:
+        """Remote→local transfer; no-op if already present with same hash."""
+        for have in self.local.listSchemas():
+            if (have.name, have.dataset, have.hash) == \
+                    (schema.name, schema.dataset, schema.hash):
+                return have
+        data = (self.remote or self.local).getBytes(schema)
+        schema.assertMatchingHash(data)
+        return self.local.addBytes(schema, data)
+
+    def downloadByName(self, name: str, dataset: str = "") -> ModelSchema:
+        pool = self.remoteModels() if self.remote else self.localModels()
+        for s in pool:
+            if s.name == name and (not dataset or s.dataset == dataset):
+                return self.downloadModel(s)
+        raise ModelNotFoundException(f"{name} (dataset={dataset!r})")
+
+    def publish(self, config: dict, params, name: str, dataset: str = "",
+                modelType: str = "image") -> ModelSchema:
+        """Pack + register a model in the local repo (the addBytes direction,
+        which the reference exposes for HDFS repos). layerNames/numLayers are
+        derived from the module so ImageFeaturizer can truncate by name."""
+        from .modules import build_model
+        data = pack_model(config, params)
+        layer_names = build_model(config).layer_names()
+        schema = ModelSchema(
+            name=name, dataset=dataset, modelType=modelType,
+            hash=hashlib.sha256(data).hexdigest(), size=len(data),
+            inputNode=0, numLayers=len(layer_names), layerNames=layer_names)
+        return self.local.addBytes(schema, data)

@@ -59,16 +59,28 @@ class TpuModel(Transformer):
     miniBatchSize = IntParam("rows per device batch", default=4096, min=1)
 
     def setModelLocation(self, path: str) -> "TpuModel":
-        """Load a saved model directory ({config.json, params.msgpack}) — the
-        CNTKModel.setModelLocation parity point, fed by ModelDownloader."""
+        """Load a saved model — the CNTKModel.setModelLocation parity point,
+        fed by ModelDownloader. Accepts either a directory ({config.json,
+        params.msgpack}) or a packed ``.model`` zip artifact."""
         import json
         import os
+        if os.path.isfile(path):
+            from .downloader import unpack_model
+            with open(path, "rb") as f:
+                config, params = unpack_model(f.read())
+            self.setModelConfig(config)
+            self.setModelParams(params)
+            return self
         from flax import serialization
         with open(os.path.join(path, "config.json")) as f:
             self.setModelConfig(json.load(f))
         with open(os.path.join(path, "params.msgpack"), "rb") as f:
             self.setModelParams(serialization.msgpack_restore(f.read()))
         return self
+
+    def setModelSchema(self, schema) -> "TpuModel":
+        """Load from a ModelDownloader ModelSchema (local uri)."""
+        return self.setModelLocation(schema.uri)
 
     def layerNames(self) -> list[str]:
         from .modules import build_model

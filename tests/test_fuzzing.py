@@ -110,6 +110,23 @@ def _tpu_model():
 
 
 _t(TpuModel, _tpu_model)
+
+
+def _image_featurizer():
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models import ImageFeaturizer
+    cfg = {"type": "convnet", "channels": [4], "dense": 8,
+           "num_classes": 2, "height": 8, "width": 8}
+    m = build_model(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+    return TestObject(
+        ImageFeaturizer().setInputCol("image").setOutputCol("feats")
+        .setModel(TpuModel().setModelConfig(cfg).setModelParams(p)), IMG)
+
+
+_t(__import__("mmlspark_tpu.models", fromlist=["ImageFeaturizer"]).ImageFeaturizer,
+   _image_featurizer)
 _t(TpuLearner, lambda: TestObject(
     TpuLearner().setModelConfig({"type": "mlp", "hidden": [4],
                                  "num_classes": 2})

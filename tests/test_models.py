@@ -189,3 +189,106 @@ class TestTpuModelInference:
         tm = TpuModel().setModelConfig({"type": "mlp"})
         with pytest.raises(ValueError):
             tm.transform(DataFrame({"features": np.zeros((2, 4))}))
+
+
+class TestModelDownloader:
+    """Reference: downloader module (ModelDownloader.scala, Schema.scala) —
+    repo listing, hash-verified transfer, ImageFeaturizer handoff."""
+
+    def _publish(self, tmp_path, name="convy", dataset="tiny"):
+        from mmlspark_tpu.models import ModelDownloader
+        cfg = {"type": "convnet", "channels": [4, 4], "dense": 8,
+               "num_classes": 3, "height": 8, "width": 8}
+        m = build_model(cfg)
+        p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+        d = ModelDownloader(str(tmp_path / "repo"))
+        schema = d.publish(cfg, p, name=name, dataset=dataset)
+        return d, schema, cfg
+
+    def test_publish_and_list(self, tmp_path):
+        d, schema, _ = self._publish(tmp_path)
+        assert schema.numLayers == len(schema.layerNames) > 0
+        names = [(s.name, s.dataset) for s in d.localModels()]
+        assert ("convy", "tiny") in names
+
+    def test_download_by_name_and_load(self, tmp_path):
+        from mmlspark_tpu.models import ModelDownloader
+        d, schema, cfg = self._publish(tmp_path)
+        d2 = ModelDownloader(str(tmp_path / "repo"))
+        got = d2.downloadByName("convy")
+        tm = TpuModel().setModelSchema(got).setInputCol("image")
+        assert tm.getModelConfig()["type"] == "convnet"
+        assert tm.layerNames() == schema.layerNames
+
+    def test_hash_mismatch_raises(self, tmp_path):
+        import dataclasses
+        d, schema, _ = self._publish(tmp_path)
+        bad = dataclasses.replace(schema, hash="0" * 64)
+        with pytest.raises(ValueError, match="hash"):
+            bad.assertMatchingHash(b"whatever")
+
+    def test_remote_repo_http(self, tmp_path):
+        """MANIFEST-indexed HTTP repo (DefaultModelRepo analog) served from
+        loopback — the reference's CDN path without leaving the machine."""
+        import http.server
+        import threading
+        from mmlspark_tpu.models import (ModelDownloader,
+                                         canonical_model_filename)
+        d, schema, _ = self._publish(tmp_path)
+        root = str(tmp_path / "repo")
+        fn = canonical_model_filename(schema.name, schema.dataset)
+        with open(f"{root}/MANIFEST", "w") as f:
+            f.write(fn + ".meta\n")
+        handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(
+            *a, directory=root, **kw)
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            d2 = ModelDownloader(str(tmp_path / "local2"), server_url=url)
+            remote = d2.remoteModels()
+            assert [s.name for s in remote] == ["convy"]
+            # metas carry repo-relative uris, so the schema is usable as-is
+            got = d2.downloadModel(remote[0])
+            assert got.uri.startswith(str(tmp_path / "local2"))
+            TpuModel().setModelSchema(got)  # loads cleanly
+        finally:
+            srv.shutdown()
+
+
+class TestImageFeaturizer:
+    def _img_df(self, n=4, h=16, w=16):
+        rng = np.random.default_rng(0)
+        rows = np.empty(n, dtype=object)
+        for i in range(n):
+            rows[i] = make_image_row(
+                f"p{i}", h, w, 3, rng.integers(0, 255, (h, w, 3), dtype=np.uint8))
+        return DataFrame({"image": rows})
+
+    def _featurizer(self, cut=1):
+        from mmlspark_tpu.models import ImageFeaturizer
+        cfg = {"type": "convnet", "channels": [4, 4], "dense": 8,
+               "num_classes": 3, "height": 8, "width": 8}
+        m = build_model(cfg)
+        p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8, 3)))
+        tm = TpuModel().setModelConfig(cfg).setModelParams(p)
+        return (ImageFeaturizer().setModel(tm).setInputCol("image")
+                .setCutOutputLayers(cut))
+
+    def test_headless_features(self):
+        out = self._featurizer(cut=1).transform(self._img_df())
+        col = out.col("features")
+        assert col[0].ndim == 1 and col[0].shape == (8,)  # dense layer width
+
+    def test_cut_zero_scores(self):
+        out = self._featurizer(cut=0).transform(self._img_df())
+        assert out.col("features")[0].shape == (3,)  # class logits
+
+    def test_deeper_cut_flattens_conv(self):
+        out = self._featurizer(cut=2).transform(self._img_df())
+        assert out.col("features")[0].ndim == 1
+        assert len(out.col("features")[0]) > 8  # flattened conv activation
+
+    def test_resizes_any_input_shape(self):
+        out = self._featurizer(cut=1).transform(self._img_df(h=24, w=10))
+        assert out.col("features")[0].shape == (8,)
