@@ -21,6 +21,7 @@ from __future__ import annotations
 import json
 import queue
 import threading
+import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -108,9 +109,13 @@ class HTTPSource:
                  timeout: float = 0.05) -> DataFrame:
         """Drain up to max_rows pending requests into an (id, value) frame."""
         rows = []
+        deadline = time.monotonic() + timeout
         try:
             while len(rows) < max_rows:
-                ex = self._pending.get(timeout=timeout if not rows else 0)
+                # deadline-bounded: discarding dead exchanges must not restart
+                # the clock, or repeated client timeouts stall this unboundedly
+                wait = max(0.0, deadline - time.monotonic()) if not rows else 0
+                ex = self._pending.get(timeout=wait)
                 # a client whose wait timed out was dropped from _inflight;
                 # its exchange is dead — don't hand it to the pipeline
                 with self._lock:

@@ -48,6 +48,7 @@ class GBDTParams(NamedTuple):
     seed: int = 0
     early_stopping_round: int = 0
     boosting_type: str = "gbdt"     # gbdt | rf (bagged trees, LightGBM rf mode)
+    hist_impl: str = "auto"         # auto | segment | pallas (histogram build)
 
 
 class TreeEnsemble(NamedTuple):
@@ -91,9 +92,37 @@ def bin_data(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
 
 # ------------------------------------------------------------- tree builder
 
+def _histograms(bins, g, h, node, n_nodes: int, n_bins: int,
+                hist_impl: str):
+    """(node, feature, bin) grad/hess histograms, two implementations:
+
+    * ``segment``: one flat segment_sum over combined ids — XLA scatter-add;
+    * ``pallas``: per-node masked one-hot matmuls via ops.pallas_kernels.
+      histogram_fused — the MXU path (vmap adds the node dimension).
+    """
+    n, d = bins.shape
+    if hist_impl == "pallas":
+        from ...ops.pallas_kernels import histogram_fused
+
+        def per_node(k):
+            m = (node == k).astype(jnp.float32)
+            return histogram_fused(bins, g * m, h * m, n_bins=n_bins)
+        hg, hh = jax.vmap(per_node)(jnp.arange(n_nodes))
+        return hg, hh
+    feat_ids = jnp.arange(d, dtype=jnp.int32)
+    seg = (node[:, None] * (d * n_bins)
+           + feat_ids[None, :] * n_bins + bins).reshape(-1)
+    num_seg = n_nodes * d * n_bins
+    hg = jax.ops.segment_sum(jnp.broadcast_to(g[:, None], (n, d)).reshape(-1),
+                             seg, num_segments=num_seg)
+    hh = jax.ops.segment_sum(jnp.broadcast_to(h[:, None], (n, d)).reshape(-1),
+                             seg, num_segments=num_seg)
+    return (hg.reshape(n_nodes, d, n_bins), hh.reshape(n_nodes, d, n_bins))
+
+
 def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
                      n_bins: int, lambda_l2, lambda_l1, min_child_weight,
-                     min_split_gain):
+                     min_split_gain, hist_impl: str = "segment"):
     """One level-wise tree for one output class.
 
     bins (n, d) int32; grad/hess (n,) f32; row_mask (n,) f32 bagging mask;
@@ -108,18 +137,10 @@ def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
     feat_arr = jnp.zeros(2 ** depth - 1, dtype=jnp.int32)
     thr_arr = jnp.full(2 ** depth - 1, n_bins, dtype=jnp.int32)  # default: all left
 
-    feat_ids = jnp.arange(d, dtype=jnp.int32)
-
     for level in range(depth):
         n_nodes = 2 ** level
         # --- histogram: scatter-add grads into (node, feature, bin) ---
-        seg = (node[:, None] * (d * n_bins)
-               + feat_ids[None, :] * n_bins + bins).reshape(-1)
-        num_seg = n_nodes * d * n_bins
-        hg = jax.ops.segment_sum(jnp.broadcast_to(g[:, None], (n, d)).reshape(-1),
-                                 seg, num_segments=num_seg).reshape(n_nodes, d, n_bins)
-        hh = jax.ops.segment_sum(jnp.broadcast_to(h[:, None], (n, d)).reshape(-1),
-                                 seg, num_segments=num_seg).reshape(n_nodes, d, n_bins)
+        hg, hh = _histograms(bins, g, h, node, n_nodes, n_bins, hist_impl)
         # --- split gain over all (node, feature, bin) at once ---
         gl = jnp.cumsum(hg, axis=2)
         hl = jnp.cumsum(hh, axis=2)
@@ -165,16 +186,17 @@ def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
     return feat_arr, thr_arr, leaf
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "n_bins"))
+@functools.partial(jax.jit, static_argnames=("depth", "n_bins", "hist_impl"))
 def _build_tree_multi(bins, grad, hess, row_mask, feat_mask, *, depth: int,
                       n_bins: int, lambda_l2, lambda_l1, min_child_weight,
-                      min_split_gain):
+                      min_split_gain, hist_impl: str = "segment"):
     """vmap the tree builder over the class axis of grad/hess (K trees per
     boosting iteration for multiclass; K=1 otherwise)."""
     return jax.vmap(
         lambda g, h: _build_tree_impl(bins, g, h, row_mask, feat_mask,
                                       depth, n_bins, lambda_l2, lambda_l1,
-                                      min_child_weight, min_split_gain),
+                                      min_child_weight, min_split_gain,
+                                      hist_impl),
         in_axes=1, out_axes=0)(grad, hess)
 
 
@@ -270,6 +292,12 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                          "rejects this combination too)")
     # global statistics (bin edges, init score) must come from REAL rows only
     # — mesh padding / user-masked rows are weight 0
+    # histogram backend: the Pallas one-hot-matmul kernel wins on TPU MXU;
+    # segment_sum is the portable scatter-add (and faster on CPU)
+    hist_impl = p.hist_impl
+    if hist_impl == "auto":
+        hist_impl = ("pallas" if jax.default_backend() == "tpu"
+                     and mesh is None else "segment")
     real = slice(None) if sample_weight is None else sample_weight > 0
     edges = compute_bin_edges(x[real], p.max_bin)
     bins = bin_data(x, edges)
@@ -345,7 +373,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             depth=p.max_depth, n_bins=p.max_bin,
             lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
             min_child_weight=p.min_child_weight,
-            min_split_gain=p.min_split_gain)
+            min_split_gain=p.min_split_gain, hist_impl=hist_impl)
         # rf leaves stay unscaled here; the 1/T average is applied at the end
         # over the ACTUAL forest size
         lv = lv * (1.0 if is_rf else p.learning_rate)

@@ -75,9 +75,13 @@ class ImageFeaturizer(Transformer):
                .setOutputCol(rcol).resize(h, w).transform(tmp))
 
         # reuse one inner TpuModel across transforms so its jitted program
-        # cache holds (a fresh instance would force an XLA recompile per call)
-        ckey = (id(tm.getModelParams()), output_layer)
-        if getattr(self, "_inner_key", None) != ckey:
+        # cache holds (a fresh instance would force an XLA recompile per call).
+        # The key holds a strong reference to the params object — id() alone
+        # could alias a new pytree allocated at a GC'd one's address.
+        ckey = (tm.getModelParams(), output_layer, repr(sorted(cfg.items())),
+                tm.getMiniBatchSize())
+        prev = getattr(self, "_inner_key", None)
+        if (prev is None or prev[0] is not ckey[0] or prev[1:] != ckey[1:]):
             self._inner = (TpuModel()
                            .setModelConfig(cfg)
                            .setModelParams(tm.getModelParams())

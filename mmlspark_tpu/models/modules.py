@@ -192,7 +192,88 @@ class BiLSTMTagger(nn.Module):
         return x.astype(jnp.float32)
 
 
+class TransformerEncoder(nn.Module):
+    """Transformer encoder for long-context sequence work — the model family
+    the reference lacks entirely (SURVEY.md §5: no attention, no sequence
+    parallelism; its only sequence model is the notebook-304 BiLSTM). Built
+    so context scales: attention is pluggable — ``attn_fn`` injects a
+    sequence-parallel form (parallel.sequence.make_sp_attention: ring over
+    ppermute, or Ulysses all-to-all) without touching the module; default is
+    single-device blockwise (FlashAttention-recurrence) attention, O(T)
+    memory.
+
+    Input: int32 token ids (B, T). Output: (B, num_classes) when
+    ``pool='mean'``, else per-token (B, T, num_classes).
+    """
+    vocab_size: int = 10000
+    d_model: int = 128
+    heads: int = 4
+    layers: int = 2
+    mlp_ratio: int = 4
+    num_classes: int = 2
+    max_len: int = 2048
+    causal: bool = False
+    pool: str = "mean"            # "mean" | "none"
+    dtype: Any = jnp.bfloat16
+    attn_fn: Optional[Callable] = None
+    attn_impl: str = "blockwise"   # "blockwise" | "flash" (Pallas kernel)
+    block_size: int = 512
+
+    def layer_names(self):
+        return ["embed"] + [f"block{i}" for i in range(self.layers)] + ["logits"]
+
+    def _attention(self, q, k, v):
+        if self.attn_fn is not None:
+            return self.attn_fn(q, k, v)
+        if self.attn_impl == "flash":
+            from ..ops.pallas_kernels import flash_attention
+            return flash_attention(q, k, v, causal=self.causal)
+        from ..parallel.sequence import blockwise_attention
+        return blockwise_attention(q, k, v, block_size=self.block_size,
+                                   causal=self.causal)
+
+    @nn.compact
+    def __call__(self, tokens, output_layer: Optional[str] = None):
+        tap = _LayerTap(output_layer)
+        B, T = tokens.shape
+        if T > self.max_len:
+            raise ValueError(f"sequence length {T} exceeds max_len "
+                             f"{self.max_len}; XLA would silently clamp the "
+                             f"position gather")
+        if self.d_model % self.heads != 0:
+            raise ValueError(f"d_model ({self.d_model}) must be divisible "
+                             f"by heads ({self.heads})")
+        H, D = self.heads, self.d_model // self.heads
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_len, self.d_model, dtype=self.dtype)(
+            jnp.arange(T)[None, :])
+        x = tap.tap("embed", x + pos)
+        if tap.done:
+            return tap.result.astype(jnp.float32)
+        for i in range(self.layers):
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            qkv = nn.Dense(3 * self.d_model, use_bias=False,
+                           dtype=self.dtype)(h)
+            q, k, v = jnp.split(qkv.reshape(B, T, 3 * H, D), 3, axis=2)
+            a = self._attention(q, k, v).reshape(B, T, self.d_model)
+            x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype)(a)
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype)(h)
+            h = nn.Dense(self.d_model, dtype=self.dtype)(nn.gelu(h))
+            x = tap.tap(f"block{i}", x + h)
+            if tap.done:
+                return tap.result.astype(jnp.float32)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.pool == "mean":
+            x = jnp.mean(x, axis=1)
+        x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))
+        return x.astype(jnp.float32)
+
+
 # ---------------------------------------------------------------- registry
+
+# families whose input is int token ids (callers must cast features to int32)
+TOKEN_MODELS = ("bilstm", "transformer")
 
 MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
     "mlp": lambda cfg: MLPNet(
@@ -211,16 +292,35 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
         embed_dim=cfg.get("embed_dim", 128),
         hidden=cfg.get("hidden", 128),
         num_classes=cfg.get("num_classes", 8)),
+    "transformer": lambda cfg, attn_fn=None: TransformerEncoder(
+        vocab_size=cfg.get("vocab_size", 10000),
+        d_model=cfg.get("d_model", 128),
+        heads=cfg.get("heads", 4),
+        layers=cfg.get("layers", 2),
+        mlp_ratio=cfg.get("mlp_ratio", 4),
+        num_classes=cfg.get("num_classes", 2),
+        max_len=cfg.get("max_len", 2048),
+        causal=cfg.get("causal", False),
+        pool=cfg.get("pool", "mean"),
+        block_size=cfg.get("block_size", 512),
+        attn_impl=cfg.get("attn_impl", "blockwise"),
+        attn_fn=attn_fn),
 }
 
 
-def build_model(config: dict) -> nn.Module:
-    """config: {"type": <family>, ...family kwargs...} -> flax module."""
+def build_model(config: dict, attn_fn: Optional[Callable] = None) -> nn.Module:
+    """config: {"type": <family>, ...family kwargs...} -> flax module.
+
+    ``attn_fn`` (transformer only): inject a sequence-parallel attention
+    callable (parallel.sequence.make_sp_attention) — kept out of the config
+    dict so configs stay JSON-serializable."""
     cfg = dict(config)
     mtype = cfg.pop("type")
     if mtype not in MODEL_BUILDERS:
         raise KeyError(f"unknown model type {mtype!r}; "
                        f"have {sorted(MODEL_BUILDERS)}")
+    if mtype == "transformer":
+        return MODEL_BUILDERS[mtype](cfg, attn_fn=attn_fn)
     return MODEL_BUILDERS[mtype](cfg)
 
 
@@ -234,6 +334,6 @@ def example_input(config: dict, batch: int = 2):
         w = config.get("width", 32)
         c = config.get("channels_in", 3)
         return jnp.zeros((batch, h, w, c), jnp.float32)
-    if mtype == "bilstm":
+    if mtype in TOKEN_MODELS:
         return jnp.zeros((batch, config.get("seq_len", 16)), jnp.int32)
     raise KeyError(mtype)

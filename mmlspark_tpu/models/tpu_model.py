@@ -105,7 +105,8 @@ class TpuModel(Transformer):
             raise ValueError("TpuModel has no params; set modelParams or "
                              "call setModelLocation")
         x = _prep_input(df, self.getInputCol(), tuple(self.getInputShape()))
-        if self.getModelConfig().get("type") == "bilstm":
+        from .modules import TOKEN_MODELS
+        if self.getModelConfig().get("type") in TOKEN_MODELS:
             x = x.astype(np.int32)
         mesh = meshlib.create_mesh()
         apply_fn = self._apply_fn()

@@ -32,7 +32,8 @@ from ..core.params import (BooleanParam, DictParam, FloatParam, IntParam,
 from ..core.pipeline import Estimator
 from ..core.utils import get_logger, to_float32_matrix
 from ..parallel import mesh as meshlib
-from .modules import build_model
+from ..parallel import sequence
+from .modules import TOKEN_MODELS, build_model
 from .tpu_model import TpuModel, _prep_input
 
 log = get_logger("trainer")
@@ -96,6 +97,10 @@ class TpuLearner(Estimator):
                                 default="")
     tensorParallel = IntParam("size of the model (TP) mesh axis", default=1,
                               min=1)
+    sequenceParallel = IntParam("size of the sequence (SP) mesh axis "
+                                "(transformer only)", default=1, min=1)
+    spMode = StringParam("sequence-parallel collective form", default="ring",
+                         choices=("ring", "ulysses"))
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -129,17 +134,37 @@ class TpuLearner(Estimator):
     def fit(self, df: DataFrame) -> TpuModel:
         cfg = dict(self.getModelConfig())
         x = _prep_input(df, self.getFeaturesCol(), tuple(self.getInputShape()))
-        if cfg.get("type") == "bilstm":
+        if cfg.get("type") in TOKEN_MODELS:
             x = x.astype(np.int32)
         y = np.asarray(df.col(self.getLabelCol()))
         y = (y.astype(np.int32) if self.getLoss() == "cross_entropy"
              else y.astype(np.float32))
 
         tp = self.getTensorParallel()
-        mesh = meshlib.create_mesh(model=tp)
-        module = build_model(cfg)
+        sp = self.getSequenceParallel()
+        attn_fn = None
+        if sp > 1:
+            if cfg.get("type") != "transformer":
+                raise ValueError("sequenceParallel>1 requires a transformer "
+                                 f"model, got {cfg.get('type')!r}")
+            n_dev = len(jax.devices())
+            if n_dev % (sp * tp) != 0 or sp * tp > n_dev:
+                raise ValueError(
+                    f"sequenceParallel*tensorParallel = {sp}*{tp} must divide "
+                    f"the device count ({n_dev})")
+            mesh = meshlib.make_mesh({"data": n_dev // (sp * tp),
+                                      "seq": sp, "model": tp})
+            attn_fn = sequence.make_sp_attention(
+                mesh, axis_name="seq", mode=self.getSpMode(),
+                causal=cfg.get("causal", False))
+        else:
+            mesh = meshlib.create_mesh(model=tp)
+        module = build_model(cfg, attn_fn=attn_fn)
         rng = jax.random.PRNGKey(self.getSeed())
-        params = module.init(rng, jnp.asarray(x[:2]))
+        # init batch must satisfy the shard_map divisibility of the sp
+        # attention (batch % data-axis == 0); data-axis size always works
+        init_b = dict(mesh.shape).get("data", 1) if sp > 1 else 2
+        params = module.init(rng, jnp.asarray(x[:init_b]))
         tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
                             self.getMomentum(), self.getWeightDecay())
         opt_state = tx.init(params)

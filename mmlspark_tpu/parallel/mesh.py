@@ -48,6 +48,23 @@ def create_mesh(data: Optional[int] = None, model: int = 1,
     return Mesh(dev_array, axis_names)
 
 
+def make_mesh(axes: dict[str, int],
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build an N-D mesh from {axis_name: size}. Axis order = dict order
+    (outermost first — put ``data`` outermost so DP collectives cross the
+    slowest links and tp/sp/ep ride contiguous ICI neighbors)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = list(axes.values())
+    if any(s < 1 for s in sizes):
+        raise ValueError(f"mesh axes must be >= 1, got {axes}")
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {axes} needs {total} devices, "
+                         f"have {len(devices)}")
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
 def batch_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:
     """Shard dim 0 (batch) over the data axis, replicate the rest."""
     return NamedSharding(mesh, P(batch_axis))
