@@ -49,6 +49,15 @@ class GBDTParams(NamedTuple):
     early_stopping_round: int = 0
     boosting_type: str = "gbdt"     # gbdt | rf (bagged trees, LightGBM rf mode)
     hist_impl: str = "auto"         # auto | segment | pallas (histogram build)
+    # LightGBM tree_learner (TrainParams.scala `parallelism`):
+    #   data    — rows sharded, per-device histograms psum'ed over ICI
+    #             (shard_map; the socket-allreduce ring of TrainUtils.scala:141)
+    #   feature — full rows everywhere, histogram WORK split by feature,
+    #             split candidates all_gather'ed (LightGBM feature-parallel
+    #             keeps the full dataset on every worker too)
+    #   auto    — shard rows and let XLA's auto-SPMD place the collectives
+    #   serial  — single-device program even if a mesh is passed
+    tree_learner: str = "data"      # data | feature | auto | serial
 
 
 class TreeEnsemble(NamedTuple):
@@ -101,68 +110,70 @@ def _histograms(bins, g, h, node, n_nodes: int, n_bins: int,
       histogram_fused — the MXU path (vmap adds the node dimension).
     """
     n, d = bins.shape
-    if hist_impl == "pallas":
-        from ...ops.pallas_kernels import histogram_fused
+    from ...ops.pallas_kernels import histogram_fused, segment_histogram
 
-        def per_node(k):
-            m = (node == k).astype(jnp.float32)
-            return histogram_fused(bins, g * m, h * m, n_bins=n_bins)
-        hg, hh = jax.vmap(per_node)(jnp.arange(n_nodes))
-        return hg, hh
-    feat_ids = jnp.arange(d, dtype=jnp.int32)
-    seg = (node[:, None] * (d * n_bins)
-           + feat_ids[None, :] * n_bins + bins).reshape(-1)
-    num_seg = n_nodes * d * n_bins
-    hg = jax.ops.segment_sum(jnp.broadcast_to(g[:, None], (n, d)).reshape(-1),
-                             seg, num_segments=num_seg)
-    hh = jax.ops.segment_sum(jnp.broadcast_to(h[:, None], (n, d)).reshape(-1),
-                             seg, num_segments=num_seg)
-    return (hg.reshape(n_nodes, d, n_bins), hh.reshape(n_nodes, d, n_bins))
+    # fold the node id into the bin id: ONE pass per level builds all nodes'
+    # histograms as (d, n_nodes*n_bins) columns (a per-node vmap would
+    # re-scan all rows 2^level times)
+    comb = node[:, None] * n_bins + bins
+    build = histogram_fused if hist_impl == "pallas" else segment_histogram
+    hg, hh = build(comb, g, h, n_bins=n_nodes * n_bins)
+    return (hg.reshape(d, n_nodes, n_bins).transpose(1, 0, 2),
+            hh.reshape(d, n_nodes, n_bins).transpose(1, 0, 2))
 
 
-def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
-                     n_bins: int, lambda_l2, lambda_l1, min_child_weight,
-                     min_split_gain, hist_impl: str = "segment"):
-    """One level-wise tree for one output class.
+def _best_splits(hg, hh, feat_mask, n_bins: int, lambda_l2, lambda_l1,
+                 min_child_weight):
+    """Vectorized split-gain argmax over (node, feature, bin) histograms.
 
-    bins (n, d) int32; grad/hess (n,) f32; row_mask (n,) f32 bagging mask;
-    feat_mask (d,) f32 feature-fraction mask.
+    hg/hh (n_nodes, d, n_bins); feat_mask (d,).
+    Returns (best_gain (n_nodes,), best_feat (n_nodes,), best_bin (n_nodes,)).
+    """
+    n_nodes, d, _ = hg.shape
+    gl = jnp.cumsum(hg, axis=2)
+    hl = jnp.cumsum(hh, axis=2)
+    gt = gl[:, :, -1:]
+    ht = hl[:, :, -1:]
+    gr = gt - gl
+    hr = ht - hl
+
+    def score(gsum, hsum):
+        # L1/L2-regularized leaf objective: (|g|-l1)^2 soft-thresholded
+        gs = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - lambda_l1, 0.0)
+        return gs * gs / (hsum + lambda_l2)
+
+    gain = score(gl, hl) + score(gr, hr) - score(gt, ht)
+    valid = ((hl >= min_child_weight) & (hr >= min_child_weight)
+             & (feat_mask[None, :, None] > 0))
+    gain = jnp.where(valid, gain, -jnp.inf)
+    flat = gain.reshape(n_nodes, d * n_bins)
+    best = jnp.argmax(flat, axis=1)
+    best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    bf = (best // n_bins).astype(jnp.int32)
+    bb = (best % n_bins).astype(jnp.int32)
+    return best_gain, bf, bb
+
+
+def _grow_tree(bins, g, h, depth: int, n_bins: int, candidate_fn,
+               lambda_l2, lambda_l1, min_split_gain,
+               leaf_axis_name: Optional[str] = None):
+    """Shared level-wise scaffolding for every tree_learner mode.
+
+    `bins` (n, d) is whatever each device routes its rows with (full
+    features); `candidate_fn(g, h, node, n_nodes) -> (best_gain, bf, bb)`
+    supplies per-node split candidates (this is where each mode's histogram
+    build + collective lives). Leaf grad/hess sums are psum'ed over
+    `leaf_axis_name` when rows are sharded.
     Returns (feature (2^depth-1,), threshold (2^depth-1,), leaf (2^depth,)).
     """
-    n, d = bins.shape
-    g = grad * row_mask
-    h = hess * row_mask
-
+    n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
     feat_arr = jnp.zeros(2 ** depth - 1, dtype=jnp.int32)
     thr_arr = jnp.full(2 ** depth - 1, n_bins, dtype=jnp.int32)  # default: all left
 
     for level in range(depth):
         n_nodes = 2 ** level
-        # --- histogram: scatter-add grads into (node, feature, bin) ---
-        hg, hh = _histograms(bins, g, h, node, n_nodes, n_bins, hist_impl)
-        # --- split gain over all (node, feature, bin) at once ---
-        gl = jnp.cumsum(hg, axis=2)
-        hl = jnp.cumsum(hh, axis=2)
-        gt = gl[:, :, -1:]
-        ht = hl[:, :, -1:]
-        gr = gt - gl
-        hr = ht - hl
-
-        def score(gsum, hsum):
-            # L1/L2-regularized leaf objective: (|g|-l1)^2 soft-thresholded
-            gs = jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - lambda_l1, 0.0)
-            return gs * gs / (hsum + lambda_l2)
-
-        gain = score(gl, hl) + score(gr, hr) - score(gt, ht)
-        valid = ((hl >= min_child_weight) & (hr >= min_child_weight)
-                 & (feat_mask[None, :, None] > 0))
-        gain = jnp.where(valid, gain, -jnp.inf)
-        flat = gain.reshape(n_nodes, d * n_bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // n_bins).astype(jnp.int32)
-        bb = (best % n_bins).astype(jnp.int32)
+        best_gain, bf, bb = candidate_fn(g, h, node, n_nodes)
         # nodes with no usable split: route everything left (thr = n_bins)
         use = best_gain > min_split_gain
         bf = jnp.where(use, bf, 0)
@@ -172,7 +183,7 @@ def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
         feat_arr = jax.lax.dynamic_update_slice(feat_arr, bf, (off,))
         thr_arr = jax.lax.dynamic_update_slice(thr_arr, bb, (off,))
 
-        # --- route rows ---
+        # --- route rows (local: every device routes its own row shard) ---
         nf = bf[node]
         nt = bb[node]
         go_right = bins[jnp.arange(n), nf] > nt
@@ -181,9 +192,129 @@ def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
     # --- leaves ---
     lg = jax.ops.segment_sum(g, node, num_segments=2 ** depth)
     lh = jax.ops.segment_sum(h, node, num_segments=2 ** depth)
+    if leaf_axis_name is not None:
+        lg = jax.lax.psum(lg, leaf_axis_name)
+        lh = jax.lax.psum(lh, leaf_axis_name)
     lgs = jnp.sign(lg) * jnp.maximum(jnp.abs(lg) - lambda_l1, 0.0)
     leaf = -lgs / (lh + lambda_l2)
     return feat_arr, thr_arr, leaf
+
+
+def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
+                     n_bins: int, lambda_l2, lambda_l1, min_child_weight,
+                     min_split_gain, hist_impl: str = "segment",
+                     axis_name: Optional[str] = None):
+    """One level-wise tree for one output class.
+
+    bins (n, d) int32; grad/hess (n,) f32; row_mask (n,) f32 bagging mask;
+    feat_mask (d,) f32 feature-fraction mask.
+    With `axis_name` (inside shard_map, rows sharded over that mesh axis) the
+    per-device histograms and leaf sums are `psum`'ed over ICI — LightGBM's
+    `tree_learner=data` allreduce ring (TrainUtils.scala:141) as one XLA
+    collective; split selection then runs replicated on every device.
+    """
+    g = grad * row_mask
+    h = hess * row_mask
+
+    def candidates(g, h, node, n_nodes):
+        hg, hh = _histograms(bins, g, h, node, n_nodes, n_bins, hist_impl)
+        if axis_name is not None:
+            hg = jax.lax.psum(hg, axis_name)
+            hh = jax.lax.psum(hh, axis_name)
+        return _best_splits(hg, hh, feat_mask, n_bins, lambda_l2, lambda_l1,
+                            min_child_weight)
+
+    return _grow_tree(bins, g, h, depth, n_bins, candidates, lambda_l2,
+                      lambda_l1, min_split_gain, leaf_axis_name=axis_name)
+
+
+def _build_tree_fp(bins, grad, hess, row_mask, feat_mask, *, depth: int,
+                   n_bins: int, d_local: int, axis_name: str,
+                   lambda_l2, lambda_l1, min_child_weight, min_split_gain,
+                   hist_impl: str = "segment"):
+    """Feature-parallel tree build (LightGBM `tree_learner=feature`).
+
+    Every device holds the FULL row set (as in LightGBM, whose feature-
+    parallel workers each keep the whole dataset) but builds histograms only
+    for its own feature slice; per-node best splits are `all_gather`'ed and
+    the winner picked identically everywhere, so only (gain, feat, bin)
+    triples — not histograms — cross ICI. Row routing is local since every
+    device has all features.
+
+    bins (n, d_pad) replicated; feat_mask (d_pad,) with padding zeroed.
+    """
+    idx = jax.lax.axis_index(axis_name)
+    f_off = idx * d_local
+    lbins = jax.lax.dynamic_slice_in_dim(bins, f_off, d_local, axis=1)
+    lfm = jax.lax.dynamic_slice_in_dim(feat_mask, f_off, d_local, axis=0)
+    g = grad * row_mask
+    h = hess * row_mask
+
+    def candidates(g, h, node, n_nodes):
+        hg, hh = _histograms(lbins, g, h, node, n_nodes, n_bins, hist_impl)
+        lgain, lbf, lbb = _best_splits(hg, hh, lfm, n_bins, lambda_l2,
+                                       lambda_l1, min_child_weight)
+        lbf = lbf + f_off  # local slice index -> global feature id
+        # --- tiny collective: (n_dev, n_nodes) candidate table everywhere ---
+        cg = jax.lax.all_gather(lgain, axis_name)
+        cf = jax.lax.all_gather(lbf, axis_name)
+        cb = jax.lax.all_gather(lbb, axis_name)
+        win = jnp.argmax(cg, axis=0)  # ties -> lowest device id: deterministic
+        best_gain = jnp.take_along_axis(cg, win[None, :], axis=0)[0]
+        bf = jnp.take_along_axis(cf, win[None, :], axis=0)[0]
+        bb = jnp.take_along_axis(cb, win[None, :], axis=0)[0]
+        return best_gain, bf, bb
+
+    # leaves need no psum: full rows + replicated routing on every device
+    return _grow_tree(bins, g, h, depth, n_bins, candidates, lambda_l2,
+                      lambda_l1, min_split_gain)
+
+
+def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
+                         d_pad: int = 0, lambda_l2=1.0, lambda_l1=0.0,
+                         min_child_weight=1e-3, min_split_gain=0.0,
+                         hist_impl: str = "segment", axis_name: str = "data"):
+    """jit(shard_map) tree builder with explicit ICI collectives.
+
+    tree_learner="data": rows sharded over `axis_name`, histograms psum'ed.
+    tree_learner="feature": inputs replicated, histogram work split by
+    feature slice, split candidates all_gather'ed.
+    Signature of the returned fn matches `_build_tree_multi`:
+    (bins, grad (n,K), hess, row_mask, feat_mask) -> (f, t, leaf) stacked
+    over the class axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if tree_learner == "data":
+        def body(bins, g, h, rm, fm):
+            build = lambda g1, h1: _build_tree_impl(
+                bins, g1, h1, rm, fm, depth, n_bins, lambda_l2, lambda_l1,
+                min_child_weight, min_split_gain, hist_impl,
+                axis_name=axis_name)
+            return jax.vmap(build, in_axes=1, out_axes=0)(g, h)
+        in_specs = (P(axis_name, None), P(axis_name, None), P(axis_name, None),
+                    P(axis_name), P(None))
+    elif tree_learner == "feature":
+        n_dev = mesh.shape[axis_name]
+        assert d_pad % n_dev == 0, (d_pad, n_dev)
+        d_local = d_pad // n_dev
+
+        def body(bins, g, h, rm, fm):
+            build = lambda g1, h1: _build_tree_fp(
+                bins, g1, h1, rm, fm, depth=depth, n_bins=n_bins,
+                d_local=d_local, axis_name=axis_name, lambda_l2=lambda_l2,
+                lambda_l1=lambda_l1, min_child_weight=min_child_weight,
+                min_split_gain=min_split_gain, hist_impl=hist_impl)
+            return jax.vmap(build, in_axes=1, out_axes=0)(g, h)
+        in_specs = (P(None, None), P(None, None), P(None, None), P(None),
+                    P(None))
+    else:
+        raise ValueError(f"unknown tree_learner {tree_learner!r}")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=(P(None), P(None), P(None)),
+                       check_vma=False)
+    return jax.jit(fn)
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "n_bins", "hist_impl"))
@@ -276,12 +407,22 @@ def _loss(raw, y, objective: str, alpha):
 def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
              mesh=None, sample_weight: Optional[np.ndarray] = None,
              eval_set: Optional[tuple] = None) -> TreeEnsemble:
-    """Train a boosted ensemble. If `mesh` is given, the bin matrix and
-    per-row state are sharded over its ``data`` axis, turning every
-    histogram segment_sum into an ICI all-reduce (LightGBM's
-    `tree_learner=data` over XLA collectives)."""
+    """Train a boosted ensemble. With a `mesh`, `params.tree_learner` picks
+    the distributed mode: "data" shards rows and psums histograms over ICI
+    (explicit shard_map — LightGBM's socket-allreduce ring), "feature"
+    splits histogram work by feature with all_gather'ed split candidates,
+    "auto" shards rows and lets XLA auto-SPMD place the collectives."""
     p = params
     n, d = x.shape
+    if p.tree_learner not in ("serial", "data", "feature", "auto"):
+        raise ValueError(f"unknown tree_learner {p.tree_learner!r}; expected "
+                         "serial|data|feature|auto")
+    if p.hist_impl not in ("auto", "segment", "pallas"):
+        raise ValueError(f"unknown hist_impl {p.hist_impl!r}; expected "
+                         "auto|segment|pallas")
+    tree_learner = p.tree_learner if mesh is not None else "serial"
+    if tree_learner == "serial":
+        mesh = None
     K = p.num_class if p.objective == "multiclass" else 1
     is_rf = p.boosting_type == "rf"
     if is_rf and not ((p.bagging_fraction < 1.0 and p.bagging_freq > 0)
@@ -301,16 +442,33 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     real = slice(None) if sample_weight is None else sample_weight > 0
     edges = compute_bin_edges(x[real], p.max_bin)
     bins = bin_data(x, edges)
+    d_pad = d
+    if tree_learner == "feature":
+        # pad the feature axis to a device multiple; padded columns carry
+        # feat_mask 0 so they can never win a split
+        n_dev = mesh.shape["data"]
+        d_pad = -(-d // n_dev) * n_dev
+        if d_pad != d:
+            bins = np.pad(bins, ((0, 0), (0, d_pad - d)))
     yj = jnp.asarray(y.astype(np.float32))
     base = _init_score(y[real], p)
     raw = jnp.broadcast_to(jnp.asarray(base)[None, :], (n, K)).astype(jnp.float32)
     bins_j = jnp.asarray(bins)
 
-    if mesh is not None:
+    shard_rows = mesh is not None and tree_learner in ("data", "auto")
+    if shard_rows:
         from ...parallel import mesh as meshlib
         bins_j = meshlib.shard_batch(bins_j, mesh)
         raw = meshlib.shard_batch(raw, mesh)
         yj = meshlib.shard_batch(yj, mesh)
+
+    builder = None
+    if mesh is not None and tree_learner in ("data", "feature"):
+        builder = make_sharded_builder(
+            mesh, tree_learner, depth=p.max_depth, n_bins=p.max_bin,
+            d_pad=d_pad, lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+            min_child_weight=p.min_child_weight,
+            min_split_gain=p.min_split_gain, hist_impl=hist_impl)
 
     rng = np.random.default_rng(p.seed)
     feats, thrs, leaves = [], [], []
@@ -364,16 +522,20 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         else:
             feat_mask = np.ones(d, dtype=np.float32)
         rm = jnp.asarray(row_mask)
-        if mesh is not None:
+        if shard_rows:
             from ...parallel import mesh as meshlib
             rm = meshlib.shard_batch(rm, mesh)
 
-        f, t, lv = _build_tree_multi(
-            bins_j, g, h, rm, jnp.asarray(feat_mask),
-            depth=p.max_depth, n_bins=p.max_bin,
-            lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
-            min_child_weight=p.min_child_weight,
-            min_split_gain=p.min_split_gain, hist_impl=hist_impl)
+        fm = jnp.asarray(np.pad(feat_mask, (0, d_pad - d)))
+        if builder is not None:
+            f, t, lv = builder(bins_j, g, h, rm, fm)
+        else:
+            f, t, lv = _build_tree_multi(
+                bins_j, g, h, rm, fm,
+                depth=p.max_depth, n_bins=p.max_bin,
+                lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                min_child_weight=p.min_child_weight,
+                min_split_gain=p.min_split_gain, hist_impl=hist_impl)
         # rf leaves stay unscaled here; the 1/T average is applied at the end
         # over the ACTUAL forest size
         lv = lv * (1.0 if is_rf else p.learning_rate)

@@ -40,9 +40,15 @@ class _BoosterParams:
     featureFraction = FloatParam("feature subsample fraction", default=1.0)
     earlyStoppingRound = IntParam("stop if no improvement for k rounds (0=off)",
                                   default=0)
-    parallelism = StringParam("data_parallel|serial (tree_learner analog)",
-                              default="data_parallel",
-                              choices=("data_parallel", "serial"))
+    parallelism = StringParam(
+        "tree_learner (TrainParams.scala): data_parallel = rows sharded + "
+        "histogram psum over ICI; feature_parallel = histogram work split "
+        "by feature, split candidates all_gather'ed; voting_parallel maps "
+        "to data_parallel (its voting trick optimizes network volume the "
+        "ICI allreduce doesn't need); serial = single device",
+        default="data_parallel",
+        choices=("data_parallel", "feature_parallel", "voting_parallel",
+                 "serial"))
     seed = IntParam("random seed", default=0)
 
     def _depth(self) -> int:
@@ -66,11 +72,16 @@ class _BoosterParams:
             feature_fraction=self.getOrDefault("featureFraction"),
             early_stopping_round=self.getOrDefault("earlyStoppingRound"),
             objective=objective, num_class=num_class, alpha=alpha,
-            seed=self.getOrDefault("seed"))
+            seed=self.getOrDefault("seed"),
+            tree_learner=self._tree_learner())
+
+    def _tree_learner(self) -> str:
+        return {"data_parallel": "data", "voting_parallel": "data",
+                "feature_parallel": "feature",
+                "serial": "serial"}[self.getOrDefault("parallelism")]
 
     def _mesh(self):
-        if (self.getOrDefault("parallelism") == "data_parallel"
-                and len(jax.devices()) > 1):
+        if self._tree_learner() != "serial" and len(jax.devices()) > 1:
             return meshlib.create_mesh()
         return None
 
@@ -85,7 +96,9 @@ def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
 def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
     p = params_holder._engine_params(objective, num_class, alpha)
     mesh = params_holder._mesh()
-    if mesh is not None:
+    if mesh is not None and p.tree_learner != "feature":
+        # row-sharded modes need the batch padded to a device multiple;
+        # feature-parallel keeps full rows on every device
         x, n = meshlib.pad_batch_to_devices(x, mesh)
         y = np.concatenate([y, np.zeros(len(x) - n, y.dtype)])
         w = np.concatenate([np.ones(n, np.float32),

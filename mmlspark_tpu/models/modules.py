@@ -264,6 +264,9 @@ class TransformerEncoder(nn.Module):
             if tap.done:
                 return tap.result.astype(jnp.float32)
         x = nn.LayerNorm(dtype=self.dtype)(x)
+        if self.pool not in ("mean", "none"):
+            raise ValueError(f"pool must be 'mean' or 'none', got "
+                             f"{self.pool!r}")
         if self.pool == "mean":
             x = jnp.mean(x, axis=1)
         x = tap.tap("logits", nn.Dense(self.num_classes, dtype=self.dtype)(x))

@@ -152,6 +152,10 @@ class TpuLearner(Estimator):
                 raise ValueError(
                     f"sequenceParallel*tensorParallel = {sp}*{tp} must divide "
                     f"the device count ({n_dev})")
+            if x.shape[1] % sp != 0:
+                raise ValueError(
+                    f"sequence length {x.shape[1]} must be divisible by "
+                    f"sequenceParallel ({sp})")
             mesh = meshlib.make_mesh({"data": n_dev // (sp * tp),
                                       "seq": sp, "model": tp})
             attn_fn = sequence.make_sp_attention(

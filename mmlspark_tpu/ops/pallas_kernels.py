@@ -144,6 +144,21 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
 
 # ------------------------------------------------------------ GBDT histogram
 
+def segment_histogram(bins, grad, hess, n_bins: int):
+    """Flat XLA scatter-add histograms (the portable non-Pallas path).
+
+    bins (N, F) int32 in [0, n_bins); grad/hess (N,) f32.
+    Returns (hist_g, hist_h), each (F, n_bins) f32.
+    """
+    N, F = bins.shape
+    feat_ids = jnp.arange(F, dtype=jnp.int32)
+    seg = (feat_ids[None, :] * n_bins + bins.astype(jnp.int32)).reshape(-1)
+    bcast = lambda v: jnp.broadcast_to(
+        v.astype(jnp.float32)[:, None], (N, F)).reshape(-1)
+    hg = jax.ops.segment_sum(bcast(grad), seg, num_segments=F * n_bins)
+    hh = jax.ops.segment_sum(bcast(hess), seg, num_segments=F * n_bins)
+    return hg.reshape(F, n_bins), hh.reshape(F, n_bins)
+
 def _hist_kernel(bins_ref, g_ref, h_ref, hg_ref, hh_ref, *, n_bins: int,
                  block_n: int, n_rows: int):
     """Grid = (num_row_blocks,). One-hot expand the row block's bins in VMEM,
@@ -160,16 +175,22 @@ def _hist_kernel(bins_ref, g_ref, h_ref, hg_ref, hh_ref, *, n_bins: int,
     bn, F = bins.shape
     row_ok = (step * block_n + jax.lax.broadcasted_iota(
         jnp.int32, (bn, 1), 0)) < n_rows                # mask row padding
+    # n_bins here is the 128-padded bin count: Mosaic only reshapes away a
+    # trailing dim that is lane-aligned
     onehot = (bins[:, :, None] ==
               jax.lax.broadcasted_iota(jnp.int32, (bn, F, n_bins), 2))
     onehot = (onehot & row_ok[:, :, None]).astype(jnp.float32)
     flat = onehot.reshape(bn, F * n_bins)
     g = g_ref[:].reshape(1, bn)                         # (1, bn)
     h = h_ref[:].reshape(1, bn)
-    hg_ref[:] += jnp.dot(g, flat,
-                         preferred_element_type=jnp.float32).reshape(F, n_bins)
-    hh_ref[:] += jnp.dot(h, flat,
-                         preferred_element_type=jnp.float32).reshape(F, n_bins)
+    # HIGHEST: full-f32 MXU passes — bf16 truncation of grads would put
+    # ~4e-3 relative error on every histogram entry and perturb split gains
+    hg_ref[:] += jnp.dot(
+        g, flat, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).reshape(F, n_bins)
+    hh_ref[:] += jnp.dot(
+        h, flat, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST).reshape(F, n_bins)
 
 
 def histogram_fused(bins, grad, hess, n_bins: int = 256,
@@ -187,7 +208,21 @@ def histogram_fused(bins, grad, hess, n_bins: int = 256,
     """
     N, F = bins.shape
     interpret = _interpret() if interpret is None else interpret
-    block_n = min(block_n, max(8, N))
+    # lane-align the bin axis (Mosaic can only collapse/split a trailing dim
+    # that is a 128 multiple); extra bins never match any bin id -> zero rows
+    n_pad = -(-n_bins // 128) * 128
+    # the (block_n, F, n_pad) one-hot staging must fit VMEM; the row block
+    # can't shrink below 128 (lane alignment), so when even a 128-row block
+    # exceeds the budget the one-hot tiling is infeasible on TPU — use the
+    # XLA scatter-add instead (same result, no VMEM staging)
+    budget = 6 << 20
+    if not interpret and 128 * F * n_pad * 4 > budget:
+        return segment_histogram(bins, grad, hess, n_bins)
+    # rows are the matmul contraction dim: keep blocks lane-aligned (128) so
+    # the TPU lowering accepts them even when the call is vmapped (per-node
+    # masked grads batch the 1xN operands)
+    rows_cap = max(128, (budget // (F * n_pad * 4)) // 128 * 128)
+    block_n = min(block_n, -(-N // 128) * 128, rows_cap)
     pad = (-N) % block_n
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
@@ -195,21 +230,21 @@ def histogram_fused(bins, grad, hess, n_bins: int = 256,
         hess = jnp.pad(hess, (0, pad))
     nblk = bins.shape[0] // block_n
 
-    kernel = functools.partial(_hist_kernel, n_bins=n_bins, block_n=block_n,
+    kernel = functools.partial(_hist_kernel, n_bins=n_pad, block_n=block_n,
                                n_rows=N)
     hg, hh = pl.pallas_call(
         kernel,
         grid=(nblk,),
         in_specs=[
             pl.BlockSpec((block_n, F), lambda i: (i, 0)),
-            pl.BlockSpec((block_n,), lambda i: (i,)),
-            pl.BlockSpec((block_n,), lambda i: (i,)),
+            pl.BlockSpec((1, block_n), lambda i: (0, i)),
+            pl.BlockSpec((1, block_n), lambda i: (0, i)),
         ],
-        out_specs=(pl.BlockSpec((F, n_bins), lambda i: (0, 0)),
-                   pl.BlockSpec((F, n_bins), lambda i: (0, 0))),
-        out_shape=(jax.ShapeDtypeStruct((F, n_bins), jnp.float32),
-                   jax.ShapeDtypeStruct((F, n_bins), jnp.float32)),
+        out_specs=(pl.BlockSpec((F, n_pad), lambda i: (0, 0)),
+                   pl.BlockSpec((F, n_pad), lambda i: (0, 0))),
+        out_shape=(jax.ShapeDtypeStruct((F, n_pad), jnp.float32),
+                   jax.ShapeDtypeStruct((F, n_pad), jnp.float32)),
         interpret=interpret,
-    )(bins.astype(jnp.int32), grad.astype(jnp.float32),
-      hess.astype(jnp.float32))
-    return hg, hh
+    )(bins.astype(jnp.int32), grad.astype(jnp.float32).reshape(1, -1),
+      hess.astype(jnp.float32).reshape(1, -1))
+    return hg[:, :n_bins], hh[:, :n_bins]

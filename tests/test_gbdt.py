@@ -101,10 +101,42 @@ class TestEngine:
         y = y.astype(np.float32)
         p = GBDTParams(num_iterations=10, max_depth=3, max_bin=31)
         ens_s = engine.fit_gbdt(x, y, p)
-        ens_d = engine.fit_gbdt(x, y, p, mesh=create_mesh())
         ps = engine.predict(ens_s, x)[:, 1]
-        pd = engine.predict(ens_d, x)[:, 1]
-        np.testing.assert_allclose(ps, pd, atol=1e-3)
+        # every tree_learner (data=psum ring, feature=all_gather candidates,
+        # auto=XLA auto-SPMD) must reproduce the serial ensemble
+        for learner in ("data", "feature", "auto"):
+            ens_d = engine.fit_gbdt(x, y, p._replace(tree_learner=learner),
+                                    mesh=create_mesh())
+            pd = engine.predict(ens_d, x)[:, 1]
+            np.testing.assert_allclose(ps, pd, atol=1e-3,
+                                       err_msg=f"tree_learner={learner}")
+
+    def test_feature_parallel_multiclass_and_padding(self):
+        # 10 features over 8 devices -> padded to 16; multiclass vmaps the
+        # feature-parallel build over the class axis
+        from mmlspark_tpu.parallel import create_mesh
+        x, y = make_classification(n_samples=384, n_features=10,
+                                   n_informative=6, n_classes=3,
+                                   random_state=5)
+        x = x.astype(np.float32)
+        y = y.astype(np.float32)
+        p = GBDTParams(num_iterations=8, max_depth=3, max_bin=31,
+                       objective="multiclass", num_class=3)
+        ens_s = engine.fit_gbdt(x, y, p)
+        ens_f = engine.fit_gbdt(x, y, p._replace(tree_learner="feature"),
+                                mesh=create_mesh())
+        np.testing.assert_allclose(engine.predict(ens_s, x),
+                                   engine.predict(ens_f, x), atol=1e-3)
+
+    def test_stage_parallelism_feature(self):
+        x, y = make_classification(n_samples=256, n_features=6,
+                                   random_state=7)
+        df = _df_from_matrix(x.astype(np.float32), y.astype(np.float32))
+        clf = (LightGBMClassifier().setNumIterations(10).setMaxBin(31)
+               .setParallelism("feature_parallel"))
+        model = clf.fit(df)
+        prob = np.stack(list(model.transform(df).col("probability")))[:, 1]
+        assert roc_auc_score(y, prob) > 0.9
 
     def test_constant_feature_no_crash(self):
         x = np.ones((100, 3), dtype=np.float32)
