@@ -1,13 +1,17 @@
 """IO layer (reference: src/io). `readImages`/`readBinaryFiles` mirror the
 reference's session implicits (io/src/main/scala/Readers.scala:14-45)."""
 
-from . import binary, http, image, powerbi
+from . import binary, csv, http, image, loader, powerbi
 from .binary import read_binary_files, recurse_path
+from .csv import read_csv, read_csv_matrix
 from .image import decode_image, read_images, write_images
+from .loader import device_image_batches, image_batches, list_images
 
 readImages = read_images
 readBinaryFiles = read_binary_files
 
-__all__ = ["binary", "http", "image", "powerbi", "read_binary_files",
-           "read_images", "write_images", "decode_image", "recurse_path",
+__all__ = ["binary", "csv", "http", "image", "loader", "powerbi",
+           "read_binary_files", "read_images", "write_images",
+           "decode_image", "recurse_path", "read_csv", "read_csv_matrix",
+           "image_batches", "device_image_batches", "list_images",
            "readImages", "readBinaryFiles"]

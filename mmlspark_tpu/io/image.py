@@ -4,7 +4,11 @@ subsampling; ImageWriter).
 
 read_images decodes to the reference's layout: HWC uint8, BGR channel order
 (OpenCV default), one ImageSchema struct per row. Undecodable files follow
-the reference's contract: dropped when drop_invalid, else a null row."""
+the reference's contract: dropped when drop_invalid, else a null row.
+
+Decode goes through the in-repo native runtime (mmlspark_tpu.native —
+libjpeg/libpng C++, bit-compatible with cv2 for PNG/BMP and same-libjpeg
+JPEG), falling back to cv2 for formats it doesn't cover (GIF/TIFF/WebP)."""
 
 from __future__ import annotations
 
@@ -19,16 +23,21 @@ from ..core.schema import make_image_row, tag_image_column
 from ..core.utils import object_column
 from .binary import read_binary_files
 
-IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".tif", ".tiff",
-                    ".webp")
+IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".gif", ".tif",
+                    ".tiff", ".webp")
+# subset the in-repo C++ decoder handles; the rest go through cv2
+NATIVE_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm")
 
 
 def decode_image(path: str, data: bytes) -> Optional[dict]:
     """bytes -> ImageSchema row (BGR HWC uint8), None if undecodable."""
-    buf = np.frombuffer(data, dtype=np.uint8)
-    img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
-    if img is None:
-        return None
+    from .. import native
+    img = native.decode_image(data)
+    if img is None:  # non-native format (gif/tiff/webp) or no toolchain
+        buf = np.frombuffer(data, dtype=np.uint8)
+        img = cv2.imdecode(buf, cv2.IMREAD_COLOR)
+        if img is None:
+            return None
     h, w, c = img.shape
     return make_image_row(path, h, w, c, img)
 

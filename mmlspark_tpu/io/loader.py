@@ -1,0 +1,118 @@
+"""Device feed: files -> decoded fixed-shape batches -> TPU HBM.
+
+The ingest pipeline the reference lacks (SURVEY.md §7 phase 2): it moves
+training data by writing text files and scp-ing them to GPU VMs
+(cntk-train/.../CommandBuilders.scala:200-228) and feeds inference through
+per-element JNI copies (cntk-model/.../CNTKModel.scala:51-88). Here the
+native threaded loader (mmlspark_tpu.native.BatchLoader, C++) fills a
+persistent host staging buffer per batch, ``jax.device_put`` snapshots it
+into HBM, and a one-batch lookahead overlaps disk/decode with TPU compute.
+A pure-Python loader covers environments without the toolchain.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterator, Optional
+
+import numpy as np
+
+from .. import native
+from ..core.utils import get_logger
+from .binary import recurse_path
+from .image import IMAGE_EXTENSIONS, NATIVE_EXTENSIONS
+
+log = get_logger("loader")
+
+
+def _cv2_fill(path: str, buf_slot: np.ndarray, height: int,
+              width: int) -> bool:
+    import cv2
+    img = cv2.imread(path, cv2.IMREAD_COLOR)
+    if img is None:
+        return False
+    if img.shape[:2] != (height, width):
+        img = cv2.resize(img, (width, height),
+                         interpolation=cv2.INTER_LINEAR)
+    buf_slot[:] = img
+    return True
+
+
+def _python_batches(paths, batch, height, width):
+    """Fallback decode loop (cv2), same (buf, ok, count) contract."""
+    buf = np.zeros((batch, height, width, 3), dtype=np.uint8)
+    ok = np.zeros((batch,), dtype=bool)
+    for lo in range(0, len(paths), batch):
+        chunk = paths[lo:lo + batch]
+        buf[:] = 0
+        ok[:] = False
+        for i, p in enumerate(chunk):
+            ok[i] = _cv2_fill(p, buf[i], height, width)
+        yield buf, ok, len(chunk)
+
+
+def image_batches(paths: list[str], batch: int, height: int, width: int,
+                  threads: int = 0, prefetch: int = 4
+                  ) -> Iterator[tuple[np.ndarray, np.ndarray, int]]:
+    """Yield (batch[B,H,W,3] uint8 BGR staging buffer, ok[B] bool, count).
+
+    Buffers are reused across yields — device_put/copy before advancing.
+    Formats outside the native decoder's set (gif/tiff/webp) are patched in
+    via cv2 so the file set never depends on whether the toolchain exists.
+    """
+    if not native.available():
+        yield from _python_batches(paths, batch, height, width)
+        return
+    with native.BatchLoader(paths, batch, height, width,
+                            threads=threads, prefetch=prefetch) as ld:
+        for bi, (buf, ok, count) in enumerate(ld):
+            for i in range(count):
+                if not ok[i]:
+                    p = paths[bi * batch + i]
+                    if not p.lower().endswith(NATIVE_EXTENSIONS):
+                        ok[i] = _cv2_fill(p, buf[i], height, width)
+            yield buf, ok, count
+
+
+def device_image_batches(paths: list[str], batch: int, height: int,
+                         width: int, *, transform: Optional[Callable] = None,
+                         threads: int = 0, prefetch: int = 4):
+    """Yield device-resident batches with one-batch lookahead.
+
+    Each yield is (jax array on device, ok mask on host, count). transform
+    (host-side, e.g. dtype cast) runs on the staging buffer before the put.
+    The lookahead keeps one device transfer in flight while the consumer
+    computes on the previous batch — decode (C++ threads), PCIe/ICI
+    transfer, and TPU compute all overlap.
+    """
+    import jax
+
+    def put(buf):
+        arr = transform(buf) if transform is not None else buf
+        if arr is buf or (isinstance(arr, np.ndarray) and
+                          arr.base is not None):
+            # device_put is async (and on CPU can alias the numpy buffer);
+            # the staging buffer is overwritten by the next decode, so any
+            # view of it must be snapshotted first
+            arr = np.array(arr)
+        return jax.device_put(arr)
+
+    pending = None  # (device_array, ok_copy, count)
+    for buf, ok, count in image_batches(paths, batch, height, width,
+                                        threads=threads, prefetch=prefetch):
+        nxt = (put(buf), ok.copy(), count)
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending
+
+
+def list_images(path: str, recursive: bool = True) -> list[str]:
+    """All decodable image files under path, sorted for determinism."""
+    if os.path.isfile(path):
+        return [path]
+    files = recurse_path(path) if recursive else [
+        os.path.join(path, f) for f in sorted(os.listdir(path))
+        if os.path.isfile(os.path.join(path, f))]
+    return sorted(p for p in files if p.lower().endswith(IMAGE_EXTENSIONS))

@@ -1,0 +1,224 @@
+"""ctypes bindings for the native runtime (libmmltpu.so).
+
+The reference's native layer arrives as prebuilt JNI/SWIG jars extracted and
+System.load-ed at runtime (core/env/src/main/scala/NativeLoader.java:28);
+ours is in-repo C++ (csrc/) compiled on demand with the baked-in toolchain
+and loaded here via ctypes. Every entry point has a pure-Python fallback at
+its call site, so the package works (slower) without a compiler.
+
+Set MMLSPARK_TPU_NO_NATIVE=1 to force the fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..core.utils import get_logger
+
+log = get_logger("native")
+
+_CSRC = os.path.join(os.path.dirname(__file__), "csrc")
+_BUILD = os.path.join(os.path.dirname(__file__), "_build")
+_SO = os.path.join(_BUILD, "libmmltpu.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(
+        os.path.getmtime(os.path.join(_CSRC, f)) > so_mtime
+        for f in os.listdir(_CSRC))
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mmltpu_free.argtypes = [ctypes.c_void_p]
+    lib.mmltpu_free.restype = None
+    lib.mmltpu_decode_image.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(u8p),
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int)]
+    lib.mmltpu_decode_image.restype = ctypes.c_int
+    lib.mmltpu_resize_bilinear.argtypes = [
+        u8p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        u8p, ctypes.c_int, ctypes.c_int]
+    lib.mmltpu_resize_bilinear.restype = None
+    lib.mmltpu_loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.mmltpu_loader_create.restype = ctypes.c_void_p
+    lib.mmltpu_loader_next.argtypes = [
+        ctypes.c_void_p, u8p, u8p, ctypes.POINTER(ctypes.c_int)]
+    lib.mmltpu_loader_next.restype = ctypes.c_int
+    lib.mmltpu_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.mmltpu_loader_destroy.restype = None
+    lib.mmltpu_csv_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char, ctypes.c_int,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.mmltpu_csv_parse.restype = ctypes.c_int
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Build (if stale) and load libmmltpu.so; None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("MMLSPARK_TPU_NO_NATIVE"):
+            log.info("native runtime disabled by MMLSPARK_TPU_NO_NATIVE")
+            return None
+        try:
+            if _needs_build():
+                os.makedirs(_BUILD, exist_ok=True)
+                r = subprocess.run(
+                    ["make", "-C", _CSRC, f"OUT={_BUILD}"],
+                    capture_output=True, text=True)
+                if r.returncode != 0:
+                    log.warning("native build failed, using fallbacks:\n%s",
+                                r.stderr[-2000:])
+                    return None
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError as e:
+            log.warning("native runtime unavailable (%s), using fallbacks", e)
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def decode_image(data: bytes) -> Optional[np.ndarray]:
+    """Encoded bytes -> HWC uint8 BGR array, or None if undecodable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    h = ctypes.c_int()
+    w = ctypes.c_int()
+    c = ctypes.c_int()
+    rc = lib.mmltpu_decode_image(data, len(data), ctypes.byref(out),
+                                 ctypes.byref(h), ctypes.byref(w),
+                                 ctypes.byref(c))
+    if rc != 0:
+        return None
+    try:
+        n = h.value * w.value * c.value
+        arr = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        lib.mmltpu_free(out)
+    return arr.reshape(h.value, w.value, c.value)
+
+
+def resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """HWC uint8 bilinear resize through the native kernel."""
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native runtime unavailable")
+    img = np.ascontiguousarray(img, dtype=np.uint8)
+    h, w, c = img.shape
+    dst = np.empty((out_h, out_w, c), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.mmltpu_resize_bilinear(
+        img.ctypes.data_as(u8p), h, w, c,
+        dst.ctypes.data_as(u8p), out_h, out_w)
+    return dst
+
+
+class BatchLoader:
+    """Iterate fixed-shape image batches decoded/resized by worker threads.
+
+    Yields (batch[B,H,W,3] uint8 BGR, ok[B] bool, count). The arrays are
+    persistent staging buffers reused across iterations — consumers must
+    device_put (or copy) before advancing, which is exactly the intended
+    use: jax.device_put snapshots into HBM, so the next decode overlaps
+    with TPU compute.
+    """
+
+    def __init__(self, paths: list[str], batch: int, height: int, width: int,
+                 threads: int = 0, prefetch: int = 4):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self.batch, self.height, self.width = batch, height, width
+        if threads <= 0:
+            threads = min(8, os.cpu_count() or 1)
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._handle = lib.mmltpu_loader_create(
+            arr, len(paths), batch, height, width, threads, prefetch)
+        if not self._handle:
+            raise RuntimeError("loader creation failed")
+        self._buf = np.empty((batch, height, width, 3), dtype=np.uint8)
+        self._ok = np.empty((batch,), dtype=np.uint8)
+
+    def __iter__(self):
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        count = ctypes.c_int()
+        while True:
+            rc = self._lib.mmltpu_loader_next(
+                self._handle, self._buf.ctypes.data_as(u8p),
+                self._ok.ctypes.data_as(u8p), ctypes.byref(count))
+            if rc == 0:
+                return
+            yield self._buf, self._ok.astype(bool), count.value
+
+    def close(self):
+        if self._handle:
+            self._lib.mmltpu_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def read_csv(path: str, skip_header: bool = False, delim: str = ",",
+             threads: int = 0) -> Optional[np.ndarray]:
+    """Delimited numeric file -> float32 matrix, or None w/o native lib."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if threads <= 0:
+        threads = min(8, os.cpu_count() or 1)
+    out = ctypes.POINTER(ctypes.c_float)()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.mmltpu_csv_parse(path.encode(), int(skip_header),
+                              delim.encode(), threads, ctypes.byref(out),
+                              ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        return None
+    try:
+        n = rows.value * cols.value
+        if n == 0:
+            return np.zeros((0, max(cols.value, 0)), dtype=np.float32)
+        mat = np.ctypeslib.as_array(out, shape=(n,)).copy()
+    finally:
+        lib.mmltpu_free(out)
+    return mat.reshape(rows.value, cols.value)

@@ -1,0 +1,65 @@
+// mmltpu: native runtime for the TPU-native mmlspark rebuild.
+//
+// The reference ships all native code as prebuilt JNI/SWIG jars (OpenCV
+// imdecode at io/image/src/main/scala/Image.scala:58-75, LightGBM SWIG,
+// CNTK JNI — SURVEY.md L1). This library is the in-repo equivalent for the
+// host-side runtime: image decode, resize, a threaded prefetching batch
+// loader that fills contiguous staging buffers ready for jax.device_put,
+// and a parallel CSV->float32 parser for GBDT ingest.
+//
+// Plain C ABI so Python binds via ctypes (no pybind11 in the image).
+
+#ifndef MMLTPU_H
+#define MMLTPU_H
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// ---- memory ----
+void mmltpu_free(void *p);
+
+// ---- decode ----
+// Decode an encoded image (JPEG/PNG/BMP/PPM, sniffed by magic bytes) into a
+// malloc'd HWC uint8 buffer in BGR channel order (the reference's OpenCV
+// contract, Image.scala:58-75). Returns 0 on success; *out must be released
+// with mmltpu_free.
+int mmltpu_decode_image(const uint8_t *data, size_t len,
+                        uint8_t **out, int *h, int *w, int *c);
+
+// ---- resize ----
+// Bilinear resize of an HWC uint8 image (any channel count) into a caller
+// buffer of out_h*out_w*c bytes.
+void mmltpu_resize_bilinear(const uint8_t *src, int h, int w, int c,
+                            uint8_t *dst, int out_h, int out_w);
+
+// ---- prefetching batch loader ----
+// Reads files from disk, decodes, resizes to (out_h, out_w), and packs
+// fixed-shape batches [batch, out_h, out_w, 3] uint8 BGR into an internal
+// bounded queue from worker threads. The consumer copies each batch into a
+// caller (numpy) staging buffer — the host-side leg of the Arrow->HBM path
+// (SURVEY.md §7 phase 2; replaces the element-wise JNI copies at
+// CNTKModel.scala:67-74).
+void *mmltpu_loader_create(const char *const *paths, int n_paths,
+                           int batch, int out_h, int out_w,
+                           int n_threads, int max_prefetch);
+// Copies the next batch into out (batch*out_h*out_w*3 bytes) and ok
+// (batch bytes; 1 = decoded, 0 = failed/padding, failed slots are
+// zero-filled). *out_count = rows valid in this batch (< batch only on the
+// final partial batch). Returns 1 if a batch was produced, 0 at end.
+int mmltpu_loader_next(void *handle, uint8_t *out, uint8_t *ok,
+                       int *out_count);
+void mmltpu_loader_destroy(void *handle);
+
+// ---- CSV ----
+// Parse a delimited numeric file into a malloc'd row-major float32 matrix.
+// Column count is fixed by the first (non-header) row; short/bad fields
+// parse as NaN. Returns 0 on success; *out released with mmltpu_free.
+int mmltpu_csv_parse(const char *path, int skip_header, char delim,
+                     int n_threads, float **out, int64_t *out_rows,
+                     int64_t *out_cols);
+
+}  // extern "C"
+
+#endif  // MMLTPU_H
