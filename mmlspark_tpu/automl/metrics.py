@@ -48,6 +48,28 @@ def auc_score(y_true: np.ndarray, score: np.ndarray) -> float:
                  / (n_pos * n_neg))
 
 
+def roc_points(y_true: np.ndarray, score: np.ndarray):
+    """(fpr, tpr) arrays swept over every distinct score threshold, for ROC
+    plotting (plot.py) — same statistic auc_score integrates."""
+    y = np.asarray(y_true).astype(np.int64)
+    s = np.asarray(score).astype(np.float64)
+    if len(s) == 0:
+        return np.array([0.0, 1.0]), np.array([0.0, 1.0])
+    order = np.argsort(-s, kind="mergesort")
+    y = y[order]
+    s = s[order]
+    tps = np.cumsum(y == 1).astype(np.float64)
+    fps = np.cumsum(y == 0).astype(np.float64)
+    # keep only the last point of each tied-threshold run
+    keep = np.r_[s[1:] != s[:-1], True]
+    tps, fps = tps[keep], fps[keep]
+    n_pos = max(tps[-1] if len(tps) else 0.0, 1.0)
+    n_neg = max(fps[-1] if len(fps) else 0.0, 1.0)
+    tpr = np.r_[0.0, tps / n_pos]
+    fpr = np.r_[0.0, fps / n_neg]
+    return fpr, tpr
+
+
 def confusion_matrix(y_true: np.ndarray, y_pred: np.ndarray) -> np.ndarray:
     y = np.asarray(y_true).astype(np.int64)
     p = np.asarray(y_pred).astype(np.int64)

@@ -33,7 +33,7 @@ class CheckpointData(Transformer):
     removeCheckpoint = BooleanParam("unpersist instead", default=False)
 
     def transform(self, df: DataFrame) -> DataFrame:
-        return df.unpersist() if self.getRemoveCheckpoint() else df.persist()
+        return df.unpersist() if self.getRemoveCheckpoint() else df.cache()
 
 
 class DropColumns(Transformer):
@@ -129,11 +129,15 @@ class MultiColumnAdapter(Transformer):
     def transform(self, df: DataFrame) -> DataFrame:
         for i, o in self._pairs():
             stage = self.getBaseStage().copy({"inputCol": i, "outputCol": o})
-            if isinstance(stage, Estimator):
-                df = stage.fit(df).transform(df)
-            else:
-                df = stage.transform(df)
+            df = _run_stage(stage, df)
         return df
+
+
+def _run_stage(stage, df: DataFrame) -> DataFrame:
+    """Fit-then-transform an Estimator, or transform a Transformer."""
+    if isinstance(stage, Estimator):
+        return stage.fit(df).transform(df)
+    return stage.transform(df)
 
 
 class Timer(Transformer):
@@ -152,13 +156,30 @@ class Timer(Transformer):
             import jax.profiler
             with jax.profiler.TraceAnnotation(
                     f"Timer/{type(inner).__name__}"):
-                out = (inner.fit(df).transform(df)
-                       if isinstance(inner, Estimator) else inner.transform(df))
+                out = _run_stage(inner, df)
         else:
-            out = (inner.fit(df).transform(df)
-                   if isinstance(inner, Estimator) else inner.transform(df))
+            out = _run_stage(inner, df)
         dt = time.perf_counter() - t0
         if self.getLogToConsole():
             log.warning("%s took %.3fs", type(inner).__name__, dt)
         self._last_seconds = dt
+        return out
+
+
+class Profiler(Transformer):
+    """Bracket an inner stage in a jax.profiler trace written to
+    ``traceDir`` for xplane/TensorBoard tooling — the first-class profiling
+    stage the reference lacks (SURVEY.md §5: reference tracing is only the
+    wall-clock Timer, pipeline-stages/.../Timer.scala:36-70)."""
+    stage = ComplexParam("inner PipelineStage", default=None)
+    traceDir = StringParam("directory for the xplane trace", default="")
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        import jax
+        inner = self.getStage()
+        trace_dir = self.getTraceDir() or None
+        if trace_dir is None:
+            return _run_stage(inner, df)
+        with jax.profiler.trace(trace_dir):
+            out = _run_stage(inner, df)
         return out

@@ -35,8 +35,8 @@ from mmlspark_tpu.stages import (Cacher, CheckpointData, ClassBalancer,
                                  CleanMissingData, DataConversion,
                                  DropColumns, EnsembleByKey, FlattenBatch,
                                  MiniBatchTransformer, MultiColumnAdapter,
-                                 PartitionSample, RenameColumn, Repartition,
-                                 SelectColumns, SummarizeData,
+                                 PartitionSample, Profiler, RenameColumn,
+                                 Repartition, SelectColumns, SummarizeData,
                                  TextPreprocessor, Timer, UDFTransformer)
 
 # ---------------------------------------------------------------- fixtures
@@ -225,6 +225,8 @@ _t(MultiColumnAdapter, lambda: TestObject(
 _t(Timer, lambda: TestObject(
     Timer().setStage(DropColumns().setCols(("a",))).setLogToConsole(False),
     TAB))
+_t(Profiler, lambda: TestObject(
+    Profiler().setStage(DropColumns().setCols(("a",))), TAB))
 _t(CleanMissingData, lambda: TestObject(
     CleanMissingData().setInputCols(("a",)).setCleaningMode("Median"), TAB))
 _t(DataConversion, lambda: TestObject(
