@@ -1,0 +1,246 @@
+"""Codegen: single-source-of-truth artifacts reflected from the Param DSL.
+
+Re-design of the reference's codegen layer (reference:
+src/codegen/src/main/scala/CodeGen.scala:44-96), which reflects every
+``PipelineStage`` out of the built jars and emits PySpark/SparklyR wrappers,
+per-stage smoke tests (PySparkWrapperTest.scala) and Sphinx docs (DocGen.scala).
+
+This framework is Python-first so wrappers invert (SURVEY.md §2.6): the Param
+DSL *is* the API. What codegen still owes the user, generated from the same
+single source of truth (the stage registry + Param descriptors):
+
+  * ``generate_docs``   — markdown API reference, one page per stage with the
+    param table (name/type/default/domain/doc), plus an index (DocGen analog);
+  * ``generate_stubs``  — ``.pyi`` typing stubs declaring the metaclass-made
+    ``setFoo``/``getFoo`` accessors so IDEs/type-checkers see the full
+    surface (PySparkWrapper analog);
+  * ``generate_smoke_tests`` — a pytest file with one construct/param-
+    round-trip/copy test per stage (PySparkWrapperTest analog).
+
+All three iterate ``registered_stages()`` the way CodeGen iterates jars, so a
+new stage is covered the moment its class is defined.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+
+from ..core.params import Param
+from ..core.pipeline import (Estimator, Model, Transformer, registered_stages)
+
+_NO_DEFAULT_REPR = "(required)"
+
+
+def _framework_stages() -> dict[str, type]:
+    return {q: c for q, c in registered_stages().items()
+            if q.startswith("mmlspark_tpu.")}
+
+
+def _kind(cls: type) -> str:
+    if issubclass(cls, Model):
+        return "Model"
+    if issubclass(cls, Estimator):
+        return "Estimator"
+    if issubclass(cls, Transformer):
+        return "Transformer"
+    return "PipelineStage"
+
+
+def _ptype_name(p: Param) -> str:
+    if p.ptype is None:
+        return "complex" if not p.jsonable else "any"
+    if isinstance(p.ptype, tuple):
+        return "/".join(t.__name__ for t in p.ptype)
+    return p.ptype.__name__
+
+
+def _default_repr(p: Param) -> str:
+    return repr(p.default) if p.has_default else _NO_DEFAULT_REPR
+
+
+# --------------------------------------------------------------------- docs
+
+def stage_doc_markdown(cls: type) -> str:
+    """One markdown page for a stage: docstring + param table."""
+    lines = [f"# {cls.__name__}", ""]
+    lines.append(f"*{_kind(cls)}* — `{cls.__module__}.{cls.__qualname__}`")
+    lines.append("")
+    if cls.__doc__:
+        lines.append(cls.__doc__.strip())
+        lines.append("")
+    params = cls.params()
+    if params:
+        lines.append("## Parameters")
+        lines.append("")
+        lines.append("| name | type | default | doc |")
+        lines.append("|---|---|---|---|")
+        for name in sorted(params):
+            p = params[name]
+            doc = (p.doc or "").replace("|", "\\|").replace("\n", " ")
+            lines.append(f"| `{name}` | {_ptype_name(p)} "
+                         f"| `{_default_repr(p)}` | {doc} |")
+        lines.append("")
+        lines.append("Accessors: " + ", ".join(
+            f"`set{n[0].upper()+n[1:]}` / `get{n[0].upper()+n[1:]}`"
+            for n in sorted(params)))
+        lines.append("")
+    return "\n".join(lines)
+
+
+def generate_docs(out_dir: str) -> list[str]:
+    """Write one markdown page per registered stage + an index; returns the
+    written paths (reference DocGen.scala emits .rst the same way)."""
+    os.makedirs(out_dir, exist_ok=True)
+    by_module: dict[str, list[type]] = defaultdict(list)
+    paths = []
+    for qual, cls in sorted(_framework_stages().items()):
+        module = qual.split(".")[1]  # mmlspark_tpu.<pkg>...
+        by_module[module].append(cls)
+        path = os.path.join(out_dir, f"{cls.__name__}.md")
+        with open(path, "w") as f:
+            f.write(stage_doc_markdown(cls))
+        paths.append(path)
+    index = [
+        "# API reference", "",
+        "Generated from the stage registry by `mmlspark_tpu.codegen` — "
+        "do not edit by hand; regenerate with "
+        "`python -m mmlspark_tpu.codegen`.", "",
+    ]
+    for module in sorted(by_module):
+        index.append(f"## {module}")
+        index.append("")
+        for cls in sorted(by_module[module], key=lambda c: c.__name__):
+            first = (cls.__doc__ or "").strip().split("\n")[0]
+            index.append(f"- [{cls.__name__}]({cls.__name__}.md) "
+                         f"(*{_kind(cls)}*) — {first}")
+        index.append("")
+    path = os.path.join(out_dir, "index.md")
+    with open(path, "w") as f:
+        f.write("\n".join(index))
+    paths.append(path)
+    return paths
+
+
+# -------------------------------------------------------------------- stubs
+
+_PYI_TYPES = {"bool": "bool", "int": "int", "float": "float", "str": "str",
+              "dict": "dict", "list/tuple": "list | tuple"}
+
+
+def _pyi_type(p: Param) -> str:
+    return _PYI_TYPES.get(_ptype_name(p), "object")
+
+
+def stage_stub(cls: type) -> str:
+    """.pyi class body declaring every generated accessor."""
+    lines = [f"class {cls.__name__}:"]
+    params = cls.params()
+    if not params:
+        lines.append("    ...")
+        return "\n".join(lines)
+    for name in sorted(params):
+        p = params[name]
+        cap = name[0].upper() + name[1:]
+        t = _pyi_type(p)
+        lines.append(f"    {name}: {t}")
+        lines.append(f"    def set{cap}(self, value: {t}) -> "
+                     f"\"{cls.__name__}\": ...")
+        lines.append(f"    def get{cap}(self) -> {t}: ...")
+    return "\n".join(lines)
+
+
+def generate_stubs(out_dir: str) -> list[str]:
+    """Write one ``<module>.pyi`` per framework module containing stage stubs
+    (the role of the reference's generated PySpark wrapper classes,
+    PySparkWrapper.scala:33-160: make the set/get surface visible to tools)."""
+    os.makedirs(out_dir, exist_ok=True)
+    by_srcmod: dict[str, list[type]] = defaultdict(list)
+    for qual, cls in sorted(_framework_stages().items()):
+        by_srcmod[cls.__module__].append(cls)
+    paths = []
+    for mod in sorted(by_srcmod):
+        rel = mod.replace(".", os.sep) + ".pyi"
+        path = os.path.join(out_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        chunks = ["# Generated by mmlspark_tpu.codegen — do not edit.", ""]
+        for cls in sorted(by_srcmod[mod], key=lambda c: c.__name__):
+            chunks.append(stage_stub(cls))
+            chunks.append("")
+        with open(path, "w") as f:
+            f.write("\n".join(chunks))
+        paths.append(path)
+    return paths
+
+
+# -------------------------------------------------------------- smoke tests
+
+def generate_smoke_tests(out_path: str) -> str:
+    """Write a pytest module with one generated test per stage: construct,
+    set/get round-trip every simple param, copy(), repr (reference
+    PySparkWrapperTest.scala emits one python smoke test per wrapped stage).
+    Values are synthesized from the param type + validator."""
+    lines = [
+        '"""Generated by mmlspark_tpu.codegen — do not edit."""',
+        "import pytest",
+        "import mmlspark_tpu  # populate the registry",
+        "from mmlspark_tpu.core.pipeline import lookup_stage_class",
+        "from mmlspark_tpu.codegen import synth_value",
+        "",
+    ]
+    for qual, cls in sorted(_framework_stages().items()):
+        name = cls.__name__
+        lines += [
+            f"def test_{name}_params():",
+            f"    cls = lookup_stage_class({qual!r})",
+            "    stage = cls()",
+            "    for pname, p in cls.params().items():",
+            "        value = synth_value(p, stage)",
+            "        if value is NotImplemented:",
+            "            continue",
+            "        getattr(stage, 'set' + pname[0].upper() + pname[1:])(value)",
+            "        got = getattr(stage, 'get' + pname[0].upper() + pname[1:])()",
+            "        assert got == value or got is value",
+            "    clone = stage.copy()",
+            "    assert clone._paramMap == stage._paramMap",
+            "    assert cls.__name__ in repr(stage)",
+            "",
+        ]
+    parent = os.path.dirname(os.path.abspath(out_path))
+    os.makedirs(parent, exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines))
+    return out_path
+
+
+def synth_value(p: Param, stage=None):
+    """A legal value for a param, derived from type + default + validator;
+    NotImplemented when no safe value can be synthesized (complex params)."""
+    if not p.jsonable:
+        return NotImplemented
+    if p.has_default and p.default is not None:
+        return p.default
+    t = _ptype_name(p)
+    candidates = {
+        "bool": [True, False],
+        "int": [1, 2, 10, 100, 0],
+        "float": [0.5, 1.0, 0.0, 2.0],
+        "str": ["x"],
+        "dict": [{}],
+        "list/tuple": [()],
+    }.get(t, [None])
+    for v in candidates:
+        try:
+            p.validate(v)
+            return v
+        except Exception:
+            continue
+    return NotImplemented
+
+
+def generate_all(repo_root: str) -> dict[str, list[str]]:
+    docs = generate_docs(os.path.join(repo_root, "docs", "api"))
+    stubs = generate_stubs(os.path.join(repo_root, "stubs"))
+    tests = [generate_smoke_tests(
+        os.path.join(repo_root, "tests", "test_generated_smoke.py"))]
+    return {"docs": docs, "stubs": stubs, "tests": tests}

@@ -1,0 +1,13 @@
+"""Regenerate all codegen artifacts in-repo: ``python -m mmlspark_tpu.codegen``."""
+
+import os
+import sys
+
+from . import generate_all
+
+root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if len(sys.argv) > 1:
+    root = sys.argv[1]
+out = generate_all(root)
+for kind, paths in out.items():
+    print(f"{kind}: {len(paths)} files")

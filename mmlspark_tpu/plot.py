@@ -31,8 +31,16 @@ def confusionMatrix(df, y_col: str, y_hat_col: str, labels=None, ax=None):
     y = _column(df, y_col)
     y_hat = _column(df, y_hat_col)
     accuracy = float(np.mean(y == y_hat))
-    # map arbitrary (possibly string) labels to indices for the count matrix
+    # map arbitrary (possibly string) labels to indices for the count matrix;
+    # when `labels` names the class values themselves, its ORDER defines the
+    # matrix axes (not just the tick text)
     uniq = np.unique(np.concatenate([y, y_hat]))
+    if labels is not None:
+        if len(labels) != len(uniq):
+            raise ValueError(f"labels has {len(labels)} entries but data has "
+                             f"{len(uniq)} distinct values {uniq.tolist()}")
+        if set(labels) == set(uniq.tolist()):
+            uniq = np.asarray(labels)
     lut = {v: i for i, v in enumerate(uniq)}
     y_idx = np.array([lut[v] for v in y], dtype=np.int64)
     yh_idx = np.array([lut[v] for v in y_hat], dtype=np.int64)

@@ -81,7 +81,10 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
         if self.getVectorized():
             out = fn(col)
         else:
-            out = np.array([fn(v) for v in col])
+            # hand the raw row results to withColumn's canonical column
+            # builder: sequence/array results become an object column (ragged
+            # rows included), scalars a typed array — never a 2D matrix
+            out = [fn(v) for v in col]
         return df.withColumn(self.getOutputCol(), out)
 
 

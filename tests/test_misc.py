@@ -132,3 +132,31 @@ def test_profiler_no_dir_passthrough():
     df = DataFrame({"a": np.arange(4.0), "b": np.arange(4.0)})
     out = Profiler().setStage(DropColumns().setCols(("a",))).transform(df)
     assert out.columns == ["b"]
+
+
+def test_udf_ragged_vectors_canonical():
+    # row results that are sequences must land as an object column, even
+    # ragged, matching the canonical vector representation
+    df = DataFrame({"n": np.array([1, 2, 3])})
+    out = (UDFTransformer().setInputCol("n").setOutputCol("v")
+           .setUdf(lambda k: np.ones(int(k), dtype=np.float32)).transform(df))
+    col = out.col("v")
+    assert col.dtype == object
+    assert [len(v) for v in col] == [1, 2, 3]
+
+
+def test_confusion_labels_define_order():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from mmlspark_tpu import plot
+    df = DataFrame({"y": np.array(["neg", "pos", "pos", "neg"], dtype=object),
+                    "p": np.array(["neg", "pos", "neg", "neg"], dtype=object)})
+    ax = plot.confusionMatrix(df, "y", "p", labels=["pos", "neg"])
+    # row 0 must now be the "pos" class: 1 correct pos, 1 pos predicted neg
+    img = ax.images[0].get_array()
+    assert img[0, 0] == 0.5 and img[0, 1] == 0.5
+    plt.close("all")
+    with pytest.raises(ValueError):
+        plot.confusionMatrix(df, "y", "p", labels=["a", "b", "c"])
+    plt.close("all")
