@@ -1,0 +1,38 @@
+"""Example 106 — gradient-boosted trees (reference: notebooks/samples/
+"106 - Quantile Regression with LightGBM": LightGBMRegressor with
+objective=quantile, plus a LightGBMClassifier fit — the socket-collective
+boosting path, here histogram boosting as XLA kernels).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import LightGBMClassifier, LightGBMRegressor
+
+rng = np.random.default_rng(0)
+n = 500
+x = rng.normal(size=(n, 6)).astype(np.float32)
+feats = object_column([row for row in x])
+
+# regression target with heteroscedastic noise — quantile objective territory
+y_reg = (2.0 * x[:, 0] - x[:, 1] + rng.normal(0, 0.5 + 0.5 * (x[:, 2] > 0), n))
+reg_df = DataFrame({"features": feats, "label": y_reg.astype(np.float64)})
+reg = (LightGBMRegressor()
+       .setApplication("quantile").setAlpha(0.5)
+       .setNumIterations(30).setNumLeaves(15))
+reg_model = reg.fit(reg_df)
+pred = reg_model.transform(reg_df).col("prediction")
+resid = np.abs(np.asarray(pred) - y_reg)
+print("median |resid|:", round(float(np.median(resid)), 3))
+assert np.median(resid) < 1.5
+
+# classification
+y_cls = (x[:, 0] + x[:, 3] > 0).astype(np.int64)
+cls_df = DataFrame({"features": feats, "label": y_cls})
+cls = LightGBMClassifier().setNumIterations(30).setNumLeaves(15)
+scored = cls.fit(cls_df).transform(cls_df)
+acc = float(np.mean(np.asarray(scored.col("prediction")) == y_cls))
+print("train accuracy:", round(acc, 3))
+assert acc > 0.85
+print("example 106 OK")

@@ -1,0 +1,40 @@
+"""Example 304 — sequence tagging (reference: notebooks/samples/
+"304 - Medical Entity Extraction": a pre-trained BiLSTM evaluated through
+CNTKModel over token-id windows; here the BiLSTM is a flax module run
+batched through TpuModel, and the long-context transformer shows the path
+the reference lacks).
+"""
+
+import numpy as np
+
+import jax
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuModel, build_model
+
+rng = np.random.default_rng(0)
+n, T, V, C = 16, 24, 200, 5
+tokens = rng.integers(0, V, size=(n, T))
+df = DataFrame({"features": object_column(
+    [t.astype(np.float32) for t in tokens])})
+
+# BiLSTM tagger: per-token logits (B, T, C)
+cfg = {"type": "bilstm", "vocab_size": V, "embed_dim": 16, "hidden": 16,
+       "num_classes": C}
+module = build_model(cfg)
+params = module.init(jax.random.PRNGKey(0), np.zeros((1, T), np.int32))
+tagger = (TpuModel().setInputCol("features").setOutputCol("tags")
+          .setModelConfig(cfg).setModelParams(params))
+out = tagger.transform(df)
+tags = np.asarray(out.col("tags")[0])
+assert tags.shape == (T, C)
+
+# the same rows through a transformer encoder (pool="none" keeps per-token)
+tcfg = {"type": "transformer", "vocab_size": V, "d_model": 16, "heads": 2,
+        "layers": 1, "num_classes": C, "max_len": 64, "pool": "none"}
+tmod = build_model(tcfg)
+tparams = tmod.init(jax.random.PRNGKey(1), np.zeros((1, T), np.int32))
+tout = (TpuModel().setInputCol("features").setOutputCol("tags")
+        .setModelConfig(tcfg).setModelParams(tparams).transform(df))
+assert np.asarray(tout.col("tags")[0]).shape == (T, C)
+print("example 304 OK")

@@ -1,0 +1,35 @@
+"""Example 401 — distributed CNN training (reference: notebooks/gpu/
+"401 - CNTK train on HDFS": CIFAR ConvNet trained data-parallel over MPI on
+GPU VMs; here ONE jitted train step over a jax.sharding.Mesh — the same
+script runs on 1 chip, a v5e-8 slice, or the multi-host CPU test mesh).
+"""
+
+import numpy as np
+
+import jax
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuLearner
+
+rng = np.random.default_rng(0)
+n = 64
+x = rng.normal(size=(n, 3 * 16 * 16)).astype(np.float32)
+# two classes separated along the first pixels so one epoch makes progress
+y = (x[:, :32].mean(axis=1) > 0).astype(np.int64)
+x[:, :32] += y[:, None] * 2.0
+df = DataFrame({"features": object_column([r for r in x]), "label": y})
+
+tp = 2 if len(jax.devices()) % 2 == 0 and len(jax.devices()) > 1 else 1
+learner = (TpuLearner()
+           .setModelConfig({"type": "convnet", "channels": [8, 8],
+                            "dense": 32, "num_classes": 2})
+           .setInputShape((3, 16, 16))
+           .setEpochs(3).setBatchSize(32).setLearningRate(0.05)
+           .setTensorParallel(tp))
+model = learner.fit(df)
+scored = model.transform(df)
+pred = np.stack([np.asarray(s) for s in scored.col("scores")]).argmax(1)
+acc = float((pred == y).mean())
+print("train accuracy:", round(acc, 3), "| tp =", tp)
+assert acc > 0.6
+print("example 401 OK")

@@ -218,6 +218,9 @@ class TransformerEncoder(nn.Module):
     attn_fn: Optional[Callable] = None
     attn_impl: str = "blockwise"   # "blockwise" | "flash" (Pallas kernel)
     block_size: int = 512
+    num_experts: int = 0           # > 0 swaps the FFN for a MoE block (EP)
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
 
     def layer_names(self):
         return ["embed"] + [f"block{i}" for i in range(self.layers)] + ["logits"]
@@ -233,7 +236,8 @@ class TransformerEncoder(nn.Module):
                                    causal=self.causal)
 
     @nn.compact
-    def __call__(self, tokens, output_layer: Optional[str] = None):
+    def __call__(self, tokens, output_layer: Optional[str] = None,
+                 row_mask=None):
         tap = _LayerTap(output_layer)
         B, T = tokens.shape
         if T > self.max_len:
@@ -258,8 +262,16 @@ class TransformerEncoder(nn.Module):
             a = self._attention(q, k, v).reshape(B, T, self.d_model)
             x = x + nn.Dense(self.d_model, use_bias=False, dtype=self.dtype)(a)
             h = nn.LayerNorm(dtype=self.dtype)(x)
-            h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype)(h)
-            h = nn.Dense(self.d_model, dtype=self.dtype)(nn.gelu(h))
+            if self.num_experts > 0:
+                from .moe import MoEMLP
+                h = MoEMLP(num_experts=self.num_experts,
+                           d_hidden=self.mlp_ratio * self.d_model,
+                           top_k=self.expert_top_k,
+                           capacity_factor=self.capacity_factor,
+                           dtype=self.dtype)(h, row_mask=row_mask)
+            else:
+                h = nn.Dense(self.mlp_ratio * self.d_model, dtype=self.dtype)(h)
+                h = nn.Dense(self.d_model, dtype=self.dtype)(nn.gelu(h))
             x = tap.tap(f"block{i}", x + h)
             if tap.done:
                 return tap.result.astype(jnp.float32)
@@ -307,6 +319,9 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
         pool=cfg.get("pool", "mean"),
         block_size=cfg.get("block_size", 512),
         attn_impl=cfg.get("attn_impl", "blockwise"),
+        num_experts=cfg.get("num_experts", 0),
+        expert_top_k=cfg.get("expert_top_k", 2),
+        capacity_factor=cfg.get("capacity_factor", 1.25),
         attn_fn=attn_fn),
 }
 

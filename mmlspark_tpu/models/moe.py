@@ -1,0 +1,119 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+The reference has no expert parallelism (SURVEY.md §2.7: data parallelism
+only). This module designs it in TPU-first: token-choice top-k routing with a
+static capacity bound, dense one-hot dispatch/combine einsums (Mesh-TF /
+Switch-Transformer formulation) — every shape static, every op an MXU matmul,
+so XLA can partition the expert dimension over an ``expert`` mesh axis and
+insert the dispatch all-to-alls itself when expert weights carry
+``P("expert", ...)`` shardings (see models.trainer EP rules).
+
+Routing/auxiliary math runs in float32; expert matmuls in bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoEMLP(nn.Module):
+    """Capacity-bounded top-k MoE feed-forward block: (B, T, d) -> (B, T, d).
+
+    Tokens overflowing an expert's capacity ``C = capacity_factor * S * k / E``
+    are dropped (their combine weight is 0 — residual connections carry them),
+    the standard Switch/GShard behavior that keeps shapes static for XLA.
+
+    Sows the Switch load-balancing auxiliary loss under
+    ``intermediates/moe_aux_loss``; callers that train MoE models should add
+    it to the objective (models.trainer does when ``moeAuxWeight`` > 0).
+    """
+    num_experts: int
+    d_hidden: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, row_mask=None):
+        """row_mask: optional (B,) weights; 0-rows (mesh padding, see
+        parallel.mesh.pad_batch_to_devices) neither claim expert capacity nor
+        contribute to the balancing statistics."""
+        B, T, d = x.shape
+        S = B * T
+        E = self.num_experts
+        k = min(self.top_k, E)
+        C = max(1, int(self.capacity_factor * S * k / E))
+        xf = x.reshape(S, d)
+        tok_w = (jnp.repeat(row_mask.astype(jnp.float32), T)
+                 if row_mask is not None else jnp.ones((S,), jnp.float32))
+
+        gate_w = self.param("gate", nn.initializers.lecun_normal(), (d, E),
+                            jnp.float32)
+        # expert weight stacks: leading E axis is what EP shards
+        w1 = self.param("expert_w1", nn.initializers.lecun_normal(),
+                        (E, d, self.d_hidden), jnp.float32)
+        b1 = self.param("expert_b1", nn.initializers.zeros, (E, self.d_hidden),
+                        jnp.float32)
+        w2 = self.param("expert_w2", nn.initializers.lecun_normal(),
+                        (E, self.d_hidden, d), jnp.float32)
+        b2 = self.param("expert_b2", nn.initializers.zeros, (E, d),
+                        jnp.float32)
+
+        logits = jnp.einsum("sd,de->se", xf.astype(jnp.float32), gate_w)
+        probs = jax.nn.softmax(logits, axis=-1)              # (S, E) f32
+        gate_vals, sel = lax.top_k(probs, k)                 # (S, k)
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)          # renormalize
+
+        # Switch aux loss: E * sum_e fraction_routed_e * mean_prob_e
+        # (fraction from top-1 assignments, prob from the full softmax),
+        # averaged over VALID tokens only
+        denom = jnp.maximum(tok_w.sum(), 1.0)
+        top1 = jax.nn.one_hot(sel[:, 0], E, dtype=jnp.float32)
+        frac = (top1 * tok_w[:, None]).sum(0) / denom
+        mean_prob = (probs * tok_w[:, None]).sum(0) / denom
+        aux = E * jnp.sum(frac * mean_prob)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        # capacity-bounded dispatch: slot-major priority (all tokens' 1st
+        # choice before any 2nd choice), token order within a slot
+        counts = jnp.zeros((E,), jnp.float32)
+        dispatch = jnp.zeros((S, E, C), jnp.float32)
+        combine = jnp.zeros((S, E, C), jnp.float32)
+        for j in range(k):                                   # k static, tiny
+            oh = jax.nn.one_hot(sel[:, j], E, dtype=jnp.float32)   # (S, E)
+            oh = oh * (tok_w > 0)[:, None]    # padding never claims capacity
+            pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh    # (S, E)
+            keep = oh * (pos < C)
+            slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                                  dtype=jnp.float32)               # (S, E, C)
+            dispatch = dispatch + keep[..., None] * slot
+            combine = combine + (gate_vals[:, j][:, None, None]
+                                 * keep[..., None] * slot)
+            counts = counts + keep.sum(0)
+
+        # expert compute: three MXU einsums over (E, C, ·) buffers
+        xin = jnp.einsum("sec,sd->ecd", dispatch.astype(self.dtype),
+                         xf.astype(self.dtype))
+        h = jnp.einsum("ecd,edh->ech", xin, w1.astype(self.dtype))
+        h = nn.gelu(h + b1[:, None, :].astype(self.dtype))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(self.dtype))
+        out = out + b2[:, None, :].astype(self.dtype)
+        y = jnp.einsum("sec,ecd->sd", combine.astype(self.dtype), out)
+        return y.reshape(B, T, d).astype(x.dtype)
+
+
+def read_moe_aux_loss(intermediates) -> jnp.ndarray:
+    """Sum every sown ``moe_aux_loss`` leaf in an ``intermediates``
+    collection (other sown intermediates are ignored)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(intermediates)
+    total = jnp.asarray(0.0, jnp.float32)
+    for path, leaf in flat:
+        if any("moe_aux_loss" in str(getattr(p, "key", p)) for p in path):
+            total = total + jnp.sum(leaf)
+    return total

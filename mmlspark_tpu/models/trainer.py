@@ -101,6 +101,10 @@ class TpuLearner(Estimator):
                                 "(transformer only)", default=1, min=1)
     spMode = StringParam("sequence-parallel collective form", default="ring",
                          choices=("ring", "ulysses"))
+    expertParallel = IntParam("size of the expert (EP) mesh axis (MoE "
+                              "transformer only)", default=1, min=1)
+    moeAuxWeight = FloatParam("weight of the MoE load-balancing aux loss",
+                              default=0.01, min=0.0)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -142,7 +146,11 @@ class TpuLearner(Estimator):
 
         tp = self.getTensorParallel()
         sp = self.getSequenceParallel()
+        ep = self.getExpertParallel()
         attn_fn = None
+        if sp > 1 and ep > 1:
+            raise ValueError("sequenceParallel and expertParallel cannot both "
+                             "exceed 1 (compose dp x sp or dp x ep meshes)")
         if sp > 1:
             if cfg.get("type") != "transformer":
                 raise ValueError("sequenceParallel>1 requires a transformer "
@@ -161,6 +169,20 @@ class TpuLearner(Estimator):
             attn_fn = sequence.make_sp_attention(
                 mesh, axis_name="seq", mode=self.getSpMode(),
                 causal=cfg.get("causal", False))
+        elif ep > 1:
+            if cfg.get("type") != "transformer" or not cfg.get("num_experts"):
+                raise ValueError("expertParallel>1 requires a transformer "
+                                 "model with num_experts set")
+            if cfg["num_experts"] % ep != 0:
+                raise ValueError(f"num_experts ({cfg['num_experts']}) must be "
+                                 f"divisible by expertParallel ({ep})")
+            n_dev = len(jax.devices())
+            if n_dev % (ep * tp) != 0 or ep * tp > n_dev:
+                raise ValueError(
+                    f"expertParallel*tensorParallel = {ep}*{tp} must divide "
+                    f"the device count ({n_dev})")
+            mesh = meshlib.make_mesh({"data": n_dev // (ep * tp),
+                                      "expert": ep, "model": tp})
         else:
             mesh = meshlib.create_mesh(model=tp)
         module = build_model(cfg, attn_fn=attn_fn)
@@ -171,26 +193,48 @@ class TpuLearner(Estimator):
         params = module.init(rng, jnp.asarray(x[:init_b]))
         tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
                             self.getMomentum(), self.getWeightDecay())
-        opt_state = tx.init(params)
         loss_fn = make_loss(self.getLoss(), per_example=True)
 
         # placement: params/opt replicated (TP rules shard wide dense kernels
-        # over `model`); batch sharded over `data`. XLA derives the gradient
-        # all-reduce + any TP collectives from these shardings alone.
+        # over `model`; EP rules shard stacked expert weights over `expert`);
+        # batch sharded over `data`. XLA derives the gradient all-reduce +
+        # any TP/EP collectives from these shardings alone.
+        from jax.sharding import PartitionSpec as P
+        rules = []
+        if ep > 1:
+            rules += [("expert_w", P("expert",)), ("expert_b", P("expert",))]
         if tp > 1:
-            from jax.sharding import PartitionSpec as P
-            rules = [("Dense", P(None, "model")), ("kernel", P())]
+            rules += [("Dense", P(None, "model")), ("kernel", P())]
+        if rules:
             params = meshlib.shard_params_tp(params, mesh, rules)
         else:
             params = jax.device_put(params, meshlib.replicated(mesh))
-        opt_state = jax.device_put(opt_state, meshlib.replicated(mesh))
+        # init AFTER placement: optax's zeros_like buffers inherit the
+        # param shardings (expert/model axes) instead of being replicated
+        opt_state = tx.init(params)
+
+        is_moe = cfg.get("num_experts", 0) > 0
+        moe_aux = self.getMoeAuxWeight() if is_moe else 0.0
 
         @jax.jit
         def train_step(params, opt_state, xb, yb, wb):
             # weighted mean so mesh-padding rows (weight 0) carry no gradient
             def compute(p):
-                losses = loss_fn(module.apply(p, xb), yb)
-                return jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                # MoE routing must see the row weights too: padded rows may
+                # not claim expert capacity or skew the balancing stats
+                kw = {"row_mask": wb} if is_moe else {}
+                if moe_aux > 0.0:
+                    preds, inter = module.apply(p, xb,
+                                                mutable=["intermediates"],
+                                                **kw)
+                    from .moe import read_moe_aux_loss
+                    aux = read_moe_aux_loss(inter["intermediates"])
+                else:
+                    preds = module.apply(p, xb, **kw)
+                    aux = 0.0
+                losses = loss_fn(preds, yb)
+                main = jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+                return main + moe_aux * aux
             loss, grads = jax.value_and_grad(compute)(params)
             updates, opt2 = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt2, loss

@@ -1,6 +1,11 @@
-from . import mesh
-from .mesh import (batch_sharding, create_mesh, pad_batch_to_devices,
-                   replicated, shard_batch, shard_params_tp)
+from . import distributed, mesh, pipeline_parallel, sequence
+from .mesh import (batch_sharding, create_mesh, make_mesh,
+                   pad_batch_to_devices, replicated, shard_batch,
+                   shard_params_tp)
+from .pipeline_parallel import (pipeline_apply, shard_pipeline_params,
+                                stack_stage_params)
 
-__all__ = ["mesh", "create_mesh", "batch_sharding", "replicated",
-           "shard_batch", "pad_batch_to_devices", "shard_params_tp"]
+__all__ = ["mesh", "sequence", "distributed", "pipeline_parallel",
+           "create_mesh", "make_mesh", "batch_sharding", "replicated",
+           "shard_batch", "pad_batch_to_devices", "shard_params_tp",
+           "pipeline_apply", "stack_stage_params", "shard_pipeline_params"]

@@ -1,0 +1,114 @@
+"""Multi-host distributed backend: JAX coordination-service rendezvous.
+
+Replaces both multi-node rendezvous mechanisms in the reference (SURVEY.md
+§2.7): the LightGBM machine-list/port handshake assembled from Spark executor
+discovery (reference: lightgbm/.../LightGBMUtils.scala:98-160 feeding
+``LGBM_NetworkInit``, TrainUtils.scala:141-142) and the MPI hostfile written
+for ssh'd ``mpirun`` (cntk-train/.../CommandBuilders.scala:135-147,241-243).
+
+Here every host process calls ``initialize(...)`` (or ``initialize_from_env``
+under a launcher that exports the coordinator address); JAX's coordination
+service does the rendezvous over DCN, after which ``jax.devices()`` spans the
+whole pod/slice and a single global ``Mesh`` drives ICI/DCN collectives — no
+ssh, no hostfiles, no socket rings.
+
+Single-process (local[*]-style) use needs no initialize call at all — the
+same code paths run on the local devices, the analog of the reference's
+partitions-as-workers local mode (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+
+from ..core.utils import get_logger
+from . import mesh as meshlib
+
+log = get_logger("distributed")
+
+# launcher-agnostic env contract (set by the Spark-executor / TPU-VM launcher)
+ENV_COORDINATOR = "MMLTPU_COORDINATOR"       # "host:port" of process 0
+ENV_NUM_PROCESSES = "MMLTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "MMLTPU_PROCESS_ID"
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None) -> None:
+    """Join the global JAX runtime. Process 0's address is the rendezvous
+    point (the machine-list/hostfile role); blocks until all processes check
+    in, like LGBM_NetworkInit's 120s barrier — but heartbeated and reusable
+    across every collective rather than per-training-job."""
+    global _initialized
+    if _initialized:
+        log.info("distributed runtime already initialized; skipping")
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+    log.info("distributed init: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def initialize_from_env() -> bool:
+    """Initialize from the MMLTPU_* env contract when present (the launcher
+    writes it per executor the way the reference's driver writes
+    hostfile.txt). Returns True when distributed init ran; False means
+    single-process mode — both are valid, same downstream code."""
+    addr = os.environ.get(ENV_COORDINATOR)
+    if not addr:
+        return False
+    initialize(coordinator_address=addr,
+               num_processes=int(os.environ[ENV_NUM_PROCESSES]),
+               process_id=int(os.environ[ENV_PROCESS_ID]))
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def global_mesh(axes: Optional[dict[str, int]] = None) -> "jax.sharding.Mesh":
+    """A mesh over ALL processes' devices. Default: 1-D ``data`` axis over
+    every chip in the job (pure DP, the reference's only strategy); pass
+    ``axes`` for dp x tp x sp x ep layouts. Put ``data`` outermost so DP
+    gradient all-reduce crosses DCN once per step while tp/sp/ep ride ICI."""
+    if axes is None:
+        axes = {"data": jax.device_count()}
+    return meshlib.make_mesh(axes, devices=jax.devices())
+
+
+def process_barrier(name: str = "barrier") -> None:
+    """Block until every process reaches this point (the role of the
+    reference's blocking NetworkInit rendezvous) — a psum of 1 over a 1-D
+    global mesh forces a cross-host collective."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = global_mesh()
+    ones = jax.device_put(
+        jnp.ones((jax.device_count(),), jnp.int32),
+        NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def _sum(x):
+        return x.sum()
+
+    total = int(_sum(ones))
+    assert total == jax.device_count(), (name, total)

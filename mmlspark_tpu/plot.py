@@ -33,18 +33,22 @@ def confusionMatrix(df, y_col: str, y_hat_col: str, labels=None, ax=None):
     accuracy = float(np.mean(y == y_hat))
     # map arbitrary (possibly string) labels to indices for the count matrix;
     # when `labels` names the class values themselves, its ORDER defines the
-    # matrix axes (not just the tick text)
+    # matrix axes (absent classes get zero rows, sklearn/Spark-style); when
+    # it's display text of matching length, it only renames the ticks
     uniq = np.unique(np.concatenate([y, y_hat]))
     if labels is not None:
-        if len(labels) != len(uniq):
-            raise ValueError(f"labels has {len(labels)} entries but data has "
-                             f"{len(uniq)} distinct values {uniq.tolist()}")
-        if set(labels) == set(uniq.tolist()):
+        if set(labels) >= set(uniq.tolist()):
             uniq = np.asarray(labels)
+        elif len(labels) != len(uniq):
+            raise ValueError(f"labels {list(labels)} neither covers the data "
+                             f"values {uniq.tolist()} nor matches their count")
     lut = {v: i for i, v in enumerate(uniq)}
     y_idx = np.array([lut[v] for v in y], dtype=np.int64)
     yh_idx = np.array([lut[v] for v in y_hat], dtype=np.int64)
     cm = _confusion_counts(y_idx, yh_idx)
+    if cm.shape[0] < len(uniq):       # classes listed but absent from data
+        k = len(uniq)
+        cm = np.pad(cm, ((0, k - cm.shape[0]), (0, k - cm.shape[1])))
     row_sums = cm.sum(axis=1, keepdims=True)
     cmn = cm.astype(float) / np.maximum(row_sums, 1)
 

