@@ -86,6 +86,11 @@ class TpuModel(Transformer):
         from .modules import build_model
         return build_model(self.getModelConfig()).layer_names()
 
+    def _is_moe(self) -> bool:
+        cfg = self.getModelConfig()
+        return (cfg.get("type") == "transformer"
+                and cfg.get("num_experts", 0) > 0)
+
     # one jitted program per (config, output_layer); reused across transforms
     def _apply_fn(self):
         key = getattr(self, "_apply_cache_key", None)
@@ -95,8 +100,15 @@ class TpuModel(Transformer):
             from .modules import build_model
             module = build_model(self.getModelConfig())
             ol = self.getOutputLayer() or None
-            self._apply_jit = jax.jit(
-                lambda p, x: module.apply(p, x, output_layer=ol))
+            if self._is_moe():
+                # MoE routing must know which rows are mesh padding: they
+                # may not claim expert capacity (same contract as training)
+                self._apply_jit = jax.jit(
+                    lambda p, x, m: module.apply(p, x, output_layer=ol,
+                                                 row_mask=m))
+            else:
+                self._apply_jit = jax.jit(
+                    lambda p, x: module.apply(p, x, output_layer=ol))
             self._apply_cache_key = cur
         return self._apply_jit
 
@@ -120,7 +132,12 @@ class TpuModel(Transformer):
             chunk = x[lo:lo + bs]
             padded, n = meshlib.pad_batch_to_devices(chunk, mesh)
             xb = meshlib.shard_batch(padded, mesh)
-            y = apply_fn(params, xb)
+            if self._is_moe():
+                wb = np.zeros(len(padded), dtype=np.float32)
+                wb[:n] = 1.0
+                y = apply_fn(params, xb, meshlib.shard_batch(wb, mesh))
+            else:
+                y = apply_fn(params, xb)
             outs.append(np.asarray(y)[:n])
         y = np.concatenate(outs, axis=0) if outs else np.empty((0,))
 

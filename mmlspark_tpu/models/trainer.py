@@ -105,6 +105,10 @@ class TpuLearner(Estimator):
                               "transformer only)", default=1, min=1)
     moeAuxWeight = FloatParam("weight of the MoE load-balancing aux loss",
                               default=0.01, min=0.0)
+    haltOnNonFinite = BooleanParam(
+        "raise when the epoch loss goes NaN/inf instead of training on "
+        "garbage (failure detection the reference lacks, SURVEY.md §5)",
+        default=True)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -213,7 +217,10 @@ class TpuLearner(Estimator):
         # param shardings (expert/model axes) instead of being replicated
         opt_state = tx.init(params)
 
-        is_moe = cfg.get("num_experts", 0) > 0
+        # only the transformer family reads num_experts (modules.py builder);
+        # other configs carrying the key must not get a row_mask kwarg
+        is_moe = (cfg.get("type") == "transformer"
+                  and cfg.get("num_experts", 0) > 0)
         moe_aux = self.getMoeAuxWeight() if is_moe else 0.0
 
         @jax.jit
@@ -267,6 +274,16 @@ class TpuLearner(Estimator):
                                                      xb, yb, wb)
             last_loss = float(loss)
             log.info("epoch %d loss %.4f", epoch, last_loss)
+            if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
+                last_good = self._latest_checkpoint() \
+                    if self.getCheckpointDir() else None
+                raise RuntimeError(
+                    f"training diverged: epoch {epoch} loss is {last_loss} "
+                    f"(lr={self.getLearningRate()}). "
+                    + (f"Last good checkpoint: epoch {last_good} in "
+                       f"{self.getCheckpointDir()!r}; refit resumes there."
+                       if last_good is not None
+                       else "Set checkpointDir to make divergence resumable."))
             if self.getCheckpointDir():
                 self._save_checkpoint(epoch, params, opt_state)
 

@@ -217,11 +217,10 @@ class FastVectorAssembler(Transformer, HasOutputCol):
                 width = int(np.prod(col.shape[1:])) if col.ndim > 1 else 1
                 block = col.astype(np.float32).reshape(n, width)
             parts.append((name, block))
+        from ..core.utils import object_column
         mat = np.concatenate([b for _, b in parts], axis=1) if parts else \
             np.zeros((n, 0), np.float32)
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            out[i] = mat[i]
+        out = object_column(mat)
         # propagate ONLY categorical attributes, as slot ranges
         slots = {}
         offset = 0

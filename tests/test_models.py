@@ -292,3 +292,25 @@ class TestImageFeaturizer:
     def test_resizes_any_input_shape(self):
         out = self._featurizer(cut=1).transform(self._img_df(h=24, w=10))
         assert out.col("features")[0].shape == (8,)
+
+
+def test_trainer_halts_on_divergence(tmp_path):
+    """Failure detection (SURVEY.md §5: reference has none): an absurd LR
+    makes the loss non-finite; the learner must halt with a clear error
+    rather than keep training, and point at the last good checkpoint."""
+    import pytest
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    rng = np.random.default_rng(0)
+    n = 16
+    x = (rng.normal(size=(n, 8)) * 1e3).astype(np.float32)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": rng.integers(0, 2, n).astype(np.int64)})
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [8],
+                                "num_classes": 2})
+               .setEpochs(3).setBatchSize(n).setLearningRate(1e12)
+               .setCheckpointDir(str(tmp_path / "ck")))
+    with pytest.raises(RuntimeError, match="diverged"):
+        learner.fit(df)

@@ -230,3 +230,103 @@ def test_moe_row_mask_ignores_padding():
     y_real_only = m.apply(params, x_real,
                           row_mask=jnp.ones((4,), jnp.float32))
     assert np.isfinite(np.asarray(y_real_only)).all()
+
+
+def test_distributed_two_process_rendezvous(tmp_path):
+    """REAL multi-process rendezvous: two OS processes join via the JAX
+    coordination service (the MPI-hostfile / LightGBM-machine-list
+    replacement, SURVEY.md §2.7) using the MMLTPU_* env contract, build one
+    global mesh, and run a cross-process collective."""
+    import socket
+    import subprocess
+    import sys
+    import os as _os
+
+    with socket.socket() as s:     # free port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from mmlspark_tpu.parallel import distributed as dist\n"
+        "import numpy as np\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "assert dist.initialize_from_env() is True\n"
+        "assert jax.process_count() == 2\n"
+        "mesh = dist.global_mesh()\n"
+        "n = jax.device_count()\n"
+        "x = jax.make_array_from_process_local_data(\n"
+        "    NamedSharding(mesh, P('data')),\n"
+        "    np.ones((jax.local_device_count(),), 'float32'), (n,))\n"
+        "tot = jax.jit(lambda a: a.sum(),\n"
+        "              out_shardings=NamedSharding(mesh, P()))(x)\n"
+        "assert float(tot) == n, float(tot)\n"
+        "dist.process_barrier('end')\n"
+        "dist.shutdown()\n"
+        "print('WORKER_OK')\n")
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(_os.environ,
+                   PYTHONPATH=repo,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   MMLTPU_NUM_PROCESSES="2",
+                   MMLTPU_PROCESS_ID=str(pid))
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, (out[-1500:], err[-1500:])
+        assert "WORKER_OK" in out
+
+
+def test_moe_inference_padding_invariant():
+    """TpuModel scores for the same rows must not change with mesh padding
+    (padded duplicates may not claim expert capacity at inference)."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.models import TpuModel, build_model
+    cfg = {"type": "transformer", "vocab_size": 30, "d_model": 8,
+           "heads": 2, "layers": 1, "num_classes": 3, "max_len": 16,
+           "num_experts": 2, "capacity_factor": 1.0}
+    module = build_model(cfg)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 30, size=(9, 8))   # 9 rows -> pads to 16 on 8 dev
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))
+
+    def frame(rows):
+        col = np.empty(len(rows), dtype=object)
+        for i, r in enumerate(rows):
+            col[i] = r.astype(np.float32)
+        return DataFrame({"features": col})
+
+    m = (TpuModel().setInputCol("features").setModelConfig(cfg)
+         .setModelParams(params))
+    s9 = np.stack([np.asarray(v) for v in
+                   m.transform(frame(toks)).col("scores")])
+    s8 = np.stack([np.asarray(v) for v in
+                   m.transform(frame(toks[:8])).col("scores")])
+    np.testing.assert_allclose(s9[:8], s8, rtol=1e-5, atol=1e-5)
+
+
+def test_mlp_config_with_stray_num_experts():
+    """num_experts on a non-transformer config is ignored by the builder and
+    must not break the trainer (row_mask only goes to MoE transformers)."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": rng.integers(0, 2, 8).astype(np.int64)})
+    model = (TpuLearner()
+             .setModelConfig({"type": "mlp", "hidden": [4],
+                              "num_classes": 2, "num_experts": 4})
+             .setEpochs(1).setBatchSize(8).fit(df))
+    assert len(model.transform(df).col("scores")) == 8

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# TPU-VM provisioning (reference analog: tools/deployment/* ARM templates +
+# docs/gpu-setup.md provision N-series GPU VMs for CNTK/MPI; here one gcloud
+# call provisions a TPU slice and the JAX runtime needs no driver setup).
+#
+# Usage: tools/tpu-vm-setup.sh NAME [ZONE] [TYPE] [VERSION]
+#   NAME     TPU VM name
+#   ZONE     default us-central1-a
+#   TYPE     default v5litepod-8   (one host, 8 chips — the bench target)
+#   VERSION  default tpu-ubuntu2204-base
+set -euo pipefail
+
+NAME="${1:?usage: tpu-vm-setup.sh NAME [ZONE] [TYPE] [VERSION]}"
+ZONE="${2:-us-central1-a}"
+TYPE="${3:-v5litepod-8}"
+VERSION="${4:-tpu-ubuntu2204-base}"
+
+gcloud compute tpus tpu-vm create "$NAME" \
+  --zone="$ZONE" --accelerator-type="$TYPE" --version="$VERSION"
+
+# install the framework on every host of the slice (multi-host slices run
+# the same command on each worker; the MMLTPU_* env contract in
+# mmlspark_tpu.parallel.distributed handles rendezvous at run time)
+gcloud compute tpus tpu-vm ssh "$NAME" --zone="$ZONE" --worker=all --command='
+  set -e
+  python3 -m pip install -q "jax[tpu]" flax optax
+  python3 -m pip install -q mmlspark-tpu  # or: pip install <wheel you scp>
+  python3 -c "import jax; print(jax.devices())"
+'
+echo "TPU VM $NAME ready. Run jobs with tools/bin/mmltpu-run."
