@@ -92,13 +92,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 1024, block_k: int = 1024,
                     interpret=None):
     """FlashAttention on TPU. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
     The score matrix stays in VMEM tiles; HBM traffic is O(T*D) instead of
     O(T^2). Sequence dims are padded to block multiples internally (padded
     keys masked, padded queries sliced off).
+
+    Default blocks from an on-chip sweep at (B,T,H,D)=(8,4096,8,64), causal,
+    v5e, scalar-sync timing: 128x128 10 TF/s, 256x256 21, 512x512 34,
+    512x1024 46, 1024x1024 58 TF/s; 1024x2048 exceeds the 16MB scoped VMEM
+    limit. Blocks clamp to the sequence length for short inputs.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
