@@ -1,0 +1,68 @@
+"""Environment / process / file utilities (reference: src/core/env —
+EnvironmentUtils.scala:41-50 counts GPUs by shelling out to ``nvidia-smi -L``;
+FileUtilities, StreamUtilities.using, ProcessUtils; NativeLoader lives in
+mmlspark_tpu.native)."""
+
+from __future__ import annotations
+
+import contextlib
+import subprocess
+from typing import Iterator, Sequence
+
+
+def accelerator_count() -> int:
+    """Attached accelerator chips (the GPUCount analog — no nvidia-smi
+    subprocess: the JAX runtime already knows)."""
+    import jax
+    return sum(1 for d in jax.devices() if d.platform != "cpu")
+
+
+def device_summary() -> dict:
+    """Platform/topology snapshot for logs and config records."""
+    import jax
+    devs = jax.devices()
+    return {
+        "backend": jax.default_backend(),
+        "device_count": len(devs),
+        "local_device_count": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "device_kinds": sorted({d.device_kind for d in devs}),
+    }
+
+
+@contextlib.contextmanager
+def using(*resources) -> Iterator[tuple]:
+    """Close every resource on exit, first-error wins (reference
+    StreamUtilities.using): an exception from the with-body outranks any
+    close()-time error; with a clean body the first close() error raises."""
+    try:
+        yield resources
+    except BaseException:
+        for r in resources:
+            try:
+                r.close()
+            except Exception:  # body error is the first error; keep it
+                pass
+        raise
+    else:
+        err = None
+        for r in resources:
+            try:
+                r.close()
+            except Exception as e:  # noqa: BLE001 - collect, raise once
+                err = err or e
+        if err is not None:
+            raise err
+
+
+def run_process(cmd: Sequence[str], timeout: float = 600.0,
+                check: bool = True) -> tuple[int, str, str]:
+    """Run a subprocess, capture (returncode, stdout, stderr) (reference
+    ProcessUtils; the reference shells out for ssh/scp/mpirun — here process
+    launch is only for tooling, never the compute path)."""
+    r = subprocess.run(list(cmd), capture_output=True, text=True,
+                       timeout=timeout)
+    if check and r.returncode != 0:
+        raise RuntimeError(f"{cmd[0]} failed ({r.returncode}): "
+                           f"{r.stderr[-500:]}")
+    return r.returncode, r.stdout, r.stderr

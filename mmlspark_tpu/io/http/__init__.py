@@ -1,3 +1,5 @@
+from .distributed import (DistributedHTTPSource, DistributedServingLoop,
+                          SharedVariable, serve_distributed)
 from .server import HTTPSink, HTTPSource, ServingLoop, serve_pipeline
 from .transformer import (CustomInputParser, CustomOutputParser,
                           HTTPTransformer, JSONInputParser, JSONOutputParser,

@@ -198,9 +198,10 @@ class TransformerEncoder(nn.Module):
     parallelism; its only sequence model is the notebook-304 BiLSTM). Built
     so context scales: attention is pluggable — ``attn_fn`` injects a
     sequence-parallel form (parallel.sequence.make_sp_attention: ring over
-    ppermute, or Ulysses all-to-all) without touching the module; default is
-    single-device blockwise (FlashAttention-recurrence) attention, O(T)
-    memory.
+    ppermute, or Ulysses all-to-all) without touching the module. Default
+    ``attn_impl='auto'`` picks the Pallas flash kernel on TPU (block_size is
+    then ignored — the kernel tiles itself) and single-device blockwise
+    (FlashAttention-recurrence, O(T) memory, honors block_size) elsewhere.
 
     Input: int32 token ids (B, T). Output: (B, num_classes) when
     ``pool='mean'``, else per-token (B, T, num_classes).

@@ -205,3 +205,121 @@ class TestPowerBI:
         finally:
             loop.stop()
             source.close()
+
+
+class TestDistributedServing:
+    def test_multi_worker_fleet(self):
+        """Requests against every worker port are answered by ONE batching
+        loop (the DistributedHTTPSource/Sink path)."""
+        import json
+        import threading
+        import requests as rq
+        from mmlspark_tpu.io.http import serve_distributed
+
+        class Doubler(Transformer):
+            def transform(self, df):
+                replies = [json.dumps({"y": json.loads(v)["x"] * 2})
+                           for v in df.col("value")]
+                return df.withColumn("reply", object_column(replies))
+
+        source, loop = serve_distributed(Doubler(), n_workers=3, max_batch=32)
+        try:
+            assert len(set(source.urls)) == 3
+            results = []
+
+            def client(i):
+                url = source.urls[i % 3]
+                r = rq.post(url, json={"x": i}, timeout=10)
+                results.append((i, r.status_code, r.json()["y"]))
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(12)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert len(results) == 12
+            for i, code, y in results:
+                assert code == 200 and y == i * 2
+        finally:
+            loop.stop()
+
+    def test_distributed_error_path(self):
+        import requests as rq
+        from mmlspark_tpu.io.http import serve_distributed
+
+        class Boom(Transformer):
+            def transform(self, df):
+                raise RuntimeError("kaput")
+
+        source, loop = serve_distributed(Boom(), n_workers=2)
+        try:
+            r = rq.post(source.urls[0], json={"x": 1}, timeout=10)
+            assert r.status_code == 500
+            assert "kaput" in r.json()["error"]
+        finally:
+            loop.stop()
+
+    def test_shared_variable(self):
+        from mmlspark_tpu.io.http import SharedVariable
+        SharedVariable.clear()
+        calls = []
+        a = SharedVariable.get("k", lambda: calls.append(1) or {"n": 0})
+        b = SharedVariable.get("k", lambda: calls.append(1) or {"n": 0})
+        assert a is b and len(calls) == 1
+        SharedVariable.remove("k")
+        c = SharedVariable.get("k", lambda: calls.append(1) or {"n": 0})
+        assert c is not a and len(calls) == 2
+        SharedVariable.clear()
+
+
+def test_env_utilities(tmp_path):
+    from mmlspark_tpu.core import env
+
+    s = env.device_summary()
+    assert s["device_count"] == 8 and s["backend"] == "cpu"
+    assert env.accelerator_count() == 0  # CPU test mesh
+
+    closed = []
+
+    class R:
+        def close(self):
+            closed.append(1)
+
+    with env.using(R(), R()) as (a, b):
+        pass
+    assert len(closed) == 2
+
+    code, out, _ = env.run_process(["echo", "hi"])
+    assert code == 0 and out.strip() == "hi"
+    import pytest
+    with pytest.raises(RuntimeError, match="failed"):
+        env.run_process(["false"])
+
+
+def test_shared_variable_nested_get():
+    """A factory may get() OTHER keys (per-key locks; a global lock here
+    would deadlock)."""
+    from mmlspark_tpu.io.http import SharedVariable
+    SharedVariable.clear()
+    inner = SharedVariable.get  # alias to keep the lambda short
+    v = SharedVariable.get(
+        "outer", lambda: {"dep": inner("inner", lambda: 41), "x": 1})
+    assert v["dep"] == 41
+    SharedVariable.clear()
+
+
+def test_using_body_error_wins():
+    import pytest
+    from mmlspark_tpu.core import env
+
+    class BadClose:
+        def close(self):
+            raise IOError("close failed")
+
+    with pytest.raises(ValueError, match="bad data"):
+        with env.using(BadClose()):
+            raise ValueError("bad data")
+    with pytest.raises(IOError, match="close failed"):
+        with env.using(BadClose()):
+            pass
