@@ -21,7 +21,6 @@ visible to every thread.
 from __future__ import annotations
 
 import threading
-from typing import Optional
 
 import numpy as np
 
@@ -94,13 +93,25 @@ class DistributedHTTPSource:
         return [w.url for w in self.workers]
 
     def getBatch(self, max_rows: int = 1024,
-                 timeout: Optional[float] = 0.05) -> DataFrame:
+                 timeout: float = 0.05) -> DataFrame:
         per = max(1, max_rows // max(1, len(self.workers)))
         ids, values = [], []
         for wi, w in enumerate(self.workers):
             batch = w.getBatch(per, timeout=timeout)
             ids.extend(f"{wi}:{ex_id}" for ex_id in batch.col("id"))
             values.extend(batch.col("value").tolist())
+        # skewed traffic: hand idle workers' unused quota to busy ones
+        # (zero-timeout second pass, so it only drains already-queued rows)
+        budget = max_rows - len(ids)
+        for wi, w in enumerate(self.workers):
+            if budget <= 0:
+                break
+            batch = w.getBatch(budget, timeout=0)
+            got = batch.count()
+            if got:
+                ids.extend(f"{wi}:{ex_id}" for ex_id in batch.col("id"))
+                values.extend(batch.col("value").tolist())
+                budget -= got
         if not ids:
             return DataFrame({"id": np.array([], dtype=object),
                               "value": np.array([], dtype=object)})

@@ -323,3 +323,42 @@ def test_using_body_error_wins():
     with pytest.raises(IOError, match="close failed"):
         with env.using(BadClose()):
             pass
+
+
+def test_distributed_skewed_traffic_uses_full_budget():
+    """All traffic on one worker: the idle workers' quota must be handed
+    over, not wasted (second zero-timeout drain pass)."""
+    import json
+    import threading
+    import requests as rq
+    from mmlspark_tpu.io.http import serve_distributed
+
+    seen_batches = []
+
+    class Echo(Transformer):
+        def transform(self, df):
+            seen_batches.append(df.count())
+            replies = [json.dumps({"y": json.loads(v)["x"]})
+                       for v in df.col("value")]
+            return df.withColumn("reply", object_column(replies))
+
+    source, loop = serve_distributed(Echo(), n_workers=4, max_batch=64)
+    try:
+        url = source.urls[0]  # every client hits ONE worker
+        results = []
+
+        def client(i):
+            results.append(rq.post(url, json={"x": i}, timeout=10).json()["y"])
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == list(range(32))
+        # with per-worker quota 64//4=16 and no redistribution this would
+        # need >= 2 batches of <=16; the handover allows bigger merges
+        assert max(seen_batches) > 16 or len(seen_batches) <= 2, seen_batches
+    finally:
+        loop.stop()

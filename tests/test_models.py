@@ -314,3 +314,42 @@ def test_trainer_halts_on_divergence(tmp_path):
                .setCheckpointDir(str(tmp_path / "ck")))
     with pytest.raises(RuntimeError, match="diverged"):
         learner.fit(df)
+
+
+def test_tpu_model_wire_dtypes():
+    """bf16 wire transfer and uint8 image passthrough give the same scores
+    as f32 (inputs are cast on device anyway)."""
+    import jax
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.schema import make_image_row
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuModel, build_model
+
+    cfg = {"type": "mlp", "hidden": [8], "num_classes": 3}
+    module = build_model(cfg)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(6, 12)).astype(np.float32)
+    params = module.init(jax.random.PRNGKey(0), x[:1])
+    df = DataFrame({"features": object_column([r for r in x])})
+    base = TpuModel().setInputCol("features").setModelConfig(cfg) \
+        .setModelParams(params)
+    s32 = np.stack([np.asarray(v) for v in
+                    base.transform(df).col("scores")])
+    sbf = np.stack([np.asarray(v) for v in
+                    base.copy({"transferDtype": "bfloat16"})
+                    .transform(df).col("scores")])
+    np.testing.assert_allclose(s32, sbf, rtol=0.05, atol=0.05)
+
+    # uint8 image rows flow through without a host f32 blow-up
+    rows = [make_image_row(f"i{k}", 8, 8, 3,
+                           rng.integers(0, 256, (8, 8, 3), dtype=np.uint8))
+            for k in range(4)]
+    idf = DataFrame({"image": object_column(rows)})
+    icfg = {"type": "convnet", "channels": [4], "dense": 8, "num_classes": 2}
+    imod = build_model(icfg)
+    iparams = imod.init(jax.random.PRNGKey(0),
+                        np.zeros((1, 8, 8, 3), np.float32))
+    im = (TpuModel().setInputCol("image").setModelConfig(icfg)
+          .setModelParams(iparams))
+    out = im.transform(idf)
+    assert len(out.col("scores")) == 4
