@@ -118,38 +118,98 @@ class _BasicBlock(nn.Module):
         return nn.relu(x + y)
 
 
+class _BottleneckBlock(nn.Module):
+    """1x1 reduce -> 3x3 -> 1x1 expand (ResNet-50-family block)."""
+    filters: int            # output width (the expanded 4x width)
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        inner = self.filters // 4
+        y = nn.Conv(inner, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
+                                 dtype=self.dtype)(y))
+        y = nn.Conv(inner, (3, 3), (self.strides, self.strides),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
+                                 dtype=self.dtype)(y))
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
+                         dtype=self.dtype)(y)
+        if x.shape != y.shape:
+            x = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
+                        use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(x + y)
+
+
 class ResNet(nn.Module):
-    """CIFAR ResNet (depth = 6n+2: 20, 32, 56...) — the flagship model.
+    """ResNet family — the flagship model.
+
+    Default config is the CIFAR ResNet (depth = 6n+2: 20, 32, 56...). With
+    ``block='bottleneck'``, per-stage depths and an ImageNet stem it builds
+    the ResNet-50 class used by the reference's ImageFeaturizer (SURVEY.md
+    §2.2: headless-net transfer learning cuts layers off the top; our
+    ``layer_names()``/``output_layer`` is that mechanism).
 
     Uses per-channel GroupNorm (LayerNorm-style) instead of BatchNorm so the
     forward pass is batch-independent and shards cleanly over the data axis
     without cross-device batch statistics.
     """
-    blocks_per_stage: int = 3          # n=3 -> ResNet-20
+    blocks_per_stage: Any = 3          # int, or per-stage list e.g. [3,4,6,3]
     widths: Sequence[int] = (16, 32, 64)
     num_classes: int = 10
+    block: str = "basic"               # basic | bottleneck
+    stem: str = "cifar"                # cifar (3x3) | imagenet (7x7/2 + pool)
     dtype: Any = jnp.bfloat16
+
+    def _depths(self):
+        if isinstance(self.blocks_per_stage, int):
+            return [self.blocks_per_stage] * len(self.widths)
+        depths = list(self.blocks_per_stage)
+        if len(depths) != len(self.widths):
+            raise ValueError(
+                f"blocks_per_stage has {len(depths)} stages but widths has "
+                f"{len(self.widths)} — set both (e.g. resnet50: "
+                f"blocks_per_stage=[3,4,6,3], widths=[256,512,1024,2048])")
+        return depths
 
     def layer_names(self):
         names = ["stem"]
-        for s in range(len(self.widths)):
-            names += [f"stage{s}_block{b}" for b in range(self.blocks_per_stage)]
+        for s, depth in enumerate(self._depths()):
+            names += [f"stage{s}_block{b}" for b in range(depth)]
         return names + ["pool", "logits"]
 
     @nn.compact
     def __call__(self, x, output_layer: Optional[str] = None):
+        if self.block not in ("basic", "bottleneck"):
+            raise ValueError(f"block must be basic|bottleneck, "
+                             f"got {self.block!r}")
+        if self.stem not in ("cifar", "imagenet"):
+            raise ValueError(f"stem must be cifar|imagenet, got {self.stem!r}")
+        Block = _BasicBlock if self.block == "basic" else _BottleneckBlock
+        stem_width = (self.widths[0] // 4 if self.block == "bottleneck"
+                      else self.widths[0])
         tap = _LayerTap(output_layer)
         x = x.astype(self.dtype)
-        x = nn.Conv(self.widths[0], (3, 3), use_bias=False, dtype=self.dtype)(x)
-        x = tap.tap("stem", nn.relu(nn.GroupNorm(
-            num_groups=None, group_size=x.shape[-1], dtype=self.dtype)(x)))
+        if self.stem == "imagenet":
+            x = nn.Conv(stem_width, (7, 7), (2, 2), use_bias=False,
+                        dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(stem_width, (3, 3), use_bias=False,
+                        dtype=self.dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=None, group_size=x.shape[-1],
+                                 dtype=self.dtype)(x))
+        if self.stem == "imagenet":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = tap.tap("stem", x)
         if tap.done:
             return tap.result.astype(jnp.float32)
-        for s, width in enumerate(self.widths):
-            for b in range(self.blocks_per_stage):
+        for s, (width, depth) in enumerate(zip(self.widths, self._depths())):
+            for b in range(depth):
                 strides = 2 if (s > 0 and b == 0) else 1
                 x = tap.tap(f"stage{s}_block{b}",
-                            _BasicBlock(width, strides, self.dtype)(x))
+                            Block(width, strides, self.dtype)(x))
                 if tap.done:
                     return tap.result.astype(jnp.float32)
         x = tap.tap("pool", jnp.mean(x, axis=(1, 2)))
@@ -308,7 +368,15 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
     "resnet": lambda cfg: ResNet(
         blocks_per_stage=cfg.get("blocks_per_stage", 3),
         widths=tuple(cfg.get("widths", (16, 32, 64))),
-        num_classes=cfg.get("num_classes", 10)),
+        num_classes=cfg.get("num_classes", 10),
+        block=cfg.get("block", "basic"),
+        stem=cfg.get("stem", "cifar")),
+    # the reference ImageFeaturizer's headline model (ResNet-50, ImageNet)
+    "resnet50": lambda cfg: ResNet(
+        blocks_per_stage=tuple(cfg.get("blocks_per_stage", (3, 4, 6, 3))),
+        widths=tuple(cfg.get("widths", (256, 512, 1024, 2048))),
+        num_classes=cfg.get("num_classes", 1000),
+        block="bottleneck", stem="imagenet"),
     "bilstm": lambda cfg: BiLSTMTagger(
         vocab_size=cfg.get("vocab_size", 10000),
         embed_dim=cfg.get("embed_dim", 128),
@@ -354,9 +422,10 @@ def example_input(config: dict, batch: int = 2):
     mtype = config["type"]
     if mtype == "mlp":
         return jnp.zeros((batch, config.get("input_dim", 16)), jnp.float32)
-    if mtype in ("convnet", "resnet"):
-        h = config.get("height", 32)
-        w = config.get("width", 32)
+    if mtype in ("convnet", "resnet", "resnet50"):
+        default_hw = 64 if mtype == "resnet50" else 32
+        h = config.get("height", default_hw)
+        w = config.get("width", default_hw)
         c = config.get("channels_in", 3)
         return jnp.zeros((batch, h, w, c), jnp.float32)
     if mtype in TOKEN_MODELS:

@@ -44,3 +44,44 @@ def assert_golden(path: str, dataset: str, learner: str, metric: str,
         raise AssertionError(
             f"{key}: measured {value:.4f} vs golden {expected:.4f} "
             f"(tolerance {tolerance})")
+
+
+def assert_golden_json(path: str, obj: dict, rtol: float = 1e-3,
+                       atol: float = 2e-4):
+    """JSON-object golden (the reference's featurize benchmark*.json
+    mechanism): numeric leaves compare within rtol/atol (atol must cover the
+    caller's digest quantization step — 4-dp rounding here), everything else
+    exactly. GOLDEN_UPDATE=1 rewrites the file."""
+    import json
+    import math
+
+    if os.environ.get("GOLDEN_UPDATE"):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+        return
+    if not os.path.exists(path):
+        raise AssertionError(f"no golden at {path}; run with GOLDEN_UPDATE=1")
+    with open(path) as f:
+        expected = json.load(f)
+
+    def compare(a, b, where):
+        if isinstance(b, dict):
+            assert isinstance(a, dict) and sorted(a) == sorted(b), \
+                f"{where}: keys {sorted(a)} != {sorted(b)}"
+            for k in b:
+                compare(a[k], b[k], f"{where}.{k}")
+        elif isinstance(b, list):
+            assert len(a) == len(b), f"{where}: len {len(a)} != {len(b)}"
+            for i, (x, y) in enumerate(zip(a, b)):
+                compare(x, y, f"{where}[{i}]")
+        elif isinstance(b, float):
+            if math.isnan(b):
+                assert math.isnan(float(a)), f"{where}: {a} != NaN"
+            else:
+                assert math.isclose(float(a), b, rel_tol=rtol,
+                                    abs_tol=atol), f"{where}: {a} != {b}"
+        else:
+            assert a == b, f"{where}: {a!r} != {b!r}"
+
+    compare(obj, expected, "$")

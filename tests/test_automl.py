@@ -19,10 +19,10 @@ from mmlspark_tpu.models import (DecisionTreeClassifier, GBTClassifier,
                                  LinearRegression, LogisticRegression,
                                  MultilayerPerceptronClassifier, NaiveBayes,
                                  RandomForestClassifier)
-from mmlspark_tpu.testing import assert_golden
+from mmlspark_tpu.testing import assert_golden, assert_golden_json
 
-GOLDENS = os.path.join(os.path.dirname(__file__), "goldens",
-                       "train_classifier_benchmark_metrics.csv")
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+GOLDENS = os.path.join(GOLDEN_DIR, "train_classifier_benchmark_metrics.csv")
 
 
 @pytest.fixture(scope="module")
@@ -208,3 +208,59 @@ class TestTuneAndFindBest:
                 .setEvaluationMetric("AUC").fit(df))
         assert best.getBestModelMetrics() > 0.9
         assert len(best.getAllModelMetrics()) == 2
+
+
+class TestGoldens:
+    """Reference §4 parity: tune goldens CSV + featurize output JSON goldens
+    (reference: tune-hyperparameters/.../benchmarkMetrics.csv and
+    featurize/.../benchmark*.json)."""
+
+    def test_tune_golden(self):
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+        tuned = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(40),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(4).setParallelism(2).setSeed(7)
+                 .fit(df))
+        assert_golden(os.path.join(GOLDEN_DIR, "tune_benchmark_metrics.csv"),
+                      "breast_cancer", "LogisticRegression", "accuracy",
+                      float(tuned.getBestMetric()), tolerance=0.03)
+
+    @pytest.mark.parametrize("scenario", ["numerics", "strings",
+                                          "categoricals", "mixed_missing"])
+    def test_featurize_golden_json(self, scenario):
+        rng = np.random.default_rng(3)
+        n = 24
+        if scenario == "numerics":
+            df = DataFrame({"a": rng.normal(size=n),
+                            "b": rng.integers(0, 9, n).astype(np.int64),
+                            "c": (rng.random(n) > 0.5)})
+        elif scenario == "strings":
+            df = DataFrame({"t": np.array(
+                [f"tok{i % 5} common w{i % 3}" for i in range(n)],
+                dtype=object)})
+        elif scenario == "categoricals":
+            df = DataFrame({"c1": np.array(list("abcd") * (n // 4), dtype=object),
+                            "c2": np.array(list("xy") * (n // 2), dtype=object)})
+        else:
+            a = rng.normal(size=n)
+            a[::5] = np.nan
+            df = DataFrame({"a": a,
+                            "c": np.array(list("uv") * (n // 2), dtype=object)})
+        model = Featurize().setOutputCol("features").fit(df)
+        out = model.transform(df)
+        vecs = np.stack([np.asarray(v, dtype=np.float64)
+                         for v in out.col("features")])
+        digest = {
+            "n_rows": int(vecs.shape[0]),
+            "dim": int(vecs.shape[1]),
+            "nnz": int(np.count_nonzero(vecs)),
+            "col_sums": [round(float(s), 4) for s in vecs.sum(axis=0)[:16]],
+            "row0": [round(float(v), 4) for v in vecs[0][:16]],
+        }
+        assert_golden_json(
+            os.path.join(GOLDEN_DIR, f"featurize_{scenario}.json"), digest)

@@ -353,3 +353,44 @@ def test_tpu_model_wire_dtypes():
           .setModelParams(iparams))
     out = im.transform(idf)
     assert len(out.col("scores")) == 4
+
+
+def test_resnet50_family_and_truncation():
+    """Bottleneck ResNet-50 (the reference ImageFeaturizer's headline
+    model): builds, forward runs, and headless truncation emits the pooled
+    2048-d embedding the transfer-learning path consumes."""
+    import jax
+    from mmlspark_tpu.models import build_model
+
+    # a narrow bottleneck variant keeps the CPU test fast; the real
+    # resnet50 config only changes widths/depths
+    cfg = {"type": "resnet", "block": "bottleneck", "stem": "imagenet",
+           "blocks_per_stage": [1, 1, 1, 1], "widths": [16, 32, 64, 128],
+           "num_classes": 7}
+    m = build_model(cfg)
+    names = m.layer_names()
+    assert names[0] == "stem" and names[-2:] == ["pool", "logits"]
+    assert "stage3_block0" in names
+    x = np.zeros((2, 64, 64, 3), np.float32)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x)
+    assert out.shape == (2, 7)
+    emb = m.apply(params, x, output_layer="pool")
+    assert emb.shape == (2, 128)  # widths[-1]-dim embedding
+
+    # the registered resnet50 config resolves (init only at tiny spatial)
+    m50 = build_model({"type": "resnet50"})
+    assert len(m50.layer_names()) == 2 + 3 + 4 + 6 + 3 + 1
+
+
+def test_resnet_config_validation():
+    import pytest
+    from mmlspark_tpu.models import build_model
+    bad_len = build_model({"type": "resnet", "block": "bottleneck",
+                           "blocks_per_stage": [1, 1, 1, 1]})
+    with pytest.raises(ValueError, match="stages but widths"):
+        bad_len.layer_names()
+    bad_stem = build_model({"type": "resnet", "stem": "Imagenet"})
+    with pytest.raises(ValueError, match="stem must be"):
+        bad_stem.init(__import__("jax").random.PRNGKey(0),
+                      np.zeros((1, 8, 8, 3), np.float32))
