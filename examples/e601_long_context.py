@@ -1,0 +1,59 @@
+"""Example 601 — long-context sequence parallelism (no reference analog:
+SURVEY.md §5 notes the reference has no long-context story at all; its only
+sequence model is a pre-trained BiLSTM. This is the capability designed in
+fresh: ring attention rotates KV shards over the mesh's ICI links while
+Ulysses re-shards sequence<->heads with all_to_all).
+
+Runs on the 8-device CPU test mesh or any TPU slice unchanged.
+"""
+
+import numpy as np
+
+import jax
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuLearner
+from mmlspark_tpu.parallel.sequence import (blockwise_attention,
+                                            make_sp_attention,
+                                            plain_attention)
+
+n_dev = len(jax.devices())
+sp = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+
+# --- 1. the collective forms agree with dense attention -------------------
+from mmlspark_tpu.parallel.mesh import make_mesh
+
+rng = np.random.default_rng(0)
+B, T, H, D = 2, 64, 4, 8
+q, k, v = (rng.normal(size=(B, T, H, D)).astype(np.float32) for _ in range(3))
+ref = np.asarray(plain_attention(q, k, v, causal=True))
+if sp > 1:
+    mesh = make_mesh({"data": n_dev // sp, "seq": sp})
+    for mode in ("ring", "ulysses"):
+        attn = make_sp_attention(mesh, axis_name="seq", mode=mode, causal=True)
+        out = np.asarray(attn(q, k, v))
+        err = float(np.abs(out - ref).max())
+        print(f"{mode} attention vs dense: max err {err:.2e}")
+        assert err < 1e-3
+blk = np.asarray(blockwise_attention(q, k, v, block_size=16, causal=True))
+assert float(np.abs(blk - ref).max()) < 1e-3
+
+# --- 2. end-to-end: sequence-parallel transformer training ----------------
+n, seq = 16, 32
+toks = np.empty(n, dtype=object)
+for i in range(n):
+    toks[i] = rng.integers(0, 64, size=seq).astype(np.float32)
+df = DataFrame({"features": toks,
+                "label": rng.integers(0, 2, n).astype(np.int64)})
+learner = (TpuLearner()
+           .setModelConfig({"type": "transformer", "vocab_size": 64,
+                            "d_model": 16, "heads": 4, "layers": 2,
+                            "num_classes": 2, "max_len": 64, "causal": True})
+           .setEpochs(1).setBatchSize(n))
+if sp > 1:
+    learner = learner.setSequenceParallel(sp).setSpMode("ring")
+model = learner.fit(df)
+out = model.transform(df)
+assert len(out.col("scores")) == n
+print(f"sequence-parallel training OK (sp={sp if sp > 1 else 'off'})")
+print("example 601 OK")

@@ -132,7 +132,18 @@ class TpuModel(Transformer):
             x = x.astype(ml_dtypes.bfloat16)
         mesh = meshlib.create_mesh()
         apply_fn = self._apply_fn()
-        params = jax.device_put(self.getModelParams(), meshlib.replicated(mesh))
+        nproc = jax.process_count()
+        params = meshlib.put_replicated(self.getModelParams(), mesh)
+        if nproc > 1:
+            # multi-host: this df is the process-local shard; SPMD demands
+            # identical shapes/call counts everywhere, so the whole shard
+            # goes in ONE globally-assembled batch (padded to the max local
+            # length) and each process reads back its own rows
+            y = self._transform_multihost(x, mesh, apply_fn, params)
+            if y.ndim == 1:
+                return df.withColumn(self.getOutputCol(), y)
+            from ..core.utils import object_column
+            return df.withColumn(self.getOutputCol(), object_column(y))
 
         pending: list = []
         outs = []
@@ -164,6 +175,31 @@ class TpuModel(Transformer):
             return df.withColumn(self.getOutputCol(), y)
         from ..core.utils import object_column
         return df.withColumn(self.getOutputCol(), object_column(y))
+
+    def _transform_multihost(self, x, mesh, apply_fn, params) -> np.ndarray:
+        """One synchronized global inference call over every process's local
+        shard. Local rows pad to the all-process max (miniBatchSize does not
+        apply — whole-shard batching keeps call counts identical)."""
+        from jax.experimental import multihost_utils
+
+        from ..parallel import mesh as meshlib
+        padded, n = meshlib.pad_batch_to_local_devices(x, mesh)
+        target = int(multihost_utils.process_allgather(
+            np.asarray(len(padded))).max())
+        if target == 0:
+            return np.empty((0,))
+        if len(padded) < target:  # extend with dummy rows to the global max
+            filler = np.zeros((target - len(padded),) + padded.shape[1:],
+                              padded.dtype)
+            padded = np.concatenate([padded, filler], axis=0)
+        xb = meshlib.put_global_batch(padded, mesh)
+        if self._is_moe():
+            wb = np.zeros(len(padded), dtype=np.float32)
+            wb[:n] = 1.0
+            yd = apply_fn(params, xb, meshlib.put_global_batch(wb, mesh))
+        else:
+            yd = apply_fn(params, xb)
+        return meshlib.local_rows(yd, n)
 
     def saveModel(self, path: str):
         """Persist {config.json, params.msgpack} (ModelDownloader layout)."""

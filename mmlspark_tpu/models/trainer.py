@@ -203,6 +203,12 @@ class TpuLearner(Estimator):
         # over `model`; EP rules shard stacked expert weights over `expert`);
         # batch sharded over `data`. XLA derives the gradient all-reduce +
         # any TP/EP collectives from these shardings alone.
+        nproc = jax.process_count()
+        if nproc > 1 and (tp > 1 or sp > 1 or ep > 1):
+            raise ValueError(
+                "multi-host training currently supports data parallelism "
+                "only (the reference's scope, SURVEY.md §2.7); run tp/sp/ep "
+                "within one host or shard the model axes over local devices")
         from jax.sharding import PartitionSpec as P
         rules = []
         if ep > 1:
@@ -212,10 +218,10 @@ class TpuLearner(Estimator):
         if rules:
             params = meshlib.shard_params_tp(params, mesh, rules)
         else:
-            params = jax.device_put(params, meshlib.replicated(mesh))
-        # init AFTER placement: optax's zeros_like buffers inherit the
-        # param shardings (expert/model axes) instead of being replicated
-        opt_state = tx.init(params)
+            params = meshlib.put_replicated(params, mesh)
+        # init AFTER placement, under jit: optax's zeros_like buffers inherit
+        # the param shardings (expert/model axes) instead of being replicated
+        opt_state = jax.jit(tx.init)(params)
 
         # only the transformer family reads num_experts (modules.py builder);
         # other configs carrying the key must not get a row_mask kwarg
@@ -246,12 +252,40 @@ class TpuLearner(Estimator):
             updates, opt2 = tx.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt2, loss
 
+        # multi-host: this process's df is its LOCAL shard of the dataset
+        # (the Spark-partition analog); batchSize stays the GLOBAL batch.
+        # SPMD demands identical shapes and step counts everywhere, so both
+        # are derived from GLOBAL quantities: every process contributes
+        # exactly bs rows per step (short shards wrap around their rows).
         n = len(x)
-        bs = min(self.getBatchSize(), n)
-        steps = max(1, n // bs)
-        rng_np = np.random.default_rng(self.getSeed())
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+            n_global = int(multihost_utils.process_allgather(
+                np.asarray(n)).sum())
+        else:
+            n_global = n
+        bs_global = max(1, min(self.getBatchSize(), n_global))
+        bs = max(1, bs_global // nproc)
+        steps = max(1, n_global // (bs * nproc))
+        rng_np = np.random.default_rng(self.getSeed() + jax.process_index())
         start_epoch = 0
         resume = self._latest_checkpoint()
+        if nproc > 1 and self.getCheckpointDir():
+            # resume only when EVERY process sees the same checkpoint epoch
+            # (shared filesystem); otherwise processes would run different
+            # epoch counts -> mismatched collectives -> deadlock
+            from jax.experimental import multihost_utils
+            seen = multihost_utils.process_allgather(
+                np.asarray(-1 if resume is None else resume))
+            if seen.min() == seen.max() and seen.min() >= 0:
+                resume = int(seen.min())
+            else:
+                if seen.max() >= 0:
+                    log.warning(
+                        "checkpoint epochs differ across processes (%s) — "
+                        "checkpointDir is not shared storage; starting "
+                        "fresh on all processes", seen.tolist())
+                resume = None
         if resume is not None:
             params, opt_state = self._restore_checkpoint(resume, params, opt_state)
             start_epoch = resume + 1
@@ -262,14 +296,19 @@ class TpuLearner(Estimator):
             order = (rng_np.permutation(n) if self.getShuffle()
                      else np.arange(n))
             for s in range(steps):
-                idx = order[s * bs:(s + 1) * bs]
-                xb, nb = meshlib.pad_batch_to_devices(x[idx], mesh)
-                yb, _ = meshlib.pad_batch_to_devices(y[idx], mesh)
+                # cyclic slice: a process whose shard is shorter than its
+                # share of the global batch wraps (repeats) its rows so every
+                # process contributes exactly bs rows — identical shapes
+                idx = order[(s * bs + np.arange(bs)) % n]
+                pad = (meshlib.pad_batch_to_local_devices if nproc > 1
+                       else meshlib.pad_batch_to_devices)
+                xb, nb = pad(x[idx], mesh)
+                yb, _ = pad(y[idx], mesh)
                 wb = np.zeros(len(xb), dtype=np.float32)
                 wb[:nb] = 1.0
-                xb = meshlib.shard_batch(xb, mesh)
-                yb = meshlib.shard_batch(yb, mesh)
-                wb = meshlib.shard_batch(wb, mesh)
+                xb = meshlib.put_global_batch(xb, mesh)
+                yb = meshlib.put_global_batch(yb, mesh)
+                wb = meshlib.put_global_batch(wb, mesh)
                 params, opt_state, loss = train_step(params, opt_state,
                                                      xb, yb, wb)
             last_loss = float(loss)
@@ -284,7 +323,7 @@ class TpuLearner(Estimator):
                        f"{self.getCheckpointDir()!r}; refit resumes there."
                        if last_good is not None
                        else "Set checkpointDir to make divergence resumable."))
-            if self.getCheckpointDir():
+            if self.getCheckpointDir() and jax.process_index() == 0:
                 self._save_checkpoint(epoch, params, opt_state)
 
         model = (TpuModel()

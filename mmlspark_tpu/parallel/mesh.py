@@ -82,17 +82,64 @@ def shard_batch(arrays, mesh: Mesh, batch_axis: str = "data"):
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)
 
 
-def pad_batch_to_devices(arr: np.ndarray, mesh: Mesh,
-                         batch_axis: str = "data") -> tuple[np.ndarray, int]:
-    """Pad dim 0 to a multiple of the data-axis size (XLA needs equal shards).
-    Returns (padded, original_n)."""
-    n_shards = mesh.shape[batch_axis]
+def _pad_rows_to_multiple(arr: np.ndarray, mult: int) -> tuple[np.ndarray, int]:
     n = arr.shape[0]
-    rem = (-n) % n_shards
+    rem = (-n) % max(1, mult)
     if rem == 0:
         return arr, n
     pad = np.repeat(arr[-1:], rem, axis=0)
     return np.concatenate([arr, pad], axis=0), n
+
+
+def pad_batch_to_devices(arr: np.ndarray, mesh: Mesh,
+                         batch_axis: str = "data") -> tuple[np.ndarray, int]:
+    """Pad dim 0 to a multiple of the data-axis size (XLA needs equal shards).
+    Returns (padded, original_n)."""
+    return _pad_rows_to_multiple(arr, mesh.shape[batch_axis])
+
+
+def pad_batch_to_local_devices(arr: np.ndarray, mesh: Mesh,
+                               batch_axis: str = "data") -> tuple[np.ndarray, int]:
+    """Multi-host variant of pad_batch_to_devices: pad THIS process's local
+    rows to a multiple of its share of the batch axis, so the per-process
+    shards concatenate into an evenly divisible global batch. NOTE: in SPMD
+    every process must end up with the SAME padded length — callers feed
+    equal-length slices (models.trainer synchronizes the per-step row count)."""
+    return _pad_rows_to_multiple(arr, mesh.shape[batch_axis]
+                                 // jax.process_count())
+
+
+def local_rows(global_array, n: Optional[int] = None) -> np.ndarray:
+    """THIS process's contiguous rows of a dim-0-sharded global array
+    (inverse of put_global_batch), optionally sliced to the first n real
+    (unpadded) rows."""
+    shards = sorted(global_array.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    out = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return out[:n] if n is not None else out
+
+
+def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
+    """Place a batch dim-0-sharded over `batch_axis`. Single-process: one
+    device_put. Multi-process: `arr` is THIS process's local rows; the global
+    array is assembled from every process's shard (the reference has no
+    analog — its data stays in Spark partitions and is shipped per-worker
+    over scp/JNI, CommandBuilders.scala:200-228)."""
+    if jax.process_count() == 1:
+        return jax.device_put(arr, batch_sharding(mesh, batch_axis))
+    return jax.make_array_from_process_local_data(
+        batch_sharding(mesh, batch_axis), np.asarray(arr))
+
+
+def put_replicated(tree, mesh: Mesh):
+    """Replicate a pytree over the whole (possibly multi-host) mesh. Every
+    process must hold identical values (same-seed init guarantees this)."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, replicated(mesh))
+    sh = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda a: jax.make_array_from_process_local_data(sh, np.asarray(a)),
+        tree)
 
 
 def shard_params_tp(params, mesh: Mesh, rules: Sequence[tuple[str, P]] = (),
