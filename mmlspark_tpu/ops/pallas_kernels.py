@@ -216,18 +216,23 @@ def histogram_fused(bins, grad, hess, n_bins: int = 256,
     # lane-align the bin axis (Mosaic can only collapse/split a trailing dim
     # that is a 128 multiple); extra bins never match any bin id -> zero rows
     n_pad = -(-n_bins // 128) * 128
-    # the (block_n, F, n_pad) one-hot staging must fit VMEM; the row block
-    # can't shrink below 128 (lane alignment), so when even a 128-row block
+    # VMEM sizing: the kernel's scoped allocation is ~4x the f32 one-hot
+    # staging (bool compare + mask + f32 cast + reshape copy of the
+    # (block_n, F, n_pad) tensor) — measured on v5e: F=10/block 512 one-hot
+    # 5.2MB allocates 20.6MB scoped and OOMs the 16MB limit. Budget the
+    # whole scoped footprint, not just the one-hot.
+    scoped_limit = 15 << 20          # stay under the 16MB scoped-vmem limit
+    onehot_row_bytes = F * n_pad * 4
+    rows_cap = (scoped_limit // (4 * onehot_row_bytes)) // 128 * 128
+    # the row block can't shrink below 128 (lane alignment); if even that
     # exceeds the budget the one-hot tiling is infeasible on TPU — use the
     # XLA scatter-add instead (same result, no VMEM staging)
-    budget = 6 << 20
-    if not interpret and 128 * F * n_pad * 4 > budget:
+    if not interpret and rows_cap < 128:
         return segment_histogram(bins, grad, hess, n_bins)
     # rows are the matmul contraction dim: keep blocks lane-aligned (128) so
     # the TPU lowering accepts them even when the call is vmapped (per-node
     # masked grads batch the 1xN operands)
-    rows_cap = max(128, (budget // (F * n_pad * 4)) // 128 * 128)
-    block_n = min(block_n, -(-N // 128) * 128, rows_cap)
+    block_n = min(block_n, -(-N // 128) * 128, max(128, rows_cap))
     pad = (-N) % block_n
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))

@@ -210,3 +210,15 @@ class TestStages:
         b = np.stack(list(m2.transform(_df_from_matrix(xte, yte))
                           .col("probability")))
         np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_max_bin_uint8_ceiling():
+    """uint8 bin wire format: max_bin beyond 256 must be rejected, not
+    silently wrapped."""
+    import pytest
+    from mmlspark_tpu.models.gbdt.engine import GBDTParams, fit_gbdt
+    x = np.random.default_rng(0).normal(size=(64, 3)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    with pytest.raises(ValueError, match="max_bin"):
+        fit_gbdt(x, y, GBDTParams(num_iterations=2, max_bin=300))
+    fit_gbdt(x, y, GBDTParams(num_iterations=2, max_bin=256))  # ceiling OK
