@@ -1,0 +1,60 @@
+"""Example 102/104 — regression + model selection (reference:
+notebooks/samples "102 - Regression Example with Flight Delay" and
+"104 - Model Comparison": TrainRegressor auto-featurization, FindBestModel
+across candidates, TuneHyperparameters random search, per-instance stats).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import (ComputePerInstanceStatistics, FindBestModel,
+                                 TrainRegressor, TuneHyperparameters)
+from mmlspark_tpu.models import (GBTRegressor, LinearRegression,
+                                 LogisticRegression, RandomForestRegressor)
+
+rng = np.random.default_rng(0)
+n = 300
+carrier = np.array(["AA", "UA", "DL"], dtype=object)[rng.integers(0, 3, n)]
+distance = rng.uniform(100, 3000, n)
+dep_hour = rng.integers(5, 23, n).astype(np.int64)
+delay = (0.01 * distance + 3.0 * (carrier == "UA") + 0.5 * dep_hour
+         + rng.normal(0, 2.0, n))
+df = DataFrame({"carrier": carrier, "distance": distance,
+                "dep_hour": dep_hour, "label": delay})
+train, test = df.randomSplit([0.8, 0.2], seed=1)
+
+# TrainRegressor with three candidate learners -> FindBestModel
+models = []
+for learner in (LinearRegression(), RandomForestRegressor()
+                .setNumIterations(20), GBTRegressor().setNumIterations(20)):
+    models.append(TrainRegressor().setModel(learner).fit(train))
+best = FindBestModel().setModels(tuple(models)) \
+    .setEvaluationMetric("rmse").fit(test)
+print("per-model rmse:", [(name, round(float(m), 3))
+                          for name, m in best.getAllModelMetrics()])
+scored = best.transform(test)
+rmse = float(np.sqrt(np.mean(
+    (scored.col("prediction") - test.col("label")) ** 2)))
+print("best model test rmse:", round(rmse, 3))
+assert rmse < 4.0
+
+# per-instance statistics (reference ComputePerInstanceStatistics)
+stats = (ComputePerInstanceStatistics().setEvaluationMetric("regression")
+         .transform(scored))
+assert "L1_loss" in stats.columns or "l1" in [c.lower() for c in stats.columns]
+
+# hyperparameter tuning on a classification variant (104-style):
+# tune works on feature-vector frames, so auto-featurize first
+from mmlspark_tpu.automl import Featurize
+
+y_cls = (delay > np.median(delay)).astype(np.int64)
+cdf = DataFrame({"carrier": carrier, "distance": distance,
+                 "dep_hour": dep_hour, "label": y_cls})
+cdf = Featurize().setOutputCol("features").fit(cdf).transform(cdf)
+tuned = (TuneHyperparameters()
+         .setModels((LogisticRegression().setMaxIter(30),))
+         .setEvaluationMetric("accuracy").setNumFolds(3).setNumRuns(3)
+         .fit(cdf))
+print("tuned accuracy:", round(float(tuned.getBestMetric()), 3))
+assert tuned.getBestMetric() > 0.6
+print("example 102 OK")

@@ -34,6 +34,14 @@ from ...core.utils import get_logger, object_column
 log = get_logger("io.http")
 
 
+class _BurstyHTTPServer(ThreadingHTTPServer):
+    """socketserver's default listen backlog (request_queue_size=5) makes a
+    burst of concurrent clients overflow the accept queue; the kernel drops
+    their SYNs and they crawl in via retransmit backoff (seconds). Serving
+    layers exist to absorb bursts — raise the backlog."""
+    request_queue_size = 128
+
+
 class _Exchange:
     """One in-flight request awaiting a reply (the HttpExchange analog)."""
 
@@ -87,7 +95,7 @@ class HTTPSource:
         last_err = None
         for probe in range(max_port_probes):
             try:
-                self.server = ThreadingHTTPServer(
+                self.server = _BurstyHTTPServer(
                     (host, port + probe if port else 0), Handler)
                 break
             except OSError as e:

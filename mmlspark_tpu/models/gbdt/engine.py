@@ -101,9 +101,10 @@ def compute_bin_edges(x: np.ndarray, max_bin: int,
 def bin_data(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
     """(n, d) floats -> (n, d) uint8 bin ids in [0, max_bin). NaN -> bin 0.
 
-    uint8 is the wire format (max_bin <= 255 always): the bin matrix is the
-    one large host->HBM transfer the fit makes, and shipping bytes moves 4x
-    less than int32 — kernels upcast on device."""
+    uint8 is the wire format (ids top out at max_bin-1 <= 255; fit_gbdt
+    enforces max_bin <= 256): the bin matrix is the one large host->HBM
+    transfer the fit makes, and shipping bytes moves 4x less than int32 —
+    kernels upcast on device."""
     n, d = x.shape
     out = np.empty((n, d), dtype=np.uint8)
     xf = x.astype(np.float32)

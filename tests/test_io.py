@@ -327,38 +327,40 @@ def test_using_body_error_wins():
 
 def test_distributed_skewed_traffic_uses_full_budget():
     """All traffic on one worker: the idle workers' quota must be handed
-    over, not wasted (second zero-timeout drain pass)."""
+    over, not wasted (second zero-timeout drain pass). No serving loop —
+    requests are queued first, then ONE getBatch must collect them all."""
     import json
     import threading
+    import time
     import requests as rq
-    from mmlspark_tpu.io.http import serve_distributed
+    from mmlspark_tpu.io.http import DistributedHTTPSource
 
-    seen_batches = []
-
-    class Echo(Transformer):
-        def transform(self, df):
-            seen_batches.append(df.count())
-            replies = [json.dumps({"y": json.loads(v)["x"]})
-                       for v in df.col("value")]
-            return df.withColumn("reply", object_column(replies))
-
-    source, loop = serve_distributed(Echo(), n_workers=4, max_batch=64)
+    source = DistributedHTTPSource(n_workers=4)
     try:
         url = source.urls[0]  # every client hits ONE worker
         results = []
 
         def client(i):
-            results.append(rq.post(url, json={"x": i}, timeout=10).json()["y"])
+            results.append(rq.post(url, json={"x": i}, timeout=15).json()["y"])
 
         threads = [threading.Thread(target=client, args=(i,))
                    for i in range(32)]
         for t in threads:
             t.start()
+        # wait until all 32 requests are QUEUED on worker 0, then drain once
+        deadline = time.monotonic() + 10
+        while (source.workers[0]._pending.qsize() < 32
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        batch = source.getBatch(64)
+        # per-worker quota alone would cap this single drain at 64//4=16
+        # rows; the handover lets worker 0 fill the whole budget
+        assert batch.count() == 32, batch.count()
+        for row in batch.iterRows():
+            source.respond(row["id"], 200,
+                           json.dumps({"y": json.loads(row["value"])["x"]}))
         for t in threads:
             t.join()
         assert sorted(results) == list(range(32))
-        # with per-worker quota 64//4=16 and no redistribution this would
-        # need >= 2 batches of <=16; the handover allows bigger merges
-        assert max(seen_batches) > 16 or len(seen_batches) <= 2, seen_batches
     finally:
-        loop.stop()
+        source.close()
