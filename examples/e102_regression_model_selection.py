@@ -24,14 +24,15 @@ df = DataFrame({"carrier": carrier, "distance": distance,
 train, test = df.randomSplit([0.8, 0.2], seed=1)
 
 # TrainRegressor with three candidate learners -> FindBestModel
-models = []
-for learner in (LinearRegression(), RandomForestRegressor()
-                .setNumIterations(20), GBTRegressor().setNumIterations(20)):
-    models.append(TrainRegressor().setModel(learner).fit(train))
+learners = (LinearRegression(), RandomForestRegressor().setNumIterations(20),
+            GBTRegressor().setNumIterations(20))
+models = [TrainRegressor().setModel(l).fit(train) for l in learners]
 best = FindBestModel().setModels(tuple(models)) \
     .setEvaluationMetric("rmse").fit(test)
-print("per-model rmse:", [(name, round(float(m), 3))
-                          for name, m in best.getAllModelMetrics()])
+# getAllModelMetrics names the wrappers; zip with the inner learner classes
+print("per-model rmse:",
+      [(type(l).__name__, round(float(m), 3))
+       for l, (_, m) in zip(learners, best.getAllModelMetrics())])
 scored = best.transform(test)
 rmse = float(np.sqrt(np.mean(
     (scored.col("prediction") - test.col("label")) ** 2)))

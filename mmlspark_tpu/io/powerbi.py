@@ -44,3 +44,67 @@ def write(df: DataFrame, url: str, batch_size: int = 1000,
                               f"{resp.text[:200]}")
             sent += 1
     return sent
+
+
+class StreamWriter:
+    """Continuous micro-batch POST loop (reference PowerBIWriter.stream wires
+    the same POST into Spark structured streaming; here the source is any
+    callable returning the next DataFrame batch — e.g. an HTTPSource's
+    getBatch or a generator over a live table)."""
+
+    def __init__(self, get_batch, url: str, interval: float = 1.0,
+                 batch_size: int = 1000, timeout: float = 30.0):
+        import threading
+        self._get_batch = get_batch
+        self.url = url
+        self.interval = interval
+        self.batch_size = batch_size
+        self.timeout = timeout
+        self.batches_sent = 0
+        self.errors = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        pending = None               # at-least-once: a failed batch is
+        while not self._stop.is_set():  # retried, never dropped
+            if pending is None:
+                try:
+                    df = self._get_batch()
+                except Exception as e:  # source failure: log, keep streaming
+                    log.warning("powerbi stream source failed: %s", e)
+                    self.errors += 1
+                    df = None
+            else:
+                df = pending
+            if df is not None and len(df):
+                try:
+                    self.batches_sent += write(df, self.url,
+                                               batch_size=self.batch_size,
+                                               timeout=self.timeout)
+                    pending = None
+                except Exception as e:  # sink failure: retry this batch
+                    log.warning("powerbi stream post failed (will retry): %s",
+                                e)
+                    self.errors += 1
+                    pending = df
+            # throttle EVERY tick — the PowerBI push API is rate-limited and
+            # a down endpoint must not spin the loop hot
+            self._stop.wait(self.interval)
+
+    def start(self) -> "StreamWriter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def stream(get_batch, url: str, interval: float = 1.0,
+           batch_size: int = 1000) -> StreamWriter:
+    """Start a continuous writer; returns the running StreamWriter
+    (reference PowerBIWriter.stream returns the StreamingQuery the same
+    way)."""
+    return StreamWriter(get_batch, url, interval=interval,
+                        batch_size=batch_size).start()

@@ -364,3 +364,87 @@ def test_distributed_skewed_traffic_uses_full_budget():
         assert sorted(results) == list(range(32))
     finally:
         source.close()
+
+
+def test_powerbi_stream_writer():
+    """Continuous micro-batch POSTs against a live local endpoint, with a
+    failing-source interval and clean stop."""
+    import json as _json
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(_json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/"
+
+    batches = [DataFrame({"a": np.arange(3.0)}),
+               None,                                   # idle tick
+               DataFrame({"a": np.arange(2.0)})]
+
+    def get_batch():
+        return batches.pop(0) if batches else None
+
+    w = powerbi.stream(get_batch, url, interval=0.05)
+    deadline = time.monotonic() + 10
+    while len(received) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    w.stop()
+    srv.shutdown()
+    assert len(received) == 2
+    assert [len(r["rows"]) for r in received] == [3, 2]
+    assert w.batches_sent == 2 and w.errors == 0
+
+
+def test_powerbi_stream_retries_failed_batch():
+    """At-least-once: a batch that fails to POST is retried, not dropped."""
+    import json as _json
+    import time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    received = []
+    fail_first = {"n": 2}  # reject the first two attempts
+
+    class Sink(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            if fail_first["n"] > 0:
+                fail_first["n"] -= 1
+                self.send_response(503)
+                self.end_headers()
+                return
+            received.append(_json.loads(body))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}/"
+
+    batches = [DataFrame({"a": np.arange(4.0)})]
+    w = powerbi.stream(lambda: batches.pop(0) if batches else None, url,
+                       interval=0.05)
+    deadline = time.monotonic() + 10
+    while not received and time.monotonic() < deadline:
+        time.sleep(0.05)
+    w.stop()
+    srv.shutdown()
+    assert len(received) == 1 and len(received[0]["rows"]) == 4
+    assert w.errors == 2 and w.batches_sent == 1
