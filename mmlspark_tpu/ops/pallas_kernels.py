@@ -37,11 +37,12 @@ def _interpret() -> bool:
 
 # ------------------------------------------------------------ flash attention
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                   *, block_q: int, block_k: int, causal: bool, scale: float,
                   seq_k: int):
     """Grid = (BH, num_q_blocks, num_k_blocks); KV innermost so the softmax
-    state in scratch carries across the k dimension for one q block."""
+    state in scratch carries across the k dimension for one q block. Also
+    emits the row logsumexp (the residual the backward kernels need)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -89,8 +90,114 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     def _finalize():
         denom = jnp.maximum(l_ref[:, 0], 1e-30)[:, None]
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        lse = m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30))
+        lse_ref[0] = jnp.where(m_ref[:, 0] <= NEG_INF / 2, NEG_INF,
+                               lse)[:, None]
 
 
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                         dq_ref, acc_ref, *, block_q: int, block_k: int,
+                         causal: bool, scale: float, seq_k: int):
+    """dq = (P * (dO V^T - D)) K * scale, accumulated over KV blocks.
+    Grid = (BH, num_q_blocks, num_k_blocks), KV innermost."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]                           # (bq,)
+        dvec = dvec_ref[0][:, 0]                         # (bq,)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, block_k), 1)
+        valid = kpos < seq_k
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] <= NEG_INF / 2, 0.0, p)   # padded q rows
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec[:, None])
+        acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
+                          block_k: int, causal: bool, scale: float,
+                          seq_k: int):
+    """dv = P^T dO; dk = (P * (dO V^T - D))^T Q * scale, accumulated over
+    Q blocks. Grid = (BH, num_k_blocks, num_q_blocks), Q innermost."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+    run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        dvec = dvec_ref[0][:, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        kpos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                  (block_q, block_k), 1)
+        valid = kpos < seq_k
+        if causal:
+            qpos = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 0)
+            valid = jnp.logical_and(valid, qpos >= kpos)
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(lse[:, None] <= NEG_INF / 2, 0.0, p)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, D)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dvec[:, None])
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, D)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     block_q: int = 1024, block_k: int = 1024,
                     interpret=None):
@@ -100,11 +207,101 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     O(T^2). Sequence dims are padded to block multiples internally (padded
     keys masked, padded queries sliced off).
 
+    Differentiable: pallas_call has no JVP, so a custom VJP pairs this
+    forward with hand-written Pallas backward kernels (dq and dk/dv passes
+    over the saved row logsumexp) — O(T) memory in both directions, the full
+    FlashAttention recurrence.
+
     Default blocks from an on-chip sweep at (B,T,H,D)=(8,4096,8,64), causal,
     v5e, scalar-sync timing: 128x128 10 TF/s, 256x256 21, 512x512 34,
     512x1024 46, 1024x1024 58 TF/s; 1024x2048 exceeds the 16MB scoped VMEM
     limit. Blocks clamp to the sequence length for short inputs.
     """
+    out, _ = _flash_attention_fwd_impl(q, k, v, causal, scale, block_q,
+                                       block_k, interpret)
+    return out
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out, lse = _flash_attention_fwd_impl(q, k, v, causal, scale, block_q,
+                                         block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
+                         residuals, g):
+    q, k, v, out, lse = residuals
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    interpret = _interpret() if interpret is None else interpret
+    # the (bq, bk) temporaries (S, P, dP, dS) quadruple the block footprint
+    # vs the forward — halve the blocks to stay inside scoped VMEM
+    block_q = min(block_q, 512, max(8, Tq))
+    block_k = min(block_k, 512, max(8, Tk))
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
+
+    pq = (-Tq) % block_q
+    pk = (-Tk) % block_k
+    qb = jnp.pad(to_bh(q), ((0, 0), (0, pq), (0, 0)))
+    kb = jnp.pad(to_bh(k), ((0, 0), (0, pk), (0, 0)))
+    vb = jnp.pad(to_bh(v), ((0, 0), (0, pk), (0, 0)))
+    dob = jnp.pad(to_bh(g).astype(q.dtype), ((0, 0), (0, pq), (0, 0)))
+    # D_i = rowsum(dO * O) — cheap elementwise residual
+    dvec = jnp.sum(to_bh(g).astype(jnp.float32)
+                   * to_bh(out).astype(jnp.float32), axis=-1)
+    dvec = jnp.pad(dvec, ((0, 0), (0, pq)))[..., None]   # (BH, Tq_pad, 1)
+    lse_b = jnp.pad(lse, ((0, 0), (0, pq)),
+                    constant_values=NEG_INF)[..., None]
+    nq = qb.shape[1] // block_q
+    nk = kb.shape[1] // block_k
+
+    common = dict(block_q=block_q, block_k=block_k, causal=causal,
+                  scale=scale, seq_k=Tk)
+    qspec = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    qrow = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(B * H, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qrow, qrow],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse_b, dvec)
+
+    # dkv grid: K blocks outer, Q blocks inner (accumulators live per-K)
+    qspec_i = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
+    kspec_i = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    qrow_i = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(B * H, nk, nq),
+        in_specs=[qspec_i, kspec_i, kspec_i, qspec_i, qrow_i, qrow_i],
+        out_specs=(kspec_i, kspec_i),
+        out_shape=(jax.ShapeDtypeStruct(kb.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vb.shape, v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse_b, dvec)
+
+    def from_bh(x, T):
+        return x[:, :T].reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    return (from_bh(dq, Tq).astype(q.dtype),
+            from_bh(dk, Tk).astype(k.dtype),
+            from_bh(dv, Tk).astype(v.dtype))
+
+
+flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
+                              interpret):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
@@ -126,7 +323,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, causal=causal, scale=scale,
                                seq_k=Tk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
@@ -134,8 +331,10 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(qb.shape, q.dtype),
+        out_specs=(pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))),
+        out_shape=(jax.ShapeDtypeStruct(qb.shape, q.dtype),
+                   jax.ShapeDtypeStruct(qb.shape[:2] + (1,), jnp.float32)),
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -144,7 +343,7 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         interpret=interpret,
     )(qb, kb, vb)
     out = out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
-    return out
+    return out, lse[:, :Tq, 0]
 
 
 # ------------------------------------------------------------ GBDT histogram

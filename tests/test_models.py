@@ -394,3 +394,32 @@ def test_resnet_config_validation():
     with pytest.raises(ValueError, match="stem must be"):
         bad_stem.init(__import__("jax").random.PRNGKey(0),
                       np.zeros((1, 8, 8, 3), np.float32))
+
+
+def test_transformer_remat_parity():
+    """remat=True must give identical outputs and gradients to remat=False
+    (it only changes what's stored vs recomputed on the backward pass)."""
+    import jax
+    from mmlspark_tpu.models import build_model
+    cfg = {"type": "transformer", "vocab_size": 40, "d_model": 16,
+           "heads": 2, "layers": 2, "num_classes": 3, "max_len": 32}
+    tok = np.asarray(np.random.default_rng(0).integers(0, 40, (4, 16)),
+                     np.int32)
+    m0 = build_model(cfg)
+    m1 = build_model({**cfg, "remat": True})
+    params = m0.init(jax.random.PRNGKey(0), tok)
+    out0 = m0.apply(params, tok)
+    out1 = m1.apply(params, tok)   # same param structure
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-6, atol=1e-6)
+    g0 = jax.grad(lambda p: m0.apply(p, tok).sum())(params)
+    g1 = jax.grad(lambda p: m1.apply(p, tok).sum())(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+    import pytest
+    bad = build_model({**cfg, "remat": True, "num_experts": 2})
+    with pytest.raises(ValueError, match="remat with MoE"):
+        bad.init(jax.random.PRNGKey(0), tok)

@@ -103,3 +103,29 @@ def test_gbdt_pallas_hist_matches_segment(rng):
     np.testing.assert_array_equal(np.asarray(e1.threshold),
                                   np.asarray(e2.threshold))
     np.testing.assert_allclose(predict(e1, x), predict(e2, x), atol=1e-5)
+
+
+def test_flash_attention_gradients():
+    """flash_attention must be differentiable (custom VJP: kernel forward,
+    blockwise-recompute backward) and match blockwise gradients."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.pallas_kernels import flash_attention
+    from mmlspark_tpu.parallel.sequence import blockwise_attention
+
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 64, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+               for _ in range(3))
+    for causal in (False, True):
+        def loss_f(q, k, v, c=causal):
+            return (flash_attention(q, k, v, causal=c) ** 2).sum()
+
+        def loss_b(q, k, v, c=causal):
+            return (blockwise_attention(q, k, v, causal=c) ** 2).sum()
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gb = jax.grad(loss_b, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
