@@ -1,0 +1,52 @@
+"""Example 202 — Word2Vec text features (reference: notebooks/samples/
+"202 - Amazon Book Reviews - Word2Vec": tokenize review text, train
+Word2Vec embeddings, average them into document vectors, and train a
+classifier on those vectors).
+
+Synthetic review-shaped data; the embedding fit is a batched skip-gram
+negative-sampling loop jitted onto the accelerator (see ops/word2vec.py).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import ComputeModelStatistics
+from mmlspark_tpu.models import LogisticRegression
+from mmlspark_tpu.ops import Word2Vec
+
+rng = np.random.default_rng(0)
+positive = ["great", "wonderful", "loved", "excellent", "gripping"]
+negative = ["boring", "awful", "hated", "dull", "tedious"]
+filler = ["book", "story", "plot", "read", "author", "chapter"]
+
+n = 400
+texts, labels = [], []
+for _ in range(n):
+    label = int(rng.random() < 0.5)
+    mood = positive if label else negative
+    words = list(rng.choice(mood, 4)) + list(rng.choice(filler, 6))
+    rng.shuffle(words)
+    texts.append(" ".join(words))
+    labels.append(label)
+
+df = DataFrame({"text": np.array(texts, dtype=object),
+                "label": np.array(labels, dtype=np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)
+
+w2v = (Word2Vec().setInputCol("text").setOutputCol("features")
+       .setVectorSize(32).setMinCount(2).setWindowSize(4)
+       .setMaxIter(3).setBatchSize(4096).setSeed(2))
+w2v_model = w2v.fit(train)
+
+# word geometry: nearest neighbors of a sentiment word are same-sentiment
+syn = w2v_model.findSynonyms("great", 3)
+print("synonyms of 'great':", list(syn.col("word")))
+
+clf = LogisticRegression().setMaxIter(40).fit(w2v_model.transform(train))
+scored = clf.transform(w2v_model.transform(test))
+metrics = ComputeModelStatistics().transform(scored)
+row = metrics.first()
+print({k: round(float(v), 3) for k, v in row.items()
+       if k in ("accuracy", "AUC")})
+assert row["accuracy"] > 0.8, "doc vectors should separate sentiment"
+print("example 202 OK")

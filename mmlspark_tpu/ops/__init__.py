@@ -1,6 +1,8 @@
 from . import image_ops, text_ops
 from .image_stages import ImageSetAugmenter, ImageTransformer, UnrollImage
 from .text_stages import TextFeaturizer, TextFeaturizerModel
+from .word2vec import Word2Vec, Word2VecModel
 
 __all__ = ["image_ops", "text_ops", "ImageTransformer", "UnrollImage",
-           "ImageSetAugmenter", "TextFeaturizer", "TextFeaturizerModel"]
+           "ImageSetAugmenter", "TextFeaturizer", "TextFeaturizerModel",
+           "Word2Vec", "Word2VecModel"]

@@ -18,7 +18,7 @@ from mmlspark_tpu.testing.fuzzing import (FUZZING_REGISTRY, TestObject,
                                           serialization_fuzz)
 
 from mmlspark_tpu.ops import (ImageSetAugmenter, ImageTransformer,
-                              TextFeaturizer, UnrollImage)
+                              TextFeaturizer, UnrollImage, Word2Vec)
 from mmlspark_tpu.models import (DecisionTreeClassifier, DecisionTreeRegressor,
                                  GBTClassifier, GBTRegressor,
                                  LightGBMClassifier, LightGBMRegressor,
@@ -98,6 +98,9 @@ _t(ImageSetAugmenter, lambda: TestObject(
     ImageSetAugmenter().setInputCol("image").setOutputCol("image"), IMG))
 _t(TextFeaturizer, lambda: TestObject(
     TextFeaturizer().setInputCol("text").setNumFeatures(32), TAB))
+_t(Word2Vec, lambda: TestObject(
+    Word2Vec().setInputCol("text").setVectorSize(8).setMinCount(1)
+    .setBatchSize(64), TAB))
 
 
 def _tpu_model():

@@ -204,3 +204,75 @@ class TestTextOps:
         a = text_ops.rows_to_matrix(model.transform(toy_df).col("features"))
         b = text_ops.rows_to_matrix(m2.transform(toy_df).col("features"))
         np.testing.assert_allclose(a.toarray(), b.toarray())
+
+
+class TestWord2Vec:
+    def _corpus_df(self):
+        # two tight co-occurrence clusters: pets vs vehicles
+        rng = np.random.default_rng(7)
+        pets, vehicles = ["cat", "dog", "puppy"], ["car", "truck", "engine"]
+        docs = []
+        for _ in range(200):
+            group = pets if rng.random() < 0.5 else vehicles
+            docs.append(" ".join(rng.choice(group, size=6)))
+        return DataFrame({"text": np.array(docs, dtype=object)})
+
+    def _fit(self, df, **kw):
+        from mmlspark_tpu.ops import Word2Vec
+        w2v = (Word2Vec().setInputCol("text").setVectorSize(16)
+               .setMinCount(1).setWindowSize(3).setMaxIter(3)
+               .setBatchSize(512).setStepSize(0.1).setSeed(1))
+        for k, v in kw.items():
+            w2v.set(**{k: v})
+        return w2v.fit(df)
+
+    def test_synonyms_reflect_cooccurrence(self):
+        model = self._fit(self._corpus_df())
+        syn = model.findSynonyms("cat", 5)
+        words = list(syn.col("word"))
+        # in-cluster words must outrank every cross-cluster word
+        assert set(words[:2]) == {"dog", "puppy"}, words
+        sims = list(syn.col("similarity"))
+        assert sims == sorted(sims, reverse=True)
+
+    def test_transform_averages_vectors(self):
+        model = self._fit(self._corpus_df())
+        df = DataFrame({"text": np.array(["cat dog", "zzz unseen"],
+                                         dtype=object)})
+        out = model.transform(df)
+        vecs = np.asarray(model.getWordVectors())
+        vocab = list(model.getVocabulary())
+        expect = (vecs[vocab.index("cat")] + vecs[vocab.index("dog")]) / 2
+        np.testing.assert_allclose(out.col("features")[0], expect, rtol=1e-5)
+        # all-OOV row -> zero vector (Spark semantics)
+        np.testing.assert_array_equal(out.col("features")[1],
+                                      np.zeros(16, np.float32))
+
+    def test_get_vectors_and_min_count(self):
+        df = DataFrame({"text": np.array(
+            ["a a a a b", "a b a b rare"], dtype=object)})
+        model = self._fit(df, minCount=2)
+        vocab = list(model.getVocabulary())
+        assert "rare" not in vocab and set(vocab) == {"a", "b"}
+        gv = model.getVectors()
+        assert list(gv.col("word")) == vocab
+        assert gv.col("vector")[0].shape == (16,)
+        # num >= vocab: the query word itself is never returned
+        syn = model.findSynonyms("a", 5)
+        assert list(syn.col("word")) == ["b"]
+        assert np.isfinite(syn.col("similarity")).all()
+
+    def test_pretokenized_input(self):
+        df = DataFrame({"text": np.array(
+            [["x", "y"], ["y", "x"], None], dtype=object)})
+        model = self._fit(df, minCount=1)
+        assert set(model.getVocabulary()) == {"x", "y"}
+
+    def test_roundtrip(self, tmp_path):
+        from mmlspark_tpu.core import load_stage
+        model = self._fit(self._corpus_df())
+        model.save(str(tmp_path / "w2v"))
+        m2 = load_stage(str(tmp_path / "w2v"))
+        df = DataFrame({"text": np.array(["cat truck"], dtype=object)})
+        np.testing.assert_allclose(model.transform(df).col("features")[0],
+                                   m2.transform(df).col("features")[0])
