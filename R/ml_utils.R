@@ -1,0 +1,69 @@
+# R binding runtime for mmlspark_tpu (reference analog: the hand-written
+# core/ml/src/main/R/ml_utils.R glue behind the generated SparklyR wrappers).
+# The generated half — one constructor per stage — is R/generated_wrappers.R,
+# emitted by `python -m mmlspark_tpu.codegen`.
+#
+# The reference binds R to the JVM through sparklyr::invoke; this framework is
+# Python-first, so the bridge is reticulate. Stages, models and DataFrames are
+# reticulate proxies to the Python objects; data crosses as R data.frames.
+
+#' Import the mmlspark_tpu Python package (cached).
+mt_module <- function() {
+  if (!requireNamespace("reticulate", quietly = TRUE)) {
+    stop("the mmlspark_tpu R binding requires the 'reticulate' package")
+  }
+  reticulate::import("mmlspark_tpu", delay_load = TRUE)
+}
+
+#' Construct a stage by its registered qualified class name.
+mt_stage <- function(qualified_name) {
+  pipeline <- reticulate::import("mmlspark_tpu.core.pipeline")
+  cls <- pipeline$lookup_stage_class(qualified_name)
+  cls()
+}
+
+#' Set one param through its typed setter (validates domain Python-side).
+mt_set_param <- function(stage, name, value) {
+  setter <- paste0("set", toupper(substring(name, 1, 1)), substring(name, 2))
+  do.call(`$`(stage, setter), list(value))
+}
+
+#' Set every non-NULL param in a named list; returns the stage (chainable).
+mt_set_params <- function(stage, params) {
+  for (name in names(params)) {
+    if (!is.null(params[[name]])) {
+      stage <- mt_set_param(stage, name, params[[name]])
+    }
+  }
+  stage
+}
+
+#' Build a framework DataFrame from an R data.frame.
+mt_dataframe <- function(df) {
+  mt <- mt_module()
+  mt$DataFrame$fromPandas(reticulate::r_to_py(df))
+}
+
+#' Fit an Estimator; returns the fitted Model proxy.
+mt_fit <- function(estimator, data) {
+  if (is.data.frame(data)) data <- mt_dataframe(data)
+  estimator$fit(data)
+}
+
+#' Transform with a Transformer/Model; returns an R data.frame.
+mt_transform <- function(transformer, data) {
+  if (is.data.frame(data)) data <- mt_dataframe(data)
+  out <- transformer$transform(data)
+  reticulate::py_to_r(out$toPandas())
+}
+
+#' Save / load any stage (Python-side ComplexParams serialization).
+mt_save <- function(stage, path) {
+  stage$save(path)
+  invisible(path)
+}
+
+mt_load <- function(path) {
+  core <- reticulate::import("mmlspark_tpu.core")
+  core$load_stage(path)
+}

@@ -173,6 +173,86 @@ def generate_stubs(out_dir: str) -> list[str]:
     return paths
 
 
+# --------------------------------------------------------------- R wrappers
+
+def _r_name(cls_name: str) -> str:
+    """CamelCase -> mt_snake_case (sparklyr's ml_logistic_regression style).
+    Acronym runs stay fused until their last letter (GBTClassifier ->
+    gbt_classifier, HTTPTransformer -> http_transformer); digits glue
+    (Word2Vec -> word2vec, sparklyr's ft_word2vec)."""
+    out = []
+    for i, ch in enumerate(cls_name):
+        if ch.isupper() and i:
+            prev = cls_name[i - 1]
+            nxt = cls_name[i + 1] if i + 1 < len(cls_name) else ""
+            if prev.islower() or (prev.isupper() and nxt.islower()):
+                out.append("_")
+        out.append(ch.lower())
+    return "mt_" + "".join(out)
+
+
+def _r_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, int):
+        return f"{v}L"
+    if isinstance(v, float):
+        return repr(v)
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, (list, tuple)):
+        return "list(" + ", ".join(_r_literal(x) for x in v) + ")"
+    if isinstance(v, dict):
+        return "list(" + ", ".join(
+            f"{k} = {_r_literal(x)}" for k, x in v.items()) + ")"
+    return "NULL"
+
+
+def stage_r_wrapper(qual: str, cls: type) -> str:
+    """One R constructor function per stage, sparklyr-shaped: named args with
+    the Param defaults, passed through to the Python setters via reticulate
+    (reference SparklyRWrapper.scala emits the same per-stage surface)."""
+    params = cls.params()
+    simple = [n for n in sorted(params) if params[n].jsonable]
+    required = [n for n in simple if not params[n].has_default]
+    optional = [n for n in simple if params[n].has_default]
+    args = required + [f"{n} = {_r_literal(params[n].default)}"
+                       for n in optional]
+    first = (cls.__doc__ or "").strip().split("\n")[0]
+    sig = ", ".join(args)
+    lines = [f"#' {cls.__name__} ({_kind(cls)}). {first}".rstrip(),
+             "#' Integer params take R integers (5L); complex params via"
+             " mt_set_param().",
+             f"{_r_name(cls.__name__)} <- function({sig}) {{",
+             f'  stage <- mt_stage("{qual}")']
+    if simple:
+        lines.append("  mt_set_params(stage, list(")
+        lines.append("    " + ",\n    ".join(f"{n} = {n}" for n in simple))
+        lines += ["  ))", "}", ""]
+    else:
+        lines += ["  stage", "}", ""]
+    return "\n".join(lines)
+
+
+def generate_r_wrappers(out_path: str) -> str:
+    """Write the generated half of the R binding: one wrapper per registered
+    stage. The static runtime half (mt_stage/mt_set_params/mt_fit/...) lives
+    in R/ml_utils.R, the analog of the reference's hand-written
+    core/ml/src/main/R/ml_utils.R."""
+    chunks = ["# Generated by mmlspark_tpu.codegen -- do not edit.",
+              "# Requires R/ml_utils.R (reticulate runtime glue).", ""]
+    for qual, cls in sorted(_framework_stages().items()):
+        if issubclass(cls, Model):
+            continue  # fitted models come back from mt_fit, not constructors
+        chunks.append(stage_r_wrapper(qual, cls))
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "w") as f:
+        f.write("\n".join(chunks))
+    return out_path
+
+
 # -------------------------------------------------------------- smoke tests
 
 def generate_smoke_tests(out_path: str) -> str:
@@ -243,4 +323,6 @@ def generate_all(repo_root: str) -> dict[str, list[str]]:
     stubs = generate_stubs(os.path.join(repo_root, "stubs"))
     tests = [generate_smoke_tests(
         os.path.join(repo_root, "tests", "test_generated_smoke.py"))]
-    return {"docs": docs, "stubs": stubs, "tests": tests}
+    r = [generate_r_wrappers(
+        os.path.join(repo_root, "R", "generated_wrappers.R"))]
+    return {"docs": docs, "stubs": stubs, "tests": tests, "r": r}

@@ -32,6 +32,10 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
+
+# one shared transform so fit() init and _sgns_step update can never drift
+_ADAM = optax.scale_by_adam()
 
 from ..core.dataframe import DataFrame
 from ..core.params import (ComplexParam, FloatParam, IntParam, StringParam)
@@ -114,8 +118,6 @@ def _unigram_table(counts, size=1 << 18):
 @functools.partial(jax.jit, static_argnums=(7,))
 def _sgns_step(emb_in, emb_out, opt_state, centers, contexts, valid, key,
                num_neg, table, lr):
-    import optax
-
     negs = table[jax.random.randint(key, (centers.shape[0], num_neg),
                                     0, table.shape[0])]
 
@@ -135,8 +137,7 @@ def _sgns_step(emb_in, emb_out, opt_state, centers, contexts, valid, key,
     # Adam direction with the (decayed) lr applied outside: large-batch
     # SGNS needs per-coordinate scaling — word2vec.c's per-pair SGD either
     # stalls (mean loss) or blows up (sum loss) once pairs are batched
-    updates, opt_state = optax.scale_by_adam().update(
-        grads, opt_state, (emb_in, emb_out))
+    updates, opt_state = _ADAM.update(grads, opt_state, (emb_in, emb_out))
     emb_in = emb_in - lr * updates[0]
     emb_out = emb_out - lr * updates[1]
     return emb_in, emb_out, opt_state, loss
@@ -224,8 +225,7 @@ class Word2Vec(Estimator, _W2VParams):
         table = jnp.asarray(_unigram_table(counts))
         bs = self.getBatchSize()
         key = jax.random.PRNGKey(self.getSeed())
-        import optax
-        opt_state = optax.scale_by_adam().init((emb_in, emb_out))
+        opt_state = _ADAM.init((emb_in, emb_out))
 
         for epoch in range(self.getMaxIter()):
             centers, contexts = _skipgram_pairs(

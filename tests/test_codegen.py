@@ -8,9 +8,10 @@ import sys
 import pytest
 
 import mmlspark_tpu  # populate registry
-from mmlspark_tpu.codegen import (_framework_stages, generate_docs,
-                                  generate_smoke_tests, generate_stubs,
-                                  stage_doc_markdown, synth_value)
+from mmlspark_tpu.codegen import (_framework_stages, _r_name, generate_docs,
+                                  generate_r_wrappers, generate_smoke_tests,
+                                  generate_stubs, stage_doc_markdown,
+                                  synth_value)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -77,3 +78,30 @@ def test_committed_docs_in_sync(tmp_path):
     on_disk = {f: open(os.path.join(committed, f)).read()
                for f in os.listdir(committed)}
     assert fresh == on_disk, "docs/api stale: python -m mmlspark_tpu.codegen"
+
+
+def test_r_wrappers_cover_every_stage(tmp_path):
+    """Every non-Model stage gets an R constructor (reference
+    SparklyRWrapper.scala emits one wrapper per stage); generated file is
+    balanced R (paren/brace count) and references the runtime glue."""
+    from mmlspark_tpu.core.pipeline import Model
+    path = generate_r_wrappers(str(tmp_path / "generated_wrappers.R"))
+    src = open(path).read()
+    for qual, cls in _framework_stages().items():
+        if issubclass(cls, Model):
+            continue
+        assert f"{_r_name(cls.__name__)} <- function(" in src, cls.__name__
+        assert f'mt_stage("{qual}")' in src
+    code = "\n".join(l for l in src.splitlines() if not l.startswith("#"))
+    assert code.count("(") == code.count(")")
+    assert code.count("{") == code.count("}")
+    assert "mt_set_params" in src
+
+
+def test_committed_r_wrappers_in_sync(tmp_path):
+    committed = os.path.join(REPO, "R", "generated_wrappers.R")
+    if not os.path.isfile(committed):
+        pytest.skip("R wrappers not generated yet")
+    path = generate_r_wrappers(str(tmp_path / "generated_wrappers.R"))
+    assert open(path).read() == open(committed).read(), (
+        "R wrappers stale: python -m mmlspark_tpu.codegen")
