@@ -229,6 +229,93 @@ class DataFrame:
         mask = rng.random(self._n) < fraction
         return self.filter(mask)
 
+    # ---- relational ops (Spark surface; numpy-vectorized host ops — the
+    # data plane's job is shaping tables, device kernels do the heavy math) --
+    def _key_ids(self, names: Sequence[str]):
+        """Factorize composite keys -> (int group id per row,
+        first-occurrence row per group id)."""
+        cols = [self.col(n) for n in names]
+        seen: dict[tuple, int] = {}
+        ids = np.empty(self._n, dtype=np.int64)
+        firsts: list[int] = []
+        rows = zip(*[[_hashable(v) for v in c.tolist()] for c in cols])
+        for i, t in enumerate(rows):
+            g = seen.setdefault(t, len(seen))
+            if g == len(firsts):
+                firsts.append(i)
+            ids[i] = g
+        return ids, np.asarray(firsts, dtype=np.int64)
+
+    def groupBy(self, *names: str) -> "GroupedData":
+        return GroupedData(self, list(names))
+
+    def distinct(self) -> "DataFrame":
+        _, firsts = self._key_ids(self.columns)
+        return self._derive({k: v[firsts] for k, v in self._cols.items()},
+                            _copy_meta(self._meta))
+
+    def join(self, other: "DataFrame", on, how: str = "inner",
+             suffix: str = "_right") -> "DataFrame":
+        """Hash join on key column(s). ``how``: inner|left|right|outer.
+        Non-key right columns colliding with left names get ``suffix``;
+        unmatched rows null-fill (ints widen to float64 + NaN, Spark's
+        nullable semantics)."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"how must be inner|left|right|outer, got {how!r}")
+        on = [on] if isinstance(on, str) else list(on)
+        for k in on:
+            self.col(k), other.col(k)
+        rmap: dict[tuple, list[int]] = {}
+        for j, t in enumerate(zip(*[other.col(k).tolist() for k in on])):
+            rmap.setdefault(t, []).append(j)
+        li: list[int] = []
+        ri: list[int] = []
+        matched: set[int] = set()
+        for i, t in enumerate(zip(*[self.col(k).tolist() for k in on])):
+            js = rmap.get(t)
+            if js:
+                for j in js:
+                    li.append(i)
+                    ri.append(j)
+                if how in ("right", "outer"):
+                    matched.update(js)
+            elif how in ("left", "outer"):
+                li.append(i)
+                ri.append(-1)
+        if how in ("right", "outer"):
+            for j in range(other.count()):
+                if j not in matched:
+                    li.append(-1)
+                    ri.append(j)
+        lidx = np.asarray(li, dtype=np.int64)
+        ridx = np.asarray(ri, dtype=np.int64)
+        cols: dict[str, np.ndarray] = {}
+        meta: dict[str, dict] = {}
+        for k, v in self._cols.items():
+            if k in on:
+                # key columns never null (a key exists on >=1 side), so take
+                # raw values from whichever side matched — no NaN widening
+                rv = other.col(k)
+                lg, rg = v[np.clip(lidx, 0, None)], rv[np.clip(ridx, 0, None)]
+                if v.dtype == rv.dtype and v.dtype.kind != "O":
+                    src = np.where(lidx >= 0, lg, rg)
+                else:
+                    src = np.array([a if i >= 0 else b for i, a, b
+                                    in zip(lidx, lg, rg)], dtype=object)
+            else:
+                src = _gather_with_nulls(v, lidx)
+            cols[k] = src
+            if k in self._meta:
+                meta[k] = self._meta[k]
+        for k, v in other._cols.items():
+            if k in on:
+                continue
+            name = k + suffix if k in cols else k
+            cols[name] = _gather_with_nulls(v, ridx)
+            if k in other._meta:
+                meta[name] = other._meta[k]
+        return DataFrame(cols, metadata=meta, npartitions=self.npartitions)
+
     # ---- partition semantics ----
     def repartition(self, n: int) -> "DataFrame":
         df = self._derive(dict(self._cols), _copy_meta(self._meta))
@@ -306,3 +393,140 @@ class DataFrame:
     def __repr__(self):
         spec = ", ".join(f"{k}:{v.dtype}" for k, v in self._cols.items())
         return f"DataFrame[{self._n} rows, {self.npartitions} parts]({spec})"
+
+
+def _hashable(v):
+    """Dict-key form of a cell value (vector cells -> bytes/tuples)."""
+    if isinstance(v, np.ndarray):
+        return (v.shape, v.tobytes())
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    return v
+
+
+def _gather_with_nulls(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """col[idx] where idx==-1 yields null: NaN for floats (ints widen to
+    float64, Spark's nullable-column semantics), None for object columns."""
+    missing = idx < 0
+    safe = np.clip(idx, 0, None)
+    if not missing.any():
+        return col[safe]
+    if col.dtype.kind == "f":
+        out = col[safe].copy()
+        out[missing] = np.nan
+        return out
+    if col.dtype.kind in "iub":
+        out = col[safe].astype(np.float64)
+        out[missing] = np.nan
+        return out
+    out = col[safe].astype(object)
+    out[missing] = None
+    return out
+
+
+_AGG_REDUCERS = {"sum": np.add, "min": np.minimum, "max": np.maximum}
+
+
+class GroupedData:
+    """Result of ``DataFrame.groupBy`` — Spark-style aggregation surface.
+
+    Aggregations run sorted-by-group with ``ufunc.reduceat`` (one vectorized
+    pass per (column, fn), no per-group Python loop). Functions: count, sum,
+    mean, min, max, first, collect_list (object columns support the last
+    three plus count).
+    """
+
+    def __init__(self, df: DataFrame, keys: list[str]):
+        if not keys:
+            raise ValueError("groupBy needs at least one key column")
+        self._df = df
+        self._keys = keys
+        self._ids, self._firsts = df._key_ids(keys)
+        # one sort shared by every aggregation in this groupBy
+        self._order = np.argsort(self._ids, kind="stable")
+        sorted_ids = self._ids[self._order]
+        self._starts = (np.flatnonzero(
+            np.r_[True, sorted_ids[1:] != sorted_ids[:-1]])
+            if len(sorted_ids) else np.empty(0, dtype=np.int64))
+
+    def _key_frame(self) -> dict[str, np.ndarray]:
+        out = {}
+        for k in self._keys:
+            out[k] = self._df.col(k)[self._firsts]
+        return out
+
+    def _key_meta(self) -> dict[str, dict]:
+        return {k: self._df._meta[k] for k in self._keys
+                if k in self._df._meta}
+
+    def _grouped(self, name: str):
+        """(values sorted by group id, segment starts) for reduceat."""
+        return self._df.col(name)[self._order], self._starts
+
+    def agg(self, spec: Optional[dict] = None, **named) -> DataFrame:
+        """``agg({"col": "mean"})`` -> column ``mean(col)`` (Spark naming), or
+        ``agg(out=("col", "mean"))`` for explicit output names."""
+        items: list[tuple[str, str, str]] = []  # (out_name, col, fn)
+        for col, fn in (spec or {}).items():
+            items.append((f"{fn}({col})", col, fn))
+        for out, (col, fn) in named.items():
+            items.append((out, col, fn))
+        if not items:
+            raise ValueError("agg needs at least one aggregation")
+        cols = self._key_frame()
+        n_groups = len(self._firsts)
+        counts = np.bincount(self._ids, minlength=n_groups)
+        for out, col, fn in items:
+            if fn == "count":
+                cols[out] = counts.astype(np.int64)
+                continue
+            vals, starts = self._grouped(col)
+            if fn == "first":
+                cols[out] = self._df.col(col)[self._firsts]
+            elif fn == "collect_list":
+                from .utils import object_column
+                cols[out] = object_column(
+                    [list(vals[s:e]) for s, e in
+                     zip(starts, np.r_[starts[1:], len(vals)])])
+            elif fn in ("sum", "min", "max"):
+                if vals.dtype.kind == "O":
+                    raise TypeError(f"{fn} needs a numeric column, "
+                                    f"{col!r} is object-typed")
+                cols[out] = _AGG_REDUCERS[fn].reduceat(vals, starts)
+            elif fn == "mean":
+                if vals.dtype.kind == "O":
+                    raise TypeError(f"mean needs a numeric column, "
+                                    f"{col!r} is object-typed")
+                cols[out] = (np.add.reduceat(vals.astype(np.float64), starts)
+                             / counts)
+            else:
+                raise ValueError(f"unknown aggregation {fn!r}")
+        return DataFrame(cols, metadata=self._key_meta(),
+                         npartitions=self._df.npartitions)
+
+    def count(self) -> DataFrame:
+        cols = self._key_frame()
+        cols["count"] = np.bincount(
+            self._ids, minlength=len(self._firsts)).astype(np.int64)
+        return DataFrame(cols, metadata=self._key_meta(),
+                         npartitions=self._df.npartitions)
+
+    def _all_numeric(self, fn: str, names) -> DataFrame:
+        names = list(names) or [c for c in self._df.columns
+                                if c not in self._keys
+                                and self._df.col(c).dtype.kind in "biuf"]
+        return self.agg({c: fn for c in names})
+
+    def sum(self, *names: str) -> DataFrame:
+        return self._all_numeric("sum", names)
+
+    def mean(self, *names: str) -> DataFrame:
+        return self._all_numeric("mean", names)
+
+    avg = mean
+
+    def min(self, *names: str) -> DataFrame:
+        return self._all_numeric("min", names)
+
+    def max(self, *names: str) -> DataFrame:
+        return self._all_numeric("max", names)

@@ -210,3 +210,129 @@ class TestSerialization:
         pipe.save(p)
         pipe2 = load_stage(p)
         assert len(pipe2.getStages()) == 2
+
+
+class TestRelationalOps:
+    """groupBy/agg, join, distinct — the Spark data-plane surface notebooks
+    lean on around the ML stages (reference data plane is Spark SQL)."""
+
+    def _df(self):
+        return DataFrame({
+            "k": np.array(["a", "b", "a", "c", "b"], dtype=object),
+            "k2": np.array([1, 1, 2, 1, 1]),
+            "x": np.array([1., 2., 3., 4., 5.]),
+            "y": np.array([10, 20, 30, 40, 50]),
+        })
+
+    def test_group_agg_spark_naming(self):
+        out = self._df().groupBy("k").agg({"x": "mean", "y": "sum"}).sort("k")
+        assert out.columns == ["k", "mean(x)", "sum(y)"]
+        assert list(out.col("mean(x)")) == [2.0, 3.5, 4.0]
+        assert list(out.col("sum(y)")) == [40, 70, 40]
+
+    def test_group_agg_named_and_fns(self):
+        df = self._df()
+        out = df.groupBy("k").agg(lo=("x", "min"), hi=("x", "max"),
+                                  n=("x", "count"), f=("k2", "first"),
+                                  xs=("x", "collect_list")).sort("k")
+        assert list(out.col("lo")) == [1.0, 2.0, 4.0]
+        assert list(out.col("hi")) == [3.0, 5.0, 4.0]
+        assert list(out.col("n")) == [2, 2, 1]
+        assert list(out.col("f")) == [1, 1, 1]
+        assert list(out.col("xs")[0]) == [1.0, 3.0]
+
+    def test_group_multi_key_and_count(self):
+        out = self._df().groupBy("k", "k2").count()
+        assert out.count() == 4  # (a,1),(b,1),(a,2),(c,1)
+        assert int(out.col("count").sum()) == 5
+
+    def test_group_convenience_all_numeric(self):
+        out = self._df().groupBy("k").mean().sort("k")
+        assert set(out.columns) == {"k", "mean(k2)", "mean(x)", "mean(y)"}
+
+    def test_group_errors(self):
+        df = self._df()
+        with pytest.raises(ValueError):
+            df.groupBy()
+        with pytest.raises(ValueError):
+            df.groupBy("k").agg({"x": "median"})
+        with pytest.raises(TypeError):
+            df.groupBy("k").agg({"k": "mean"})
+
+    def test_join_inner_and_suffix(self):
+        left = self._df()
+        right = DataFrame({"k": np.array(["a", "b", "d"], dtype=object),
+                           "x": np.array([7., 8., 9.]),
+                           "z": np.array([70., 80., 90.])})
+        out = left.join(right, "k")
+        assert out.count() == 4  # a,a,b,b
+        assert "x_right" in out.columns and "z" in out.columns
+        row = [r for r in out.collect() if r["k"] == "b"][0]
+        assert row["x"] == 2.0 and row["x_right"] == 8.0 and row["z"] == 80.0
+
+    def test_join_outer_null_semantics(self):
+        left = self._df().select("k", "x")
+        right = DataFrame({"k": np.array(["a", "d"], dtype=object),
+                           "z": np.array([70, 90])})
+        out = left.join(right, "k", how="outer")
+        rows = {(r["k"], i): r for i, r in enumerate(out.collect())}
+        ks = [r["k"] for r in out.collect()]
+        assert "d" in ks and "c" in ks
+        d_row = [r for r in out.collect() if r["k"] == "d"][0]
+        assert np.isnan(d_row["x"])          # unmatched left side
+        c_row = [r for r in out.collect() if r["k"] == "c"][0]
+        assert np.isnan(c_row["z"])          # ints widened to nullable float
+        assert out.col("z").dtype.kind == "f"
+
+    def test_join_left_right_and_multikey(self):
+        left = self._df()
+        right = DataFrame({"k": np.array(["a", "a", "z"], dtype=object),
+                           "k2": np.array([1, 2, 9]),
+                           "w": np.array([100., 200., 300.])})
+        out = left.join(right, ["k", "k2"], how="left")
+        assert out.count() == 5
+        a1 = [r for r in out.collect() if r["k"] == "a" and r["k2"] == 1][0]
+        assert a1["w"] == 100.0
+        out_r = left.join(right, ["k", "k2"], how="right")
+        assert out_r.count() == 3
+        with pytest.raises(ValueError):
+            left.join(right, "k", how="cross")
+
+    def test_distinct(self):
+        df = DataFrame({"a": np.array([1, 1, 2]),
+                        "b": np.array(["x", "x", "y"], dtype=object)})
+        assert df.distinct().count() == 2
+        assert self._df().distinct().count() == 5
+
+    def test_metadata_survives_join_and_group_keys(self):
+        left = self._df().withMetadata("x", {"tag": "score"})
+        right = DataFrame({"k": np.array(["a"], dtype=object),
+                           "z": np.array([1.])})
+        out = left.join(right, "k", how="left")
+        assert out.metadata("x") == {"tag": "score"}
+
+    def test_empty_frame_group_and_agg(self):
+        df = self._df().filter(np.zeros(5, dtype=bool))
+        out = df.groupBy("k").agg({"x": "sum", "y": "collect_list",
+                                   "k2": "count"})
+        assert out.count() == 0
+        assert df.groupBy("k").count().count() == 0
+
+    def test_distinct_with_vector_column(self):
+        from mmlspark_tpu.core.utils import object_column
+        df = DataFrame({"k": np.array([1, 1, 2]),
+                        "v": object_column([np.ones(3), np.ones(3),
+                                            np.zeros(3)])})
+        assert df.distinct().count() == 2
+
+    def test_right_join_keeps_int_key_dtype(self):
+        left = DataFrame({"k": np.array([1, 2]), "x": np.array([1., 2.])})
+        right = DataFrame({"k": np.array([2, 3]), "z": np.array([20., 30.])})
+        out = left.join(right, "k", how="right")
+        assert out.col("k").dtype.kind == "i"
+        assert sorted(out.col("k")) == [2, 3]
+
+    def test_metadata_survives_groupby_keys(self):
+        df = self._df().withMetadata("k", {"cat": True})
+        assert df.groupBy("k").count().metadata("k") == {"cat": True}
+        assert df.groupBy("k").agg({"x": "mean"}).metadata("k") == {"cat": True}
