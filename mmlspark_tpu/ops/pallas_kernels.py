@@ -60,9 +60,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)               # (bq, D)
-        k = k_ref[0].astype(jnp.float32)               # (bk, D)
-        v = v_ref[0].astype(jnp.float32)
+        # matmul operands stay in the input dtype (bf16 on chip): the MXU
+        # runs bf16xbf16->f32 at full rate, while f32 inputs force slow
+        # multi-pass emulation; accumulation is f32 either way
+        q = q_ref[0]                                   # (bq, D)
+        k = k_ref[0]                                   # (bk, D)
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         kpos = k_start + jax.lax.broadcasted_iota(jnp.int32,
@@ -84,7 +87,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=1)
         m_ref[:, 0] = m_new
         acc_ref[:] = (acc_ref[:] * corr[:, None]
-                      + jnp.dot(p, v, preferred_element_type=jnp.float32))
+                      + jnp.dot(p.astype(v.dtype), v,
+                                preferred_element_type=jnp.float32))
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -114,10 +118,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                 # native dtype: full-rate MXU (see fwd)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]                           # (bq,)
         dvec = dvec_ref[0][:, 0]                         # (bq,)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -135,7 +139,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dvec[:, None])
-        acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        acc_ref[:] += jnp.dot(ds.astype(k.dtype), k,
+                              preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -163,10 +168,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                 # native dtype: full-rate MXU (see fwd)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, 0]
         dvec = dvec_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -182,13 +187,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(lse[:, None] <= NEG_INF / 2, 0.0, p)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - dvec[:, None])
         dk_acc[:] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bk, D)
 
     @pl.when(qi == nq - 1)

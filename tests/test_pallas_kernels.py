@@ -129,3 +129,35 @@ def test_flash_attention_gradients():
         for a, b in zip(gf, gb):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_bf16_forward_and_grad_parity(rng, causal):
+    """The on-chip dtype: bf16 operands into every MXU matmul, f32
+    accumulation. Covers the casts that are no-ops in the f32 tests."""
+    import jax
+    q, k, v = _qkv(rng)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    out = flash_attention(qb, kb, vb, causal=causal, block_q=8, block_k=8)
+    assert out.dtype == jnp.bfloat16
+    ref = plain_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), atol=3e-2, rtol=3e-2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, None, 8, 8)
+                       .astype(jnp.float32))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=causal)
+                       .astype(jnp.float32))
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(qb, kb, vb)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        assert gf.dtype == jnp.bfloat16
+        scale = max(1e-3, float(np.abs(np.asarray(gr)).max()))
+        np.testing.assert_allclose(
+            np.asarray(gf, dtype=np.float32) / scale,
+            np.asarray(gr) / scale, atol=5e-2)
