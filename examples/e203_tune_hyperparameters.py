@@ -1,0 +1,41 @@
+"""Example 203 — hyperparameter tuning (reference: notebooks/samples/
+"203 - Breast Cancer - Tune Hyperparameters": TuneHyperparameters runs a
+randomized k-fold search over several model families at once and returns
+the best fitted model).
+"""
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import (ComputeModelStatistics, TuneHyperparameters)
+from mmlspark_tpu.models import (LightGBMClassifier, LogisticRegression,
+                                 RandomForestClassifier)
+
+rng = np.random.default_rng(0)
+n = 300
+# breast-cancer-shaped synthetic data: 6 correlated diagnostics
+y = rng.integers(0, 2, n)
+base = rng.normal(size=(n, 6))
+x = base + y[:, None] * np.array([1.2, 0.8, 0.0, 0.5, 1.0, 0.2])
+feats = np.empty(n, dtype=object)
+for i in range(n):
+    feats[i] = x[i].astype(np.float32)
+df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)
+
+tuner = (TuneHyperparameters()
+         .setModels((LogisticRegression(),
+                     RandomForestClassifier(),
+                     LightGBMClassifier()))
+         .setEvaluationMetric("accuracy")
+         .setNumFolds(3).setNumRuns(6).setParallelism(2).setSeed(0))
+best = tuner.fit(train)
+print("best model:", type(best.getBestModel()).__name__,
+      "cv accuracy:", round(best.getBestMetric(), 3))
+
+scored = best.transform(test)
+metrics = ComputeModelStatistics().setLabelCol("label").transform(scored)
+acc = float(metrics.col("accuracy")[0])
+print("held-out accuracy:", round(acc, 3))
+assert acc > 0.8
+print("example 203 OK")

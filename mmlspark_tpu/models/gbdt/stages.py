@@ -80,10 +80,19 @@ class _BoosterParams:
                 "feature_parallel": "feature",
                 "serial": "serial"}[self.getOrDefault("parallelism")]
 
-    def _mesh(self):
-        if self._tree_learner() != "serial" and len(jax.devices()) > 1:
-            return meshlib.create_mesh()
-        return None
+    def _mesh(self, n_rows: int = None):
+        """Distributed tree learning pays mesh padding + per-iteration
+        collectives; below ~8k rows per fit the serial program is strictly
+        faster (LightGBM's own docs steer small data to serial too). When
+        the user left ``parallelism`` at its default, small fits fall back
+        to the single-device program (also keeps thread-pooled tuning over
+        small folds collective-free); an explicit setting is honored."""
+        if self._tree_learner() == "serial" or len(jax.devices()) < 2:
+            return None
+        explicit = self.isSet("parallelism")
+        if not explicit and n_rows is not None and n_rows < 8192:
+            return None
+        return meshlib.create_mesh()
 
 
 def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
@@ -95,7 +104,7 @@ def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
 
 def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
     p = params_holder._engine_params(objective, num_class, alpha)
-    mesh = params_holder._mesh()
+    mesh = params_holder._mesh(x.shape[0])
     if mesh is not None and p.tree_learner != "feature":
         # row-sharded modes need the batch padded to a device multiple;
         # feature-parallel keeps full rows on every device
@@ -105,7 +114,12 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
                             np.zeros(len(x) - n, np.float32)])
     else:
         w = None
-    return engine.fit_gbdt(x, y, p, mesh=mesh, sample_weight=w)
+    if mesh is None:
+        return engine.fit_gbdt(x, y, p, mesh=None, sample_weight=w)
+    # collective programs from concurrent threads (tuner pool) interleave
+    # across devices and deadlock — one distributed fit at a time
+    with meshlib.collective_fit_lock:
+        return engine.fit_gbdt(x, y, p, mesh=mesh, sample_weight=w)
 
 
 def _ensemble_to_state(ens: engine.TreeEnsemble) -> dict:

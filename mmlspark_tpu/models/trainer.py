@@ -291,9 +291,31 @@ class TpuLearner(Estimator):
             start_epoch = resume + 1
             log.info("resumed from checkpoint epoch %d", resume)
 
+        # concurrent fits from a thread pool (TuneHyperparameters) must not
+        # interleave collective programs across the same devices — same
+        # deadlock guard as the GBDT fit path (parallel/mesh.py)
+        import contextlib
+        guard = (meshlib.collective_fit_lock if mesh.size > 1
+                 else contextlib.nullcontext())
+        with guard:
+            params, opt_state, last_loss = self._run_epochs(
+                start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
+                nproc=nproc, train_step=train_step, params=params,
+                opt_state=opt_state)
+
+        model = (TpuModel()
+                 .setInputCol(self.getFeaturesCol())
+                 .setModelConfig(cfg)
+                 .setModelParams(jax.tree_util.tree_map(np.asarray, params))
+                 .setInputShape(tuple(self.getInputShape())))
+        model._final_loss = last_loss
+        return model
+
+    def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
+                    mesh, nproc, train_step, params, opt_state):
         last_loss = None
         for epoch in range(start_epoch, self.getEpochs()):
-            order = (rng_np.permutation(n) if self.getShuffle()
+            order = (order_rng.permutation(n) if self.getShuffle()
                      else np.arange(n))
             for s in range(steps):
                 # cyclic slice: a process whose shard is shorter than its
@@ -325,11 +347,4 @@ class TpuLearner(Estimator):
                        else "Set checkpointDir to make divergence resumable."))
             if self.getCheckpointDir() and jax.process_index() == 0:
                 self._save_checkpoint(epoch, params, opt_state)
-
-        model = (TpuModel()
-                 .setInputCol(self.getFeaturesCol())
-                 .setModelConfig(cfg)
-                 .setModelParams(jax.tree_util.tree_map(np.asarray, params))
-                 .setInputShape(tuple(self.getInputShape())))
-        model._final_loss = last_loss
-        return model
+        return params, opt_state, last_loss

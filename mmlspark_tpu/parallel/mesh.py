@@ -18,11 +18,19 @@ additional axes (pipeline/sequence/expert) compose the same way.
 
 from __future__ import annotations
 
+import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Collectives issued concurrently from multiple host threads can interleave
+# across the same devices and deadlock (each device waits on a different
+# collective). Any fit that runs a multi-device collective program while
+# other fits may run on other threads (e.g. TuneHyperparameters' pool)
+# must hold this lock; single-device fits need not.
+collective_fit_lock = threading.Lock()
 
 
 def create_mesh(data: Optional[int] = None, model: int = 1,

@@ -222,3 +222,23 @@ def test_max_bin_uint8_ceiling():
     with pytest.raises(ValueError, match="max_bin"):
         fit_gbdt(x, y, GBDTParams(num_iterations=2, max_bin=300))
     fit_gbdt(x, y, GBDTParams(num_iterations=2, max_bin=256))  # ceiling OK
+
+
+class TestMeshSelection:
+    """The implicit small-data serial fallback vs explicit parallelism
+    (collective programs from a tuner thread pool must not appear for
+    toy fits; an explicit user setting is always honored)."""
+
+    def test_default_small_fit_is_serial(self):
+        assert LightGBMClassifier()._mesh(300) is None
+
+    def test_default_large_fit_is_distributed(self):
+        assert LightGBMClassifier()._mesh(100_000) is not None
+
+    def test_explicit_parallelism_honored_on_small_data(self):
+        clf = LightGBMClassifier().setParallelism("feature_parallel")
+        assert clf._mesh(300) is not None
+
+    def test_explicit_serial_honored_on_large_data(self):
+        clf = LightGBMClassifier().setParallelism("serial")
+        assert clf._mesh(100_000) is None
