@@ -160,6 +160,10 @@ class _W2VParams:
 
 
 class Word2VecModel(Model, _W2VParams):
+    """Fitted word embeddings: transform averages a document's in-vocab
+    word vectors (Spark Word2VecModel semantics); findSynonyms/getVectors
+    expose the vocabulary geometry."""
+
     vocabulary = ComplexParam("vocab words, id order", default=None)
     wordVectors = ComplexParam("(V, D) float32 embeddings", default=None)
 
@@ -201,6 +205,9 @@ class Word2VecModel(Model, _W2VParams):
 
 
 class Word2Vec(Estimator, _W2VParams):
+    """Learn word embeddings by skip-gram negative sampling, batched into
+    jitted MXU steps (Spark ML Word2Vec surface; notebook-202 workflow)."""
+
     def _make_model(self, vocab, vectors) -> Word2VecModel:
         model = Word2VecModel()
         model.set(**{k: self.getOrDefault(k) for k in self._params
