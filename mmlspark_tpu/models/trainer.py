@@ -128,8 +128,13 @@ class TpuLearner(Estimator):
         state = {"params": jax.tree_util.tree_map(np.asarray, params),
                  "opt": serialization.to_state_dict(
                      jax.tree_util.tree_map(np.asarray, opt_state))}
-        with open(self._ckpt_path(epoch), "wb") as f:
+        # write-then-rename: a crash mid-write must never leave a truncated
+        # file that _latest_checkpoint would pick and brick the resume
+        path = self._ckpt_path(epoch)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(serialization.msgpack_serialize(state))
+        os.replace(tmp, path)
 
     def _restore_checkpoint(self, epoch: int, params_tmpl, opt_tmpl):
         with open(self._ckpt_path(epoch), "rb") as f:

@@ -33,6 +33,13 @@ log = get_logger("distributed")
 ENV_COORDINATOR = "MMLTPU_COORDINATOR"       # "host:port" of process 0
 ENV_NUM_PROCESSES = "MMLTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "MMLTPU_PROCESS_ID"
+ENV_INIT_TIMEOUT = "MMLTPU_INIT_TIMEOUT"     # seconds to wait at rendezvous
+ENV_HEARTBEAT_TIMEOUT = "MMLTPU_HEARTBEAT_TIMEOUT"  # dead-worker detection
+
+# the reference's LightGBM rendezvous blocks at most 120 s
+# (LightGBMConstants.scala:9-12 defaultListenTimeout); same bound here so a
+# missing worker fails the job instead of hanging the fleet
+DEFAULT_INIT_TIMEOUT = 120
 
 _initialized = False
 
@@ -44,19 +51,34 @@ def is_initialized() -> bool:
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None,
-               local_device_ids: Optional[Sequence[int]] = None) -> None:
+               local_device_ids: Optional[Sequence[int]] = None,
+               init_timeout: Optional[int] = None,
+               heartbeat_timeout: Optional[int] = None) -> None:
     """Join the global JAX runtime. Process 0's address is the rendezvous
     point (the machine-list/hostfile role); blocks until all processes check
     in, like LGBM_NetworkInit's 120s barrier — but heartbeated and reusable
-    across every collective rather than per-training-job."""
+    across every collective rather than per-training-job. A worker that
+    never shows up fails the rendezvous after ``init_timeout`` (default
+    120 s, the reference's bound); a worker that dies later is detected by
+    missed heartbeats and takes the job down rather than hanging it."""
     global _initialized
     if _initialized:
         log.info("distributed runtime already initialized; skipping")
         return
+    if init_timeout is None:
+        init_timeout = int(os.environ.get(ENV_INIT_TIMEOUT,
+                                          DEFAULT_INIT_TIMEOUT))
+    kwargs = {}
+    if heartbeat_timeout is None and ENV_HEARTBEAT_TIMEOUT in os.environ:
+        heartbeat_timeout = int(os.environ[ENV_HEARTBEAT_TIMEOUT])
+    if heartbeat_timeout is not None:
+        kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id,
-                               local_device_ids=local_device_ids)
+                               local_device_ids=local_device_ids,
+                               initialization_timeout=init_timeout,
+                               **kwargs)
     _initialized = True
     log.info("distributed init: process %d/%d, %d local / %d global devices",
              jax.process_index(), jax.process_count(),
