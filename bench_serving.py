@@ -1,0 +1,104 @@
+"""Serving benchmark: HTTP -> continuous batching -> pjit inference on the
+real chip (the reference's serving story is DistributedHTTPSource feeding
+CNTKModel, SURVEY.md §2.4/§3.5 — no published latency/throughput numbers).
+
+Measures end-to-end client-observed latency (p50/p99) and sustained
+throughput for a ResNet-20 scorer behind `serve_pipeline`, with uint8 image
+payloads (the wire format TpuModel.transferDtype optimizes). Prints one
+JSON line per load level; the last line is the headline.
+"""
+
+import base64
+import json
+import threading
+import time
+
+import numpy as np
+
+
+class _ImageScorer:
+    """(id, value) -> reply: decode base64 uint8 image batch, score."""
+
+    def __init__(self):
+        import jax
+        from mmlspark_tpu.models import TpuModel, build_model
+        cfg = {"type": "resnet", "num_classes": 10}
+        module = build_model(cfg)
+        params = module.init(jax.random.PRNGKey(0),
+                             np.zeros((1, 32, 32, 3), np.float32))
+        self.model = (TpuModel().setModelConfig(cfg).setModelParams(params)
+                      .setInputCol("features").setTransferDtype("bfloat16")
+                      .setInputShape((3, 32, 32)))
+        from mmlspark_tpu import DataFrame
+        from mmlspark_tpu.core.utils import object_column
+        ex = DataFrame({"features": object_column(
+            [np.zeros(32 * 32 * 3, np.float32)])})
+        self.model.warmup(ex, max_rows=256)  # no request pays a compile
+
+    def transform(self, df):
+        from mmlspark_tpu.core.utils import object_column
+        imgs = [np.frombuffer(base64.b64decode(v), dtype=np.uint8)
+                .reshape(32, 32, 3).astype(np.float32).ravel()
+                for v in df.col("value")]
+        scored = self.model.transform(
+            df.withColumn("features", object_column(imgs)))
+        replies = [json.dumps({"label": int(np.argmax(s))})
+                   for s in scored.col("scores")]
+        return scored.withColumn("reply", object_column(replies))
+
+
+def main():
+    import requests
+    from mmlspark_tpu.io.http import serve_pipeline
+
+    rng = np.random.default_rng(0)
+    payload = base64.b64encode(
+        rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
+
+    source, loop = serve_pipeline(_ImageScorer(), max_batch=256)
+    try:
+        # warmup (compile)
+        r = requests.post(source.url, data=payload, timeout=120)
+        assert r.status_code == 200, r.text
+
+        headline = None
+        for clients, per_client in ((4, 50), (16, 50), (64, 25)):
+            lat: list[float] = []
+            lock = threading.Lock()
+
+            def worker():
+                mine = []
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    r = requests.post(source.url, data=payload, timeout=60)
+                    mine.append(time.perf_counter() - t0)
+                    assert r.status_code == 200
+                with lock:
+                    lat.extend(mine)
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            lat_ms = np.sort(np.array(lat)) * 1e3
+            result = {
+                "metric": "serving_resnet20_http",
+                "clients": clients,
+                "throughput_rps": round(len(lat) / wall, 1),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
+            }
+            print(json.dumps(result))
+            headline = result
+        return headline
+    finally:
+        loop.stop()
+        source.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,15 @@ from ..core.utils import to_float32_matrix
 from ..parallel import mesh as meshlib
 
 
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>= 8 so tiny serving batches share one
+    compiled shape)."""
+    t = 8
+    while t < n:
+        t <<= 1
+    return t
+
+
 def _prep_input(df: DataFrame, col_name: str, input_shape) -> np.ndarray:
     """Column -> device-ready batch. Images become NHWC and STAY uint8 —
     the device cast is free and shipping bytes moves 4x less host->HBM
@@ -98,6 +107,27 @@ class TpuModel(Transformer):
         return (cfg.get("type") == "transformer"
                 and cfg.get("num_experts", 0) > 0)
 
+    def _cached_mesh(self):
+        """One mesh per device topology (a new Mesh object per call would
+        also defeat the device-params cache below)."""
+        devs = tuple(id(d) for d in jax.devices())
+        if getattr(self, "_mesh_key", None) != devs:
+            self._mesh_cache = meshlib.create_mesh()
+            self._mesh_key = devs
+        return self._mesh_cache
+
+    def _device_params(self, mesh):
+        """Device-resident replicated params, uploaded ONCE per (params,
+        mesh) — the serving loop calls transform per request batch, and
+        re-shipping the whole tree host->HBM each time (~100 MB for a
+        ResNet-50) would dominate request latency."""
+        key = (id(self.getModelParams()), id(mesh))
+        if getattr(self, "_dev_params_key", None) != key:
+            self._dev_params = meshlib.put_replicated(
+                self.getModelParams(), mesh)
+            self._dev_params_key = key
+        return self._dev_params
+
     # one jitted program per (config, output_layer); reused across transforms
     def _apply_fn(self):
         key = getattr(self, "_apply_cache_key", None)
@@ -119,6 +149,26 @@ class TpuModel(Transformer):
             self._apply_cache_key = cur
         return self._apply_jit
 
+    def warmup(self, example_df: DataFrame, max_rows: Optional[int] = None
+               ) -> "TpuModel":
+        """Pre-compile every bucketed batch shape up to ``max_rows``
+        (default miniBatchSize) by scoring tiled copies of ``example_df``'s
+        first row. Serving loops call this once at startup so no client
+        request ever pays an XLA compile (seconds) in its latency."""
+        row = {k: example_df.col(k)[:1] for k in example_df.columns}
+        cap = min(self.getMiniBatchSize(),
+                  _next_pow2(max_rows or self.getMiniBatchSize()))
+        t = 8
+        while True:
+            n = min(t, cap)
+            tiled = DataFrame({k: np.concatenate([v] * n)
+                               for k, v in row.items()})
+            self.transform(tiled)
+            if t >= cap:
+                break
+            t <<= 1
+        return self
+
     def transform(self, df: DataFrame) -> DataFrame:
         if self.getModelParams() is None:
             raise ValueError("TpuModel has no params; set modelParams or "
@@ -130,10 +180,10 @@ class TpuModel(Transformer):
         elif x.dtype == np.float32 and self.getTransferDtype() == "bfloat16":
             import ml_dtypes
             x = x.astype(ml_dtypes.bfloat16)
-        mesh = meshlib.create_mesh()
+        mesh = self._cached_mesh()
         apply_fn = self._apply_fn()
         nproc = jax.process_count()
-        params = meshlib.put_replicated(self.getModelParams(), mesh)
+        params = self._device_params(mesh)
         if nproc > 1:
             # multi-host: this df is the process-local shard; SPMD demands
             # identical shapes/call counts everywhere, so the whole shard
@@ -156,7 +206,18 @@ class TpuModel(Transformer):
         # residency stays ~window*miniBatchSize instead of the whole dataset
         for lo in range(0, len(x), bs):
             chunk = x[lo:lo + bs]
+            n_real = len(chunk)
+            # bucket partial chunks to the next power of two: serving feeds
+            # ragged request batches, and every distinct shape is a fresh
+            # XLA compile (seconds) — bucketing bounds the shape set to
+            # log2(miniBatchSize) and the padding rows are sliced off below
+            target = min(_next_pow2(n_real), bs)
+            if n_real < target:
+                filler = np.zeros((target - n_real,) + chunk.shape[1:],
+                                  chunk.dtype)
+                chunk = np.concatenate([chunk, filler])
             padded, n = meshlib.pad_batch_to_devices(chunk, mesh)
+            n = n_real
             xb = meshlib.shard_batch(padded, mesh)
             if self._is_moe():
                 wb = np.zeros(len(padded), dtype=np.float32)

@@ -423,3 +423,32 @@ def test_transformer_remat_parity():
     bad = build_model({**cfg, "remat": True, "num_experts": 2})
     with pytest.raises(ValueError, match="remat with MoE"):
         bad.init(jax.random.PRNGKey(0), tok)
+
+
+def test_tpu_model_bucketed_shapes_and_warmup():
+    """Serving feeds ragged batch sizes; transform buckets them to powers of
+    two so the compiled-shape set is bounded, and warmup() pre-compiles all
+    buckets so no later call compiles anything."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuModel, build_model
+
+    cfg = {"type": "mlp", "hidden": [4], "num_classes": 2}
+    m = build_model(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    model = (TpuModel().setModelConfig(cfg).setModelParams(p)
+             .setInputCol("features").setMiniBatchSize(64))
+
+    def df_of(n):
+        return DataFrame({"features": object_column(
+            [np.zeros(4, np.float32)] * n)})
+
+    model.warmup(df_of(1), max_rows=64)
+    compiled = model._apply_jit._cache_size()
+    assert compiled == 4  # buckets 8, 16, 32, 64
+    for n in (1, 3, 8, 9, 17, 40, 64):
+        out = model.transform(df_of(n))
+        assert len(out.col("scores")) == n
+    assert model._apply_jit._cache_size() == compiled, \
+        "ragged batches must reuse warmed bucket shapes"
