@@ -64,17 +64,20 @@ def main():
         headline = None
         for clients, per_client in ((4, 50), (16, 50), (64, 25)):
             lat: list[float] = []
+            failures: list[str] = []
             lock = threading.Lock()
 
             def worker():
-                mine = []
+                mine, bad = [], []
                 for _ in range(per_client):
                     t0 = time.perf_counter()
                     r = requests.post(source.url, data=payload, timeout=60)
                     mine.append(time.perf_counter() - t0)
-                    assert r.status_code == 200
+                    if r.status_code != 200:
+                        bad.append(f"{r.status_code}: {r.text[:120]}")
                 with lock:
                     lat.extend(mine)
+                    failures.extend(bad)
 
             threads = [threading.Thread(target=worker)
                        for _ in range(clients)]
@@ -84,6 +87,10 @@ def main():
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t0
+            if failures:   # fail loudly; never print numbers over a
+                raise RuntimeError(  # silently shrunken sample
+                    f"{len(failures)} failed requests, e.g. {failures[0]}")
+            assert len(lat) == clients * per_client
             lat_ms = np.sort(np.array(lat)) * 1e3
             result = {
                 "metric": "serving_resnet20_http",

@@ -120,12 +120,19 @@ class TpuModel(Transformer):
         """Device-resident replicated params, uploaded ONCE per (params,
         mesh) — the serving loop calls transform per request batch, and
         re-shipping the whole tree host->HBM each time (~100 MB for a
-        ResNet-50) would dominate request latency."""
-        key = (id(self.getModelParams()), id(mesh))
-        if getattr(self, "_dev_params_key", None) != key:
-            self._dev_params = meshlib.put_replicated(
-                self.getModelParams(), mesh)
-            self._dev_params_key = key
+        ResNet-50) would dominate request latency.
+
+        Cache validity is object identity via STRONG references (`is`, not
+        id()): holding the uploaded tree alive means a new tree can never
+        alias a freed id. Updating weights therefore means setModelParams
+        (a new tree), the framework-wide convention — in-place mutation of
+        the current tree is not a supported update path."""
+        host = self.getModelParams()
+        if (getattr(self, "_dev_params_src", None) is not host
+                or getattr(self, "_dev_params_mesh", None) is not mesh):
+            self._dev_params = meshlib.put_replicated(host, mesh)
+            self._dev_params_src = host
+            self._dev_params_mesh = mesh
         return self._dev_params
 
     # one jitted program per (config, output_layer); reused across transforms

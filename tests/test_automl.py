@@ -92,20 +92,40 @@ ALGOS = {
 }
 
 
+def _golden_datasets():
+    """dataset -> (X, y, accuracy floor). The reference commits a 33-row
+    dataset x algorithm accuracy grid (train-classifier benchmarkMetrics
+    .csv); zero-egress here, so the grid runs on the bundled sklearn
+    datasets — binary, 3-class, and 13-feature multiclass shapes."""
+    from sklearn.datasets import load_iris, load_wine
+    x, y = load_breast_cancer(return_X_y=True)
+    out = {"breast_cancer": (x[:, :10], y, 0.85)}
+    x, y = load_iris(return_X_y=True)
+    out["iris"] = (x, y, 0.85)
+    x, y = load_wine(return_X_y=True)
+    out["wine"] = (x, y, 0.80)
+    return out
+
+
 class TestTrainClassifier:
     @pytest.mark.parametrize("algo", list(ALGOS))
-    def test_breast_cancer_golden_grid(self, algo):
+    @pytest.mark.parametrize("dataset", ["breast_cancer", "iris", "wine"])
+    def test_golden_grid(self, dataset, algo):
         # the reference's benchmarkMetrics.csv grid: dataset x algorithm
-        x, y = load_breast_cancer(return_X_y=True)
-        feats = {f"f{i}": x[:, i].astype(np.float32) for i in range(10)}
+        x, y, floor = _golden_datasets()[dataset]
+        feats = {f"f{i}": x[:, i].astype(np.float32)
+                 for i in range(x.shape[1])}
         df = DataFrame({**feats, "Label": y.astype(np.int64)})
         model = (TrainClassifier().setLabelCol("Label")
                  .setModel(ALGOS[algo]()).fit(df))
         out = model.transform(df)
         acc = float((out.col("scored_labels").astype(np.float64) == y).mean())
-        assert_golden(GOLDENS, "breast_cancer", algo, "accuracy", acc,
+        assert_golden(GOLDENS, dataset, algo, "accuracy", acc,
                       tolerance=0.03)
-        assert acc > 0.85, f"{algo}: {acc}"
+        if algo == "MLP" and dataset == "wine":
+            floor = 0.6  # 15-iter MLP underfits unscaled 13-feature wine;
+            # the golden line (not the floor) is the regression gate
+        assert acc > floor, f"{dataset}/{algo}: {acc}"
 
     def test_object_labels_decoded(self, mixed_df):
         model = (TrainClassifier().setLabelCol("income")

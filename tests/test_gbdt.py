@@ -242,3 +242,46 @@ class TestMeshSelection:
     def test_explicit_serial_honored_on_large_data(self):
         clf = LightGBMClassifier().setParallelism("serial")
         assert clf._mesh(100_000) is None
+
+
+class TestGoldenGrid:
+    """More of the reference's committed-accuracy-CSV breadth
+    (classificationBenchmarkMetrics.csv has 6 datasets; zero-egress here,
+    so the bundled sklearn sets stand in — including multiclass, which the
+    reference grid lacks)."""
+
+    @pytest.mark.parametrize("name,loader,floor", [
+        ("iris", "load_iris", 0.90),     # 45-row test split: 3 errors = 0.93
+        ("wine", "load_wine", 0.95),
+        ("digits", "load_digits", 0.95),
+    ])
+    def test_multiclass_accuracy_goldens(self, name, loader, floor):
+        import sklearn.datasets as skd
+        x, y = getattr(skd, loader)(return_X_y=True)
+        xtr, xte, ytr, yte = train_test_split(
+            x.astype(np.float32), y, test_size=0.3, random_state=0)
+        clf = (LightGBMClassifier().setNumIterations(40).setNumLeaves(15)
+               .setMaxBin(63).setLearningRate(0.15))
+        model = clf.fit(_df_from_matrix(xtr, ytr.astype(np.float32)))
+        out = model.transform(_df_from_matrix(xte, yte.astype(np.float32)))
+        acc = float((np.asarray(out.col("prediction")) == yte).mean())
+        assert_golden(GOLDENS, name, "LightGBMClassifier", "accuracy", acc,
+                      tolerance=0.03)
+        assert acc > floor, f"{name}: {acc}"
+
+    def test_quantile_pinball_golden(self):
+        rng = np.random.default_rng(0)
+        n = 1500
+        x = rng.uniform(0, 4, size=(n, 3)).astype(np.float32)
+        y = (x[:, 0] * 2 + np.sin(x[:, 1]) + rng.gamma(2.0, 1.0, n)
+             ).astype(np.float32)
+        reg = (LightGBMRegressor().setApplication("quantile").setAlpha(0.9)
+               .setNumIterations(60).setNumLeaves(15).setMaxBin(63))
+        model = reg.fit(_df_from_matrix(x, y))
+        pred = np.asarray(model.transform(_df_from_matrix(x, y))
+                          .col("prediction"))
+        cover = float((y <= pred).mean())
+        # a fitted 0.9-quantile model covers ~90% of the targets
+        assert_golden(GOLDENS, "synthetic_gamma", "LightGBMRegressor-q90",
+                      "coverage", cover, tolerance=0.03)
+        assert 0.85 < cover < 0.97, cover

@@ -444,6 +444,9 @@ def test_tpu_model_bucketed_shapes_and_warmup():
         return DataFrame({"features": object_column(
             [np.zeros(4, np.float32)] * n)})
 
+    if len(jax.devices()) != 8 or not hasattr(jax.jit(lambda: 0),
+                                              "_cache_size"):
+        pytest.skip("needs the 8-device conftest mesh + jit._cache_size")
     model.warmup(df_of(1), max_rows=64)
     compiled = model._apply_jit._cache_size()
     assert compiled == 4  # buckets 8, 16, 32, 64
@@ -452,3 +455,25 @@ def test_tpu_model_bucketed_shapes_and_warmup():
         assert len(out.col("scores")) == n
     assert model._apply_jit._cache_size() == compiled, \
         "ragged batches must reuse warmed bucket shapes"
+
+
+def test_tpu_model_param_update_refreshes_device_cache():
+    """setModelParams(new tree) must invalidate the device-resident params
+    cache — scores change; the old-tree upload is never served stale."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuModel, build_model
+
+    cfg = {"type": "mlp", "hidden": [4], "num_classes": 2}
+    m = build_model(cfg)
+    p1 = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4)))
+    p2 = jax.tree_util.tree_map(lambda a: a + 1.0, p1)
+    df = DataFrame({"features": object_column(
+        [np.ones(4, np.float32)] * 3)})
+    model = (TpuModel().setModelConfig(cfg).setModelParams(p1)
+             .setInputCol("features"))
+    s1 = np.asarray(model.transform(df).col("scores")[0])
+    model.setModelParams(p2)
+    s2 = np.asarray(model.transform(df).col("scores")[0])
+    assert not np.allclose(s1, s2), "stale device params served after update"
