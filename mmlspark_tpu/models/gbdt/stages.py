@@ -50,6 +50,10 @@ class _BoosterParams:
         choices=("data_parallel", "feature_parallel", "voting_parallel",
                  "serial"))
     seed = IntParam("random seed", default=0)
+    maxDenseFeatures = IntParam(
+        "sparse inputs wider than this train on the top-k document-"
+        "frequency columns (the dense bin matrix is the device format; "
+        "2^18-dim hashed text cannot densify whole)", default=4096, min=1)
 
     def _depth(self) -> int:
         d = self.getOrDefault("maxDepth")
@@ -95,11 +99,36 @@ class _BoosterParams:
         return meshlib.create_mesh()
 
 
-def _features_matrix(df: DataFrame, col: str) -> np.ndarray:
-    mat = rows_to_matrix(df.col(col))
+def _densify(mat, selection=None) -> np.ndarray:
+    if selection is not None:
+        mat = mat.tocsc()[:, selection] if hasattr(mat, "tocsc") \
+            else mat[:, selection]
     if hasattr(mat, "toarray"):
         mat = mat.toarray()
     return np.asarray(mat, dtype=np.float32)
+
+
+def _features_matrix(df: DataFrame, col: str, selection=None) -> np.ndarray:
+    return _densify(rows_to_matrix(df.col(col)), selection)
+
+
+def _select_features(mat, cap: int):
+    """Sparse high-dim inputs (hashed text, 2^18 dims) cannot densify into
+    the (n, d) bin matrix the histogram kernels take. Keep the `cap`
+    highest-document-frequency columns — the pragmatic cut of LightGBM's
+    sparse/EFB handling: hashed-text signal lives in frequent columns, and
+    an all-zero or near-empty column can't win a split anyway. Returns
+    sorted column indices, or None when d already fits."""
+    d = mat.shape[1]
+    if d <= cap or not hasattr(mat, "tocsc"):
+        return None  # already-dense inputs stay uncapped (no memory win)
+    doc_freq = np.diff(mat.tocsc().indptr)
+    sel = np.sort(np.argsort(-doc_freq, kind="stable")[:cap]).astype(np.int64)
+    from ...core.utils import get_logger
+    get_logger("gbdt").warning(
+        "sparse input has %d features; training on the %d most frequent "
+        "(raise maxDenseFeatures to keep more)", d, cap)
+    return sel
 
 
 def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
@@ -147,12 +176,15 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
     predictionCol = StringParam("predicted label column", default="prediction")
     objective = StringParam("binary|multiclass", default="binary")
     boosterState = ComplexParam("fitted tree arrays", default=None)
+    featureSelection = ComplexParam(
+        "column indices the fit kept (sparse wide inputs)", default=None)
 
     def _ensemble(self):
         return _state_to_ensemble(self.getBoosterState(), self.getObjective())
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = _features_matrix(df, self.getFeaturesCol())
+        x = _features_matrix(df, self.getFeaturesCol(),
+                             self.getFeatureSelection())
         ens = self._ensemble()
         raw = engine.predict_raw(ens, x)
         prob = engine.prob_from_raw(ens.objective, raw)
@@ -173,7 +205,11 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
     """Binary/multiclass boosted trees (reference: LightGBMClassifier.scala:32)."""
 
     def fit(self, df: DataFrame) -> LightGBMClassificationModel:
-        x = _features_matrix(df, self.getFeaturesCol())
+        mat = rows_to_matrix(df.col(self.getFeaturesCol()))
+        if hasattr(mat, "tocsc"):
+            mat = mat.tocsc()  # once; the helpers' tocsc() are then no-ops
+        sel = _select_features(mat, self.getMaxDenseFeatures())
+        x = _densify(mat, sel)
         y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
         classes = np.unique(y.astype(np.int64))
         if not np.array_equal(classes, np.arange(len(classes))) or \
@@ -188,6 +224,7 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
         return (LightGBMClassificationModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(objective)
+                .setFeatureSelection(sel)
                 .setBoosterState(_ensemble_to_state(ens)))
 
 
@@ -195,9 +232,12 @@ class LightGBMRegressionModel(Model, HasFeaturesCol):
     predictionCol = StringParam("prediction column", default="prediction")
     objective = StringParam("regression|quantile|mae", default="regression")
     boosterState = ComplexParam("fitted tree arrays", default=None)
+    featureSelection = ComplexParam(
+        "column indices the fit kept (sparse wide inputs)", default=None)
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = _features_matrix(df, self.getFeaturesCol())
+        x = _features_matrix(df, self.getFeaturesCol(),
+                             self.getFeatureSelection())
         ens = _state_to_ensemble(self.getBoosterState(), self.getObjective())
         pred = engine.predict(ens, x).astype(np.float64)
         out = df.withColumn(self.getPredictionCol(), pred)
@@ -215,11 +255,16 @@ class LightGBMRegressor(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams):
     alpha = FloatParam("quantile level", default=0.9, min=0.0, max=1.0)
 
     def fit(self, df: DataFrame) -> LightGBMRegressionModel:
-        x = _features_matrix(df, self.getFeaturesCol())
+        mat = rows_to_matrix(df.col(self.getFeaturesCol()))
+        if hasattr(mat, "tocsc"):
+            mat = mat.tocsc()  # once; the helpers' tocsc() are then no-ops
+        sel = _select_features(mat, self.getMaxDenseFeatures())
+        x = _densify(mat, sel)
         y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
         ens = _fit_ensemble(self, x, y, self.getApplication(),
                             alpha=self.getAlpha())
         return (LightGBMRegressionModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(self.getApplication())
+                .setFeatureSelection(sel)
                 .setBoosterState(_ensemble_to_state(ens)))

@@ -285,3 +285,66 @@ class TestGoldenGrid:
         assert_golden(GOLDENS, "synthetic_gamma", "LightGBMRegressor-q90",
                       "coverage", cover, tolerance=0.03)
         assert 0.85 < cover < 0.97, cover
+
+
+class TestSparseWideInput:
+    """TextFeaturizer-style hashed features (2^16 dims) into LightGBM: the
+    fit keeps the top document-frequency columns instead of densifying the
+    whole matrix, the selection rides the fitted model (incl. save/load),
+    and accuracy on a separable corpus survives the cut."""
+
+    def _text_df(self, n=300):
+        from mmlspark_tpu.ops import TextFeaturizer
+        rng = np.random.default_rng(0)
+        pos = ["great", "excellent", "wonderful"]
+        neg = ["awful", "boring", "terrible"]
+        filler = [f"w{i}" for i in range(50)]
+        texts, ys = [], []
+        for _ in range(n):
+            y = int(rng.random() < 0.5)
+            words = list(rng.choice(pos if y else neg, 3)) + \
+                list(rng.choice(filler, 5))
+            rng.shuffle(words)
+            texts.append(" ".join(words))
+            ys.append(y)
+        df = DataFrame({"text": np.array(texts, dtype=object),
+                        "label": np.array(ys, dtype=np.float32)})
+        m = (TextFeaturizer().setInputCol("text").setOutputCol("features")
+             .setNumFeatures(1 << 16).setUseIDF(False).fit(df))
+        return m.transform(df), np.array(ys)
+
+    def test_wide_sparse_fit_and_selection_persistence(self, tmp_path):
+        df, y = self._text_df()
+        clf = (LightGBMClassifier().setNumIterations(20).setMaxBin(15)
+               .setMaxDenseFeatures(256))
+        model = clf.fit(df)
+        sel = model.getFeatureSelection()
+        assert sel is not None and len(sel) == 256
+        assert np.all(np.diff(sel) > 0)  # sorted, unique
+        prob = np.stack(list(model.transform(df).col("probability")))[:, 1]
+        assert roc_auc_score(y, prob) > 0.95
+        from mmlspark_tpu.core import load_stage
+        model.save(str(tmp_path / "m"))
+        m2 = load_stage(str(tmp_path / "m"))
+        prob2 = np.stack(list(m2.transform(df).col("probability")))[:, 1]
+        np.testing.assert_allclose(prob, prob2)
+
+    def test_dense_input_stays_uncapped(self):
+        # the cap targets sparse inputs only; already-dense matrices gain
+        # no memory from the cut and must keep their full width
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(60, 64)).astype(np.float32)
+        y = (x[:, 50] > 0).astype(np.float32)   # signal above the cap
+        df = _df_from_matrix(x, y)
+        model = (LightGBMClassifier().setMaxDenseFeatures(8)
+                 .setNumIterations(10).setMaxBin(15).fit(df))
+        assert model.getFeatureSelection() is None
+        prob = np.stack(list(model.transform(df).col("probability")))[:, 1]
+        assert roc_auc_score(y, prob) > 0.95
+
+    def test_narrow_input_keeps_all_columns(self):
+        x, yv = make_classification(n_samples=100, n_features=6,
+                                    random_state=0)
+        df = _df_from_matrix(x.astype(np.float32), yv.astype(np.float32))
+        model = LightGBMClassifier().setNumIterations(3).setMaxBin(15).fit(df)
+        assert model.getFeatureSelection() is None
