@@ -1,0 +1,74 @@
+"""Data-ingest benchmark: disk -> C++ threaded decode -> staging buffer ->
+HBM (SURVEY.md §7 hard part (a): the reference's ingest is element-wise JNI
+copies at CNTKModel.scala:67-74 plus scp/getmerge data movement; here whole
+batches stream through `io/loader.py` + `native/csrc/loader.cc`).
+
+Writes a synthetic JPEG corpus once, then measures images/sec into device
+memory (decode + resize + transfer, pipelined). Prints one JSON line.
+"""
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+N_IMAGES = 1024
+SRC_HW = (256, 256)
+OUT_HW = (224, 224)
+BATCH = 128
+
+
+def _corpus(tmp: str) -> list[str]:
+    import cv2
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(N_IMAGES):
+        img = rng.integers(0, 256, (*SRC_HW, 3), dtype=np.uint8)
+        p = os.path.join(tmp, f"img_{i:05d}.jpg")
+        cv2.imwrite(p, img)
+        paths.append(p)
+    return paths
+
+
+def main():
+    import jax
+
+    from mmlspark_tpu.io.loader import device_image_batches
+    from mmlspark_tpu.native import available
+
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = _corpus(tmp)
+        # warmup pass primes file cache + threads; sync the final async
+        # device_put so no in-flight transfer leaks into the timed region
+        warm = None
+        for warm, _, _ in device_image_batches(paths[:BATCH * 2], BATCH,
+                                               *OUT_HW):
+            pass
+        if warm is not None:
+            np.asarray(warm)
+
+        t0 = time.perf_counter()
+        total = 0
+        last = None
+        for dev_batch, ok, count in device_image_batches(
+                paths, BATCH, *OUT_HW):
+            total += int(ok[:count].sum())
+            last = dev_batch
+        _ = np.asarray(last)  # hard sync: the final transfer must land
+        dt = time.perf_counter() - t0
+
+        print(json.dumps({
+            "metric": "ingest_jpeg_decode_resize_to_hbm",
+            "value": round(total / dt, 1),
+            "unit": "imgs/sec",
+            "backend": jax.default_backend(),
+            "native_decoder": available(),
+            "images": total,
+            "config": f"{SRC_HW[0]}px jpeg -> {OUT_HW[0]}px, batch {BATCH}",
+        }))
+
+
+if __name__ == "__main__":
+    main()
