@@ -263,8 +263,9 @@ class DataFrame:
         if how not in ("inner", "left", "right", "outer"):
             raise ValueError(f"how must be inner|left|right|outer, got {how!r}")
         on = [on] if isinstance(on, str) else list(on)
-        for k in on:
-            self.col(k), other.col(k)
+        for k in on:  # validate keys exist on both sides (col() raises)
+            self.col(k)
+            other.col(k)
         rmap: dict[tuple, list[int]] = {}
         for j, t in enumerate(zip(*[other.col(k).tolist() for k in on])):
             rmap.setdefault(t, []).append(j)

@@ -72,14 +72,9 @@ def _build_vocab(docs, min_count):
     return vocab, np.array([counts[w] for w in vocab], dtype=np.int64)
 
 
-def _skipgram_pairs(docs, word2id, window, rng):
-    """(center, context) int32 pairs with per-position random window
-    reduction (word2vec's dynamic window ~ distance down-weighting).
-
-    Vectorized over the whole corpus — one numpy pass per distance d,
-    pairing i with i±d where the center's sampled span covers d and both
-    positions fall in the same document — so pair generation stays a small
-    fraction of the jitted training steps even at notebook-202 scale."""
+def _corpus_ids(docs, word2id):
+    """One-time docs -> (token id stream, document id per token); the
+    per-epoch work below only resamples windows over these arrays."""
     ids_parts, doc_parts = [], []
     for di, doc in enumerate(docs):
         ids = [word2id[t] for t in doc if t in word2id]
@@ -87,9 +82,20 @@ def _skipgram_pairs(docs, word2id, window, rng):
             ids_parts.append(np.asarray(ids, dtype=np.int32))
             doc_parts.append(np.full(len(ids), di, dtype=np.int64))
     if not ids_parts:
+        return (np.empty(0, dtype=np.int32), np.empty(0, dtype=np.int64))
+    return np.concatenate(ids_parts), np.concatenate(doc_parts)
+
+
+def _skipgram_pairs(ids, docm, window, rng):
+    """(center, context) int32 pairs with per-position random window
+    reduction (word2vec's dynamic window ~ distance down-weighting).
+
+    Vectorized over the whole corpus — one numpy pass per distance d,
+    pairing i with i±d where the center's sampled span covers d and both
+    positions fall in the same document — so pair generation stays a small
+    fraction of the jitted training steps even at notebook-202 scale."""
+    if len(ids) < 2:
         return (np.empty(0, dtype=np.int32), np.empty(0, dtype=np.int32))
-    ids = np.concatenate(ids_parts)
-    docm = np.concatenate(doc_parts)
     spans = rng.integers(1, window + 1, size=len(ids))
     centers, contexts = [], []
     for d in range(1, min(window, len(ids) - 1) + 1):
@@ -234,9 +240,10 @@ class Word2Vec(Estimator, _W2VParams):
         key = jax.random.PRNGKey(self.getSeed())
         opt_state = _ADAM.init((emb_in, emb_out))
 
+        ids, docm = _corpus_ids(docs, word2id)
         for epoch in range(self.getMaxIter()):
             centers, contexts = _skipgram_pairs(
-                docs, word2id, self.getWindowSize(), rng)
+                ids, docm, self.getWindowSize(), rng)
             n = len(centers)
             if n == 0:
                 break
