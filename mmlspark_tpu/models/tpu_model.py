@@ -156,6 +156,44 @@ class TpuModel(Transformer):
             self._apply_cache_key = cur
         return self._apply_jit
 
+    def exportStableHLO(self, path: str, batch: Optional[int] = None) -> str:
+        """AOT-lower the inference program to StableHLO text and write it to
+        ``path`` — a compiler-level deployment artifact any XLA-hosting
+        runtime (PJRT plugins, IREE, serving systems) can consume without
+        Python. The reference's deployment unit is a CNTK model file run by
+        a JVM wrapper (SURVEY.md §2.2); here the model IS a compiled
+        program, so the export carries the whole forward computation.
+
+        Lowering uses abstract shapes (no device transfer, no execution);
+        ``batch`` defaults to miniBatchSize. Requires modelConfig to know
+        the input feature shape (inputShape, or model-config dims)."""
+        if self.getModelParams() is None:
+            raise ValueError("TpuModel has no params; set modelParams or "
+                             "call setModelLocation before exporting")
+        cfg = self.getModelConfig()
+        from .modules import TOKEN_MODELS, example_input
+        in_dtype = (np.int32 if cfg.get("type") in TOKEN_MODELS
+                    else np.float32)
+        b = batch or self.getMiniBatchSize()
+        if self.getInputShape():
+            # the serving shape: _prep_input reshapes CHW vectors to NHWC
+            c, h, w = self.getInputShape()
+            row_shape = (h, w, c)
+        else:
+            row_shape = tuple(example_input(cfg).shape[1:])
+        x_spec = jax.ShapeDtypeStruct((b,) + row_shape, in_dtype)
+        p_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.result_type(a)),
+            self.getModelParams())
+        fn = self._apply_fn()
+        args = ((p_spec, x_spec,
+                 jax.ShapeDtypeStruct((b,), np.float32))
+                if self._is_moe() else (p_spec, x_spec))
+        text = fn.lower(*args).as_text()
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+
     def warmup(self, example_df: DataFrame, max_rows: Optional[int] = None
                ) -> "TpuModel":
         """Pre-compile every bucketed batch shape up to ``max_rows``
