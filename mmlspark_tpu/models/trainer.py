@@ -74,6 +74,68 @@ def make_loss(name: str, per_example: bool = False):
     return lambda p, l: vec(p, l).mean()
 
 
+def _stream_batch(b, cfg: dict, loss_name: str):
+    """Normalize one (features, labels) generator item to device-ready
+    numpy: token models take int32 ids, labels follow the loss dtype."""
+    x, y = b
+    x = np.asarray(x)
+    x = x.astype(np.int32 if cfg.get("type") in TOKEN_MODELS
+                 else np.float32)
+    y = np.asarray(y)
+    y = (y.astype(np.int32) if loss_name == "cross_entropy"
+         else y.astype(np.float32))
+    if len(x) != len(y):
+        raise ValueError(f"batch features/labels length mismatch: "
+                         f"{len(x)} vs {len(y)}")
+    return x, y
+
+
+def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
+    """Place params on the mesh (TP/EP sharding rules or replication) and
+    init the optimizer AFTER placement, under jit, so optax's zeros_like
+    buffers inherit the param shardings instead of being replicated."""
+    from jax.sharding import PartitionSpec as P
+    rules = []
+    if ep > 1:
+        rules += [("expert_w", P("expert",)), ("expert_b", P("expert",))]
+    if tp > 1:
+        rules += [("Dense", P(None, "model")), ("kernel", P())]
+    if rules:
+        params = meshlib.shard_params_tp(params, mesh, rules)
+    else:
+        params = meshlib.put_replicated(params, mesh)
+    return params, jax.jit(tx.init)(params)
+
+
+def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float):
+    """One jitted optimizer step shared by fit() and fitStream()."""
+
+    @jax.jit
+    def train_step(params, opt_state, xb, yb, wb):
+        # weighted mean so mesh-padding rows (weight 0) carry no gradient
+        def compute(p):
+            # MoE routing must see the row weights too: padded rows may
+            # not claim expert capacity or skew the balancing stats
+            kw = {"row_mask": wb} if is_moe else {}
+            if moe_aux > 0.0:
+                preds, inter = module.apply(p, xb,
+                                            mutable=["intermediates"],
+                                            **kw)
+                from .moe import read_moe_aux_loss
+                aux = read_moe_aux_loss(inter["intermediates"])
+            else:
+                preds = module.apply(p, xb, **kw)
+                aux = 0.0
+            losses = loss_fn(preds, yb)
+            main = jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+            return main + moe_aux * aux
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt2, loss
+
+    return train_step
+
+
 class TpuLearner(Estimator):
     """Data-parallel (optionally tensor-parallel) neural-net training."""
 
@@ -214,48 +276,14 @@ class TpuLearner(Estimator):
                 "multi-host training currently supports data parallelism "
                 "only (the reference's scope, SURVEY.md §2.7); run tp/sp/ep "
                 "within one host or shard the model axes over local devices")
-        from jax.sharding import PartitionSpec as P
-        rules = []
-        if ep > 1:
-            rules += [("expert_w", P("expert",)), ("expert_b", P("expert",))]
-        if tp > 1:
-            rules += [("Dense", P(None, "model")), ("kernel", P())]
-        if rules:
-            params = meshlib.shard_params_tp(params, mesh, rules)
-        else:
-            params = meshlib.put_replicated(params, mesh)
-        # init AFTER placement, under jit: optax's zeros_like buffers inherit
-        # the param shardings (expert/model axes) instead of being replicated
-        opt_state = jax.jit(tx.init)(params)
+        params, opt_state = _place_params(params, mesh, tx, tp=tp, ep=ep)
 
         # only the transformer family reads num_experts (modules.py builder);
         # other configs carrying the key must not get a row_mask kwarg
         is_moe = (cfg.get("type") == "transformer"
                   and cfg.get("num_experts", 0) > 0)
         moe_aux = self.getMoeAuxWeight() if is_moe else 0.0
-
-        @jax.jit
-        def train_step(params, opt_state, xb, yb, wb):
-            # weighted mean so mesh-padding rows (weight 0) carry no gradient
-            def compute(p):
-                # MoE routing must see the row weights too: padded rows may
-                # not claim expert capacity or skew the balancing stats
-                kw = {"row_mask": wb} if is_moe else {}
-                if moe_aux > 0.0:
-                    preds, inter = module.apply(p, xb,
-                                                mutable=["intermediates"],
-                                                **kw)
-                    from .moe import read_moe_aux_loss
-                    aux = read_moe_aux_loss(inter["intermediates"])
-                else:
-                    preds = module.apply(p, xb, **kw)
-                    aux = 0.0
-                losses = loss_fn(preds, yb)
-                main = jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
-                return main + moe_aux * aux
-            loss, grads = jax.value_and_grad(compute)(params)
-            updates, opt2 = tx.update(grads, opt_state, params)
-            return optax.apply_updates(params, updates), opt2, loss
+        train_step = _make_train_step(module, tx, loss_fn, is_moe, moe_aux)
 
         # multi-host: this process's df is its LOCAL shard of the dataset
         # (the Spark-partition analog); batchSize stays the GLOBAL batch.
@@ -308,6 +336,9 @@ class TpuLearner(Estimator):
                 nproc=nproc, train_step=train_step, params=params,
                 opt_state=opt_state)
 
+        return self._package_model(cfg, params, last_loss)
+
+    def _package_model(self, cfg, params, last_loss) -> TpuModel:
         model = (TpuModel()
                  .setInputCol(self.getFeaturesCol())
                  .setModelConfig(cfg)
@@ -315,6 +346,104 @@ class TpuLearner(Estimator):
                  .setInputShape(tuple(self.getInputShape())))
         model._final_loss = last_loss
         return model
+
+    def fitStream(self, batches_fn) -> TpuModel:
+        """Out-of-core training: ``batches_fn()`` returns a FRESH iterator
+        of ``(features, labels)`` host numpy batches for every epoch — e.g.
+        wrapping ``io.loader.image_batches`` over a file corpus, or any
+        generator whose dataset doesn't fit host memory. The reference
+        streams training data from files too (CNTKLearner writes CNTK text
+        format, then CNTK reads it back; DataConversion.scala:89-132); here
+        the stream feeds the jitted step directly, one device batch in
+        flight.
+
+        Single-host, data(+tensor)-parallel. Ragged generator batches
+        bucket to powers of two (weight-masked), so batch-size drift never
+        recompiles. Checkpoint/resume and divergence halt work as in fit().
+        """
+        cfg = dict(self.getModelConfig())
+        if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
+                or jax.process_count() > 1):
+            raise ValueError(
+                "fitStream is single-host data(+tensor)-parallel; use "
+                "fit() for sequence/expert parallelism or multi-host")
+        tp = self.getTensorParallel()
+        mesh = meshlib.create_mesh(model=tp)
+        first_iter = iter(batches_fn())
+        try:
+            first = next(first_iter)
+        except StopIteration:
+            raise ValueError("batches_fn() yielded no batches")
+        x0, y0 = _stream_batch(first, cfg, self.getLoss())
+
+        module = build_model(cfg)
+        params = module.init(jax.random.PRNGKey(self.getSeed()),
+                             jnp.asarray(x0[:1]))
+        tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
+                            self.getMomentum(), self.getWeightDecay())
+        loss_fn = make_loss(self.getLoss(), per_example=True)
+        is_moe = (cfg.get("type") == "transformer"
+                  and cfg.get("num_experts", 0) > 0)
+        train_step = _make_train_step(
+            module, tx, loss_fn, is_moe,
+            self.getMoeAuxWeight() if is_moe else 0.0)
+        params, opt_state = _place_params(params, mesh, tx, tp=tp)
+
+        start_epoch = 0
+        resume = self._latest_checkpoint()
+        if resume is not None:
+            params, opt_state = self._restore_checkpoint(resume, params,
+                                                         opt_state)
+            start_epoch = resume + 1
+            log.info("resumed from checkpoint epoch %d", resume)
+
+        from .tpu_model import _next_pow2
+        axis = mesh.shape["data"]
+        import contextlib
+        guard = (meshlib.collective_fit_lock if mesh.size > 1
+                 else contextlib.nullcontext())
+        last_loss = None
+        with guard:
+            for epoch in range(start_epoch, self.getEpochs()):
+                it = first_iter if epoch == start_epoch and first is not None \
+                    else iter(batches_fn())
+                batches = ([first] if epoch == start_epoch else [])
+                first = None  # only replayed once
+                import itertools
+                n_batches = 0
+                for b in itertools.chain(batches, it):
+                    xb, yb = _stream_batch(b, cfg, self.getLoss())
+                    n = len(xb)
+                    # pow2 bucket, rounded up to a data-axis multiple (a
+                    # 6-device axis doesn't divide pow2 buckets)
+                    target = -(-max(_next_pow2(n), axis) // axis) * axis
+                    if n < target:
+                        fx = np.zeros((target - n,) + xb.shape[1:], xb.dtype)
+                        xb = np.concatenate([xb, fx])
+                        yb = np.concatenate(
+                            [yb, np.zeros(target - n, yb.dtype)])
+                    wb = np.zeros(target, dtype=np.float32)
+                    wb[:n] = 1.0
+                    params, opt_state, loss = train_step(
+                        params, opt_state,
+                        meshlib.shard_batch(xb, mesh),
+                        meshlib.shard_batch(yb, mesh),
+                        meshlib.shard_batch(wb, mesh))
+                    n_batches += 1
+                if n_batches == 0:
+                    raise ValueError(f"batches_fn() yielded no batches in "
+                                     f"epoch {epoch}")
+                last_loss = float(loss)
+                log.info("epoch %d loss %.4f (%d stream batches)",
+                         epoch, last_loss, n_batches)
+                if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
+                    raise RuntimeError(
+                        f"training diverged: epoch {epoch} loss {last_loss} "
+                        f"(lr={self.getLearningRate()})")
+                if self.getCheckpointDir():
+                    self._save_checkpoint(epoch, params, opt_state)
+
+        return self._package_model(cfg, params, last_loss)
 
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state):

@@ -514,3 +514,105 @@ def test_export_stablehlo_honors_input_shape(tmp_path):
              .setInputShape((3, 224, 224)))
     out = model.exportStableHLO(str(tmp_path / "r50.stablehlo"), batch=4)
     assert "tensor<4x224x224x3xf32>" in open(out).read()
+
+
+class TestFitStream:
+    """Out-of-core training: generator-fed epochs, ragged batch bucketing,
+    checkpoint/resume — the streaming analog of the reference's
+    train-from-files path (CNTKLearner writes CNTK text, CNTK streams it)."""
+
+    def _stream_fn(self, seed=0, batches=8, bs=32, ragged=False):
+        rng = np.random.default_rng(seed)
+        centers = np.array([[-2.0] * 6, [2.0] * 6], dtype=np.float32)
+
+        def make():
+            r = np.random.default_rng(seed)
+            for i in range(batches):
+                n = bs - (i % 5) if ragged else bs
+                y = r.integers(0, 2, n)
+                x = centers[y] + r.normal(size=(n, 6)).astype(np.float32)
+                yield x.astype(np.float32), y
+        return make
+
+    def _learner(self, **kw):
+        base = dict(modelConfig={"type": "mlp", "hidden": [16],
+                                 "num_classes": 2},
+                    epochs=3, learningRate=0.05)
+        base.update(kw)
+        return TpuLearner().set(**base)
+
+    def test_learns_from_stream(self):
+        model = self._learner().fitStream(self._stream_fn())
+        assert np.isfinite(model._final_loss)
+        rng = np.random.default_rng(9)
+        centers = np.array([[-2.0] * 6, [2.0] * 6], dtype=np.float32)
+        y = rng.integers(0, 2, 64)
+        x = centers[y] + rng.normal(size=(64, 6)).astype(np.float32)
+        feats = np.empty(64, dtype=object)
+        for i in range(64):
+            feats[i] = x[i].astype(np.float32)
+        out = model.transform(DataFrame({"features": feats}))
+        preds = np.stack(list(out.col("scores"))).argmax(axis=1)
+        assert (preds == y).mean() > 0.95
+
+    def test_ragged_batches_bucket(self):
+        model = self._learner(epochs=2).fitStream(
+            self._stream_fn(ragged=True))
+        assert np.isfinite(model._final_loss)
+
+    def test_checkpoint_resume(self, tmp_path):
+        ck = str(tmp_path / "ck")
+        self._learner(epochs=2, checkpointDir=ck).fitStream(self._stream_fn())
+        assert len(list((tmp_path / "ck").glob("ckpt_*"))) == 2
+        self._learner(epochs=4, checkpointDir=ck).fitStream(self._stream_fn())
+        assert len(list((tmp_path / "ck").glob("ckpt_*"))) == 4
+
+    def test_empty_stream_raises(self):
+        with pytest.raises(ValueError, match="no batches"):
+            self._learner().fitStream(lambda: iter(()))
+
+    def test_length_mismatch_raises(self):
+        def bad():
+            yield np.zeros((4, 6), np.float32), np.zeros(3, np.int64)
+        with pytest.raises(ValueError, match="mismatch"):
+            self._learner().fitStream(bad)
+
+    def test_sp_rejected(self):
+        learner = self._learner().setSequenceParallel(2)
+        with pytest.raises(ValueError, match="single-host"):
+            learner.fitStream(self._stream_fn())
+
+
+def test_fitstream_from_image_loader(tmp_path):
+    """End-to-end out-of-core path: files -> io.loader.image_batches ->
+    fitStream, never materializing the dataset."""
+    import cv2
+    from mmlspark_tpu.io.loader import image_batches
+
+    rng = np.random.default_rng(0)
+    paths, labels = [], []
+    for i in range(48):
+        y = i % 2
+        img = rng.integers(0, 80, (16, 16, 3))
+        img[(slice(0, 8) if y == 0 else slice(8, 16))] += 150
+        p = str(tmp_path / f"im{i}.png")
+        cv2.imwrite(p, img.astype(np.uint8))
+        paths.append(p)
+        labels.append(y)
+    labels = np.array(labels, dtype=np.int64)
+
+    def batches():
+        for bi, (buf, ok, count) in enumerate(
+                image_batches(paths, 16, 16, 16)):
+            x = buf[:count].astype(np.float32) / 255.0
+            y = labels[bi * 16: bi * 16 + count]
+            keep = ok[:count]
+            yield x[keep], y[keep]
+
+    model = (TpuLearner()
+             .setModelConfig({"type": "convnet", "channels": [8],
+                              "dense": 16, "num_classes": 2,
+                              "height": 16, "width": 16})
+             .setEpochs(6).setLearningRate(0.05)
+             .fitStream(batches))
+    assert np.isfinite(model._final_loss) and model._final_loss < 0.5
