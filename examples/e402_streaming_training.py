@@ -1,0 +1,67 @@
+"""Example 402 — out-of-core streaming training (extends the notebook-401
+story: the reference writes CNTK text files to disk and CNTK streams them
+during MPI training; here a batch generator — backed by the C++ image
+loader over a file corpus — feeds the jitted train step directly, and the
+dataset never materializes in host memory).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.io.loader import image_batches
+from mmlspark_tpu.models import TpuLearner
+
+# --- write a small on-disk corpus (stands in for a directory of images) ---
+import cv2
+
+rng = np.random.default_rng(0)
+tmp = tempfile.mkdtemp()
+paths, labels = [], []
+for i in range(96):
+    y = i % 2
+    img = rng.integers(0, 80, (16, 16, 3))
+    img[(slice(0, 8) if y == 0 else slice(8, 16))] += 150
+    p = os.path.join(tmp, f"img{i}.png")
+    cv2.imwrite(p, img.astype(np.uint8))
+    paths.append(p)
+    labels.append(y)
+labels = np.array(labels, dtype=np.int64)
+
+
+def batches():
+    """Fresh pass over the corpus: threaded decode -> (x, y) host batches."""
+    for bi, (buf, ok, count) in enumerate(image_batches(paths, 32, 16, 16)):
+        x = buf[:count].astype(np.float32) / 255.0
+        y = labels[bi * 32: bi * 32 + count]
+        keep = ok[:count]
+        yield x[keep], y[keep]
+
+
+model = (TpuLearner()
+         .setModelConfig({"type": "convnet", "channels": [8], "dense": 16,
+                          "num_classes": 2, "height": 16, "width": 16})
+         .setInputShape((3, 16, 16))  # eval frames carry CHW-flat vectors
+         .setEpochs(6).setLearningRate(0.05)
+         .fitStream(batches))
+print("streamed fit final loss:", round(model._final_loss, 4))
+assert model._final_loss < 0.5
+
+# the fitted model scores in-memory frames like any other TpuModel
+eval_rows = []
+for p in paths[:32]:
+    img = cv2.imread(p).astype(np.float32) / 255.0
+    eval_rows.append(img.transpose(2, 0, 1).ravel())  # CHW-flat, UnrollImage layout
+df = DataFrame({"features": object_column(eval_rows)})
+preds = np.stack(list(model.transform(df).col("scores"))).argmax(axis=1)
+acc = float((preds == labels[:32]).mean())
+print("accuracy on first 32 files:", acc)
+assert acc > 0.9
+
+import shutil
+
+shutil.rmtree(tmp)
+print("example 402 OK")
