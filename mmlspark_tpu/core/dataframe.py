@@ -464,6 +464,12 @@ class GroupedData:
         """(values sorted by group id, segment starts) for reduceat."""
         return self._df.col(name)[self._order], self._starts
 
+    def rowGroupIds(self) -> np.ndarray:
+        """Group id per ORIGINAL row (first-occurrence order, matching the
+        row order of agg()/count() output) — lets callers broadcast
+        aggregates back onto the ungrouped frame."""
+        return self._ids.copy()
+
     def agg(self, spec: Optional[dict] = None, **named) -> DataFrame:
         """``agg({"col": "mean"})`` -> column ``mean(col)`` (Spark naming), or
         ``agg(out=("col", "mean"))`` for explicit output names."""
@@ -489,15 +495,33 @@ class GroupedData:
                 cols[out] = object_column(
                     [list(vals[s:e]) for s, e in
                      zip(starts, np.r_[starts[1:], len(vals)])])
+            elif fn in ("sum", "mean") and vals.dtype.kind == "O":
+                # vector-valued cells (object column of equal-length
+                # arrays): stack once, segment-reduce along rows
+                from .utils import object_column
+                if len(vals) == 0:
+                    cols[out] = object_column([])
+                    continue
+                try:
+                    mat = np.stack([np.asarray(v, dtype=np.float64)
+                                    for v in vals])
+                except (ValueError, TypeError) as e:
+                    raise TypeError(
+                        f"{fn} on object column {col!r} needs numeric "
+                        f"array cells of one common length ({e})") from e
+                if mat.ndim < 2:  # scalar cells: not the vector path
+                    raise TypeError(f"{fn} needs a numeric column, "
+                                    f"{col!r} is object-typed")
+                seg = np.add.reduceat(mat, starts, axis=0)
+                if fn == "mean":
+                    seg = seg / counts[:, None]
+                cols[out] = object_column(list(seg))
             elif fn in ("sum", "min", "max"):
                 if vals.dtype.kind == "O":
                     raise TypeError(f"{fn} needs a numeric column, "
                                     f"{col!r} is object-typed")
                 cols[out] = _AGG_REDUCERS[fn].reduceat(vals, starts)
             elif fn == "mean":
-                if vals.dtype.kind == "O":
-                    raise TypeError(f"mean needs a numeric column, "
-                                    f"{col!r} is object-typed")
                 cols[out] = (np.add.reduceat(vals.astype(np.float64), starts)
                              / counts)
             else:

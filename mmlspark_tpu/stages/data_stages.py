@@ -171,35 +171,16 @@ class EnsembleByKey(Transformer):
         vcols = list(self.getCols())
         if not keys or not vcols:
             raise ValueError("keys and cols must both be set")
-        key_vals = [tuple(df.col(k)[i] for k in keys)
-                    for i in range(df.count())]
-        groups: dict[tuple, list[int]] = {}
-        for i, kv in enumerate(key_vals):
-            groups.setdefault(kv, []).append(i)
-        rows = []
-        for kv, idxs in groups.items():
-            row = dict(zip(keys, kv))
-            for c in vcols:
-                col = df.col(c)
-                vals = [col[i] for i in idxs]
-                if self.getStrategy() == "collect":
-                    row[c] = list(vals)
-                elif np.ndim(vals[0]) >= 1:
-                    row[c] = np.mean(np.stack(vals), axis=0)
-                else:
-                    row[c] = float(np.mean(vals))
-            rows.append(row)
-        out = DataFrame.fromRows(rows)
+        fn = "collect_list" if self.getStrategy() == "collect" else "mean"
+        grouped = df.groupBy(*keys)
+        out = grouped.agg(**{c: (c, fn) for c in vcols})
         if self.getCollapseGroup():
             return out
-        # broadcast aggregates back onto every original row
-        agg = {tuple(r[k] for k in keys): r for r in rows}
+        # broadcast aggregates back onto every original row (one gather)
+        ids = grouped.rowGroupIds()
         res = df
         for c in vcols:
-            col = np.empty(df.count(), dtype=object)
-            for i, kv in enumerate(key_vals):
-                col[i] = agg[kv][c]
-            res = res.withColumn(c, col)
+            res = res.withColumn(c, out.col(c)[ids])
         return res
 
 

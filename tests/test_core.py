@@ -336,3 +336,32 @@ class TestRelationalOps:
         df = self._df().withMetadata("k", {"cat": True})
         assert df.groupBy("k").count().metadata("k") == {"cat": True}
         assert df.groupBy("k").agg({"x": "mean"}).metadata("k") == {"cat": True}
+
+    def test_group_vector_mean_and_sum(self):
+        from mmlspark_tpu.core.utils import object_column
+        df = DataFrame({
+            "k": np.array(["a", "a", "b"], dtype=object),
+            "v": object_column([np.array([1., 2.]), np.array([3., 4.]),
+                                np.array([10., 20.])]),
+        })
+        out = df.groupBy("k").agg(m=("v", "mean"), s=("v", "sum")).sort("k")
+        np.testing.assert_allclose(out.col("m")[0], [2.0, 3.0])
+        np.testing.assert_allclose(out.col("s")[0], [4.0, 6.0])
+        np.testing.assert_allclose(out.col("m")[1], [10.0, 20.0])
+        # ragged vector cells fail loudly
+        bad = DataFrame({"k": np.array(["a", "a"], dtype=object),
+                         "v": object_column([np.ones(2), np.ones(3)])})
+        with pytest.raises(TypeError, match="common length"):
+            bad.groupBy("k").agg({"v": "mean"})
+
+    def test_group_scalar_object_cells_still_rejected(self):
+        from mmlspark_tpu.core.utils import object_column
+        df = DataFrame({"k": np.array(["a", "a", "b"], dtype=object),
+                        "v": object_column([1.0, 2.0, 3.0])})
+        with pytest.raises(TypeError, match="numeric column"):
+            df.groupBy("k").agg({"v": "mean"})
+        # empty frame with an object column aggregates to empty, not a crash
+        empty = df.filter(np.zeros(3, dtype=bool))
+        from mmlspark_tpu.core.utils import object_column as oc
+        vecs = DataFrame({"k": np.array([], dtype=object), "v": oc([])})
+        assert vecs.groupBy("k").agg({"v": "mean"}).count() == 0
