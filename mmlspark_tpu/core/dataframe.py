@@ -470,7 +470,7 @@ class GroupedData:
         aggregates back onto the ungrouped frame."""
         return self._ids.copy()
 
-    def agg(self, spec: Optional[dict] = None, **named) -> DataFrame:
+    def agg(self, spec: Optional[dict] = None, /, **named) -> DataFrame:
         """``agg({"col": "mean"})`` -> column ``mean(col)`` (Spark naming), or
         ``agg(out=("col", "mean"))`` for explicit output names."""
         items: list[tuple[str, str, str]] = []  # (out_name, col, fn)
@@ -483,6 +483,7 @@ class GroupedData:
         cols = self._key_frame()
         n_groups = len(self._firsts)
         counts = np.bincount(self._ids, minlength=n_groups)
+        stacked: dict = {}  # per-source-column cell matrix, reused across fns
         for out, col, fn in items:
             if fn == "count":
                 cols[out] = counts.astype(np.int64)
@@ -496,25 +497,28 @@ class GroupedData:
                     [list(vals[s:e]) for s, e in
                      zip(starts, np.r_[starts[1:], len(vals)])])
             elif fn in ("sum", "mean") and vals.dtype.kind == "O":
-                # vector-valued cells (object column of equal-length
-                # arrays): stack once, segment-reduce along rows
+                # vector-valued cells (object column of equal-shape
+                # arrays): stack once per source column, segment-reduce
                 from .utils import object_column
                 if len(vals) == 0:
                     cols[out] = object_column([])
                     continue
-                try:
-                    mat = np.stack([np.asarray(v, dtype=np.float64)
-                                    for v in vals])
-                except (ValueError, TypeError) as e:
-                    raise TypeError(
-                        f"{fn} on object column {col!r} needs numeric "
-                        f"array cells of one common length ({e})") from e
+                if col not in stacked:
+                    try:
+                        stacked[col] = np.stack(
+                            [np.asarray(v, dtype=np.float64) for v in vals])
+                    except (ValueError, TypeError) as e:
+                        raise TypeError(
+                            f"{fn} on object column {col!r} needs numeric "
+                            f"array cells of one common shape ({e})") from e
+                mat = stacked[col]
                 if mat.ndim < 2:  # scalar cells: not the vector path
                     raise TypeError(f"{fn} needs a numeric column, "
                                     f"{col!r} is object-typed")
                 seg = np.add.reduceat(mat, starts, axis=0)
                 if fn == "mean":
-                    seg = seg / counts[:, None]
+                    # divide along the GROUP axis only, whatever the cell rank
+                    seg = seg / counts.reshape((-1,) + (1,) * (seg.ndim - 1))
                 cols[out] = object_column(list(seg))
             elif fn in ("sum", "min", "max"):
                 if vals.dtype.kind == "O":

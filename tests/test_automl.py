@@ -15,10 +15,13 @@ from mmlspark_tpu.automl import (ComputeModelStatistics,
                                  TrainClassifier, TrainRegressor,
                                  TuneHyperparameters, ValueIndexer)
 from mmlspark_tpu.automl.metrics import auc_score, classification_metrics
-from mmlspark_tpu.models import (DecisionTreeClassifier, GBTClassifier,
-                                 LinearRegression, LogisticRegression,
+from mmlspark_tpu.models import (DecisionTreeClassifier,
+                                 DecisionTreeRegressor, GBTClassifier,
+                                 GBTRegressor, LinearRegression,
+                                 LogisticRegression,
                                  MultilayerPerceptronClassifier, NaiveBayes,
-                                 RandomForestClassifier)
+                                 RandomForestClassifier,
+                                 RandomForestRegressor)
 from mmlspark_tpu.testing import assert_golden, assert_golden_json
 
 GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
@@ -146,7 +149,38 @@ class TestTrainClassifier:
         assert acc > 0.9
 
 
+R_ALGOS = {
+    "LinearRegression": lambda: LinearRegression()
+        .setMaxIter(2000).setStepSize(0.5),
+    "DecisionTree": lambda: DecisionTreeRegressor().setMaxBin(63),
+    "RandomForest": lambda: RandomForestRegressor()
+        .setNumIterations(20).setMaxBin(63),
+    "GBT": lambda: GBTRegressor().setNumIterations(30).setMaxBin(63),
+}
+
+R_GOLDENS = os.path.join(GOLDEN_DIR, "train_regressor_benchmark_metrics.csv")
+
+
 class TestTrainRegressor:
+    @pytest.mark.parametrize("algo", list(R_ALGOS))
+    def test_diabetes_golden_grid(self, algo):
+        """Regressor half of the reference's committed-metric grids
+        (regressionBenchmarkMetrics.csv commits RMSE-class goldens per
+        dataset; sklearn's diabetes stands in under zero egress)."""
+        from sklearn.datasets import load_diabetes
+        x, y = load_diabetes(return_X_y=True)
+        df = DataFrame({f"f{i}": x[:, i].astype(np.float32)
+                        for i in range(x.shape[1])}
+                       | {"target": y.astype(np.float64)})
+        model = (TrainRegressor().setLabelCol("target")
+                 .setModel(R_ALGOS[algo]()).fit(df))
+        pred = np.asarray(model.transform(df).col("prediction"))
+        rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+        # RMSE scales ~50-60 on diabetes: tolerance follows the magnitude
+        assert_golden(R_GOLDENS, "diabetes", algo, "rmse", rmse,
+                      tolerance=3.0)
+        assert rmse < 0.9 * float(np.std(y)), f"{algo}: rmse {rmse}"
+
     def test_linear_target(self):
         rng = np.random.default_rng(0)
         n = 300

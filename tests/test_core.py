@@ -351,7 +351,7 @@ class TestRelationalOps:
         # ragged vector cells fail loudly
         bad = DataFrame({"k": np.array(["a", "a"], dtype=object),
                          "v": object_column([np.ones(2), np.ones(3)])})
-        with pytest.raises(TypeError, match="common length"):
+        with pytest.raises(TypeError, match="common shape"):
             bad.groupBy("k").agg({"v": "mean"})
 
     def test_group_scalar_object_cells_still_rejected(self):
@@ -361,7 +361,22 @@ class TestRelationalOps:
         with pytest.raises(TypeError, match="numeric column"):
             df.groupBy("k").agg({"v": "mean"})
         # empty frame with an object column aggregates to empty, not a crash
-        empty = df.filter(np.zeros(3, dtype=bool))
-        from mmlspark_tpu.core.utils import object_column as oc
-        vecs = DataFrame({"k": np.array([], dtype=object), "v": oc([])})
+        vecs = DataFrame({"k": np.array([], dtype=object),
+                          "v": object_column([])})
         assert vecs.groupBy("k").agg({"v": "mean"}).count() == 0
+
+    def test_group_matrix_cells_and_spec_column_name(self):
+        from mmlspark_tpu.core.utils import object_column
+        # matrix-valued cells: the mean divides along the GROUP axis only
+        ones = np.ones((2, 3))
+        df = DataFrame({"k": np.array(["a", "a", "b"], dtype=object),
+                        "v": object_column([ones, ones, 2 * ones])})
+        out = df.groupBy("k").agg(m=("v", "mean")).sort("k")
+        np.testing.assert_allclose(out.col("m")[0], ones)
+        np.testing.assert_allclose(out.col("m")[1], 2 * ones)
+        # a value column literally named "spec" must not collide with the
+        # positional-only spec parameter of agg()
+        df2 = DataFrame({"k": np.array(["a", "a"], dtype=object),
+                         "spec": np.array([1.0, 3.0])})
+        out2 = df2.groupBy("k").agg(spec=("spec", "mean"))
+        assert float(out2.col("spec")[0]) == 2.0
