@@ -267,12 +267,14 @@ class DataFrame:
             self.col(k)
             other.col(k)
         rmap: dict[tuple, list[int]] = {}
-        for j, t in enumerate(zip(*[other.col(k).tolist() for k in on])):
+        for j, t in enumerate(zip(*[[_hashable(v) for v in other.col(k).tolist()]
+                                    for k in on])):
             rmap.setdefault(t, []).append(j)
         li: list[int] = []
         ri: list[int] = []
         matched: set[int] = set()
-        for i, t in enumerate(zip(*[self.col(k).tolist() for k in on])):
+        for i, t in enumerate(zip(*[[_hashable(v) for v in self.col(k).tolist()]
+                                    for k in on])):
             js = rmap.get(t)
             if js:
                 for j in js:
@@ -297,7 +299,8 @@ class DataFrame:
                 # key columns never null (a key exists on >=1 side), so take
                 # raw values from whichever side matched — no NaN widening
                 rv = other.col(k)
-                lg, rg = v[np.clip(lidx, 0, None)], rv[np.clip(ridx, 0, None)]
+                lg = _safe_take(v, lidx)
+                rg = _safe_take(rv, ridx)
                 if v.dtype == rv.dtype and v.dtype.kind != "O":
                     src = np.where(lidx >= 0, lg, rg)
                 else:
@@ -397,17 +400,34 @@ class DataFrame:
 
 
 def _hashable(v):
-    """Dict-key form of a cell value (vector cells -> bytes/tuples)."""
+    """Dict-key form of a cell value (vector cells -> bytes/tuples,
+    struct cells like image rows -> sorted item tuples)."""
     if isinstance(v, np.ndarray):
         return (v.shape, v.tobytes())
     if isinstance(v, (list, tuple)):
         return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
     return v
+
+
+def _safe_take(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """col[clip(idx)] that tolerates an EMPTY col (all idx are then -1 and
+    the values are placeholders the caller masks out)."""
+    if len(col) == 0:
+        if col.dtype.kind == "O":
+            return np.full(len(idx), None, dtype=object)
+        return np.zeros(len(idx), dtype=col.dtype)
+    return col[np.clip(idx, 0, None)]
 
 
 def _gather_with_nulls(col: np.ndarray, idx: np.ndarray) -> np.ndarray:
     """col[idx] where idx==-1 yields null: NaN for floats (ints widen to
     float64, Spark's nullable-column semantics), None for object columns."""
+    if len(col) == 0:  # empty join side: every row is null
+        if col.dtype.kind == "O":
+            return np.full(len(idx), None, dtype=object)
+        return np.full(len(idx), np.nan, dtype=np.float64)
     missing = idx < 0
     safe = np.clip(idx, 0, None)
     if not missing.any():
@@ -480,6 +500,11 @@ class GroupedData:
             items.append((out, col, fn))
         if not items:
             raise ValueError("agg needs at least one aggregation")
+        clash = [out for out, _, _ in items if out in self._keys]
+        if clash:
+            raise ValueError(
+                f"aggregation output name(s) {clash} collide with group "
+                f"key columns; pick different output names")
         cols = self._key_frame()
         n_groups = len(self._firsts)
         counts = np.bincount(self._ids, minlength=n_groups)
@@ -512,14 +537,14 @@ class GroupedData:
                             f"{fn} on object column {col!r} needs numeric "
                             f"array cells of one common shape ({e})") from e
                 mat = stacked[col]
-                if mat.ndim < 2:  # scalar cells: not the vector path
-                    raise TypeError(f"{fn} needs a numeric column, "
-                                    f"{col!r} is object-typed")
                 seg = np.add.reduceat(mat, starts, axis=0)
                 if fn == "mean":
                     # divide along the GROUP axis only, whatever the cell rank
                     seg = seg / counts.reshape((-1,) + (1,) * (seg.ndim - 1))
-                cols[out] = object_column(list(seg))
+                if mat.ndim < 2:  # numeric scalar cells -> plain column
+                    cols[out] = seg
+                else:
+                    cols[out] = object_column(list(seg))
             elif fn in ("sum", "min", "max"):
                 if vals.dtype.kind == "O":
                     raise TypeError(f"{fn} needs a numeric column, "
@@ -534,6 +559,9 @@ class GroupedData:
                          npartitions=self._df.npartitions)
 
     def count(self) -> DataFrame:
+        if "count" in self._keys:
+            raise ValueError("a group key is named 'count'; use "
+                             "agg(<name>=(key, 'count')) instead")
         cols = self._key_frame()
         cols["count"] = np.bincount(
             self._ids, minlength=len(self._firsts)).astype(np.int64)
@@ -544,6 +572,9 @@ class GroupedData:
         names = list(names) or [c for c in self._df.columns
                                 if c not in self._keys
                                 and self._df.col(c).dtype.kind in "biuf"]
+        if not names:  # no numeric columns: keys only (Spark behavior)
+            return DataFrame(self._key_frame(), metadata=self._key_meta(),
+                             npartitions=self._df.npartitions)
         return self.agg({c: fn for c in names})
 
     def sum(self, *names: str) -> DataFrame:

@@ -354,12 +354,19 @@ class TestRelationalOps:
         with pytest.raises(TypeError, match="common shape"):
             bad.groupBy("k").agg({"v": "mean"})
 
-    def test_group_scalar_object_cells_still_rejected(self):
+    def test_group_scalar_object_cells_aggregate(self):
         from mmlspark_tpu.core.utils import object_column
+        # numeric scalars stored in an object column (join null-fill,
+        # fromRows) aggregate like a plain numeric column
         df = DataFrame({"k": np.array(["a", "a", "b"], dtype=object),
                         "v": object_column([1.0, 2.0, 3.0])})
-        with pytest.raises(TypeError, match="numeric column"):
-            df.groupBy("k").agg({"v": "mean"})
+        out = df.groupBy("k").agg({"v": "mean"}).sort("k")
+        np.testing.assert_allclose(out.col("mean(v)"), [1.5, 3.0])
+        # non-numeric object cells still fail loudly
+        sdf = DataFrame({"k": np.array(["a"], dtype=object),
+                         "v": np.array(["txt"], dtype=object)})
+        with pytest.raises(TypeError):
+            sdf.groupBy("k").agg({"v": "mean"})
         # empty frame with an object column aggregates to empty, not a crash
         vecs = DataFrame({"k": np.array([], dtype=object),
                           "v": object_column([])})
@@ -380,3 +387,43 @@ class TestRelationalOps:
                          "spec": np.array([1.0, 3.0])})
         out2 = df2.groupBy("k").agg(spec=("spec", "mean"))
         assert float(out2.col("spec")[0]) == 2.0
+
+
+    def test_join_with_empty_side(self):
+        left = DataFrame({"k": np.array([1, 2]), "x": np.array([1., 2.])})
+        empty = DataFrame({"k": np.array([], dtype=np.int64),
+                           "z": np.array([], dtype=np.float64)})
+        out = left.join(empty, "k", how="left")
+        assert out.count() == 2 and np.isnan(out.col("z")).all()
+        assert empty.join(left, "k", how="right").count() == 2
+        assert left.join(empty, "k").count() == 0
+
+    def test_join_on_vector_key(self):
+        from mmlspark_tpu.core.utils import object_column
+        key = [np.array([1., 2.]), np.array([3., 4.])]
+        left = DataFrame({"k": object_column(key), "x": np.array([1., 2.])})
+        right = DataFrame({"k": object_column([key[1]]),
+                           "z": np.array([9.])})
+        out = left.join(right, "k")
+        assert out.count() == 1 and float(out.col("z")[0]) == 9.0
+
+    def test_distinct_with_image_struct_column(self):
+        from mmlspark_tpu.core.schema import make_image_row
+        from mmlspark_tpu.core.utils import object_column
+        img = make_image_row("p", 2, 2, 3,
+                             np.zeros((2, 2, 3), dtype=np.uint8))
+        df = DataFrame({"image": object_column([img, img])})
+        assert df.distinct().count() == 1
+
+    def test_agg_output_name_collisions_raise(self):
+        df = self._df()
+        with pytest.raises(ValueError, match="collide"):
+            df.groupBy("k").agg(k=("x", "mean"))
+        with pytest.raises(ValueError, match="count"):
+            df.withColumnRenamed("k", "count").groupBy("count").count()
+
+    def test_group_mean_without_numeric_columns(self):
+        df = DataFrame({"k": np.array(["a", "b"], dtype=object),
+                        "s": np.array(["x", "y"], dtype=object)})
+        out = df.groupBy("k").mean()
+        assert out.columns == ["k"] and out.count() == 2
