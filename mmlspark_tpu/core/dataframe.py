@@ -399,6 +399,15 @@ class DataFrame:
         return f"DataFrame[{self._n} rows, {self.npartitions} parts]({spec})"
 
 
+#: Dict-key stand-ins for NaN / null cells so grouping/distinct/join treat
+#: all NaN keys as equal (Spark normalizes NaN equality in these ops; the
+#: IEEE default nan != nan would otherwise make every NaN row its own group)
+#: and all nulls as equal — but NaN and null stay DISTINCT groups, matching
+#: Spark (null is absence, NaN is a float value).
+_NAN_SENTINEL = ("__mmltpu_nan__",)
+_NULL_SENTINEL = ("__mmltpu_null__",)
+
+
 def _hashable(v):
     """Dict-key form of a cell value (vector cells -> bytes/tuples,
     struct cells like image rows -> sorted item tuples)."""
@@ -408,6 +417,10 @@ def _hashable(v):
         return tuple(_hashable(x) for x in v)
     if isinstance(v, dict):
         return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if v is None:
+        return _NULL_SENTINEL
+    if isinstance(v, float) and v != v:
+        return _NAN_SENTINEL
     return v
 
 

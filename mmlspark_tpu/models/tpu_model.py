@@ -156,7 +156,8 @@ class TpuModel(Transformer):
             self._apply_cache_key = cur
         return self._apply_jit
 
-    def exportStableHLO(self, path: str, batch: Optional[int] = None) -> str:
+    def exportStableHLO(self, path: str, batch: Optional[int] = None,
+                        in_dtype=None) -> str:
         """AOT-lower the inference program to StableHLO text and write it to
         ``path`` — a compiler-level deployment artifact any XLA-hosting
         runtime (PJRT plugins, IREE, serving systems) can consume without
@@ -166,14 +167,20 @@ class TpuModel(Transformer):
 
         Lowering uses abstract shapes (no device transfer, no execution);
         ``batch`` defaults to miniBatchSize. Requires modelConfig to know
-        the input feature shape (inputShape, or model-config dims)."""
+        the input feature shape (inputShape, or model-config dims).
+
+        The input dtype matches what transform() actually compiles and
+        serves: int32 for token models; uint8 for image-shaped models fed
+        image columns (``_prep_input`` keeps bytes on the wire); otherwise
+        float32, or bfloat16 under transferDtype. Flat-vector inputs
+        (inputShape set) always arrive as floats. Pass ``in_dtype`` to
+        override (e.g. ``np.float32`` to export a float-input variant of an
+        image model)."""
         if self.getModelParams() is None:
             raise ValueError("TpuModel has no params; set modelParams or "
                              "call setModelLocation before exporting")
         cfg = self.getModelConfig()
         from .modules import TOKEN_MODELS, example_input
-        in_dtype = (np.int32 if cfg.get("type") in TOKEN_MODELS
-                    else np.float32)
         b = batch or self.getMiniBatchSize()
         if self.getInputShape():
             # the serving shape: _prep_input reshapes CHW vectors to NHWC
@@ -181,6 +188,17 @@ class TpuModel(Transformer):
             row_shape = (h, w, c)
         else:
             row_shape = tuple(example_input(cfg).shape[1:])
+        if in_dtype is None:
+            if cfg.get("type") in TOKEN_MODELS:
+                in_dtype = np.int32
+            elif (cfg.get("type") in ("convnet", "resnet", "resnet50")
+                  and not self.getInputShape()):
+                in_dtype = np.uint8  # image rows ship as bytes
+            elif self.getTransferDtype() == "bfloat16":
+                import ml_dtypes
+                in_dtype = ml_dtypes.bfloat16
+            else:
+                in_dtype = np.float32
         x_spec = jax.ShapeDtypeStruct((b,) + row_shape, in_dtype)
         p_spec = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(np.shape(a), np.result_type(a)),

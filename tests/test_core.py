@@ -318,6 +318,38 @@ class TestRelationalOps:
         assert out.count() == 0
         assert df.groupBy("k").count().count() == 0
 
+    def test_nan_keys_group_as_one(self):
+        # Spark normalizes NaN equality in grouping/distinct/join keys;
+        # IEEE nan != nan must not leak into key hashing
+        df = DataFrame({"k": np.array([np.nan, np.nan, 1.0]),
+                        "x": np.array([1., 2., 3.])})
+        out = df.groupBy("k").agg({"x": "sum"})
+        assert out.count() == 2
+        sums = sorted(out.col("sum(x)").tolist())
+        assert sums == [3.0, 3.0]
+        assert df.distinct().count() == 3  # x differs; k alone has 2 levels
+        assert df.select("k").distinct().count() == 2
+
+    def test_nan_and_none_join_keys_match(self):
+        left = DataFrame({"k": np.array([np.nan, 1.0]),
+                          "x": np.array([10., 20.])})
+        right = DataFrame({"k": np.array([np.nan, 2.0]),
+                           "z": np.array([7., 8.])})
+        out = left.join(right, "k")
+        assert out.count() == 1
+        assert out.col("z")[0] == 7.0
+        left_o = DataFrame({"k": np.array([None, "a"], dtype=object),
+                            "x": np.array([1., 2.])})
+        right_o = DataFrame({"k": np.array([None], dtype=object),
+                             "z": np.array([9.])})
+        assert left_o.join(right_o, "k").count() == 1
+        # but null and NaN are DISTINCT keys (Spark: null is absence,
+        # NaN is a float value)
+        mixed = DataFrame({"k": np.array([None, np.nan, np.nan],
+                                         dtype=object),
+                           "x": np.array([1., 2., 3.])})
+        assert mixed.select("k").distinct().count() == 2
+
     def test_distinct_with_vector_column(self):
         from mmlspark_tpu.core.utils import object_column
         df = DataFrame({"k": np.array([1, 1, 2]),
