@@ -31,6 +31,22 @@ import numpy as np
 import pytest
 
 
+def pytest_collection_modifyitems(config, items):
+    """Test tiering (reference: TestBase.scala:23-39 Extended/BuildServer tags
+    selected by TESTS= env, default -extended). The default tier must finish
+    in CI minutes on one core; MMLTPU_TESTS=extended (or =all) runs
+    everything — example scripts, multi-process workers, big-model parity."""
+    tiers = {t.strip() for t in
+             os.environ.get("MMLTPU_TESTS", "").lower().split(",") if t.strip()}
+    if tiers & {"extended", "all"}:
+        return
+    skip = pytest.mark.skip(
+        reason="extended tier (set MMLTPU_TESTS=extended to run)")
+    for item in items:
+        if "extended" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

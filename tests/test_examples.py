@@ -17,6 +17,7 @@ def test_examples_exist():
     assert len(EXAMPLES) >= 5
 
 
+@pytest.mark.extended
 @pytest.mark.parametrize("path", EXAMPLES,
                          ids=[os.path.basename(p) for p in EXAMPLES])
 def test_example_runs(path):

@@ -94,6 +94,7 @@ class TestEngine:
         auc = roc_auc_score(y, engine.predict(ens, x)[:, 1])
         assert auc > 0.95
 
+    @pytest.mark.extended
     def test_distributed_matches_serial(self):
         from mmlspark_tpu.parallel import create_mesh
         x, y = make_classification(n_samples=512, n_features=8, random_state=3)
@@ -111,6 +112,7 @@ class TestEngine:
             np.testing.assert_allclose(ps, pd, atol=1e-3,
                                        err_msg=f"tree_learner={learner}")
 
+    @pytest.mark.extended
     def test_feature_parallel_multiclass_and_padding(self):
         # 10 features over 8 devices -> padded to 16; multiclass vmaps the
         # feature-parallel build over the class axis
@@ -128,6 +130,7 @@ class TestEngine:
         np.testing.assert_allclose(engine.predict(ens_s, x),
                                    engine.predict(ens_f, x), atol=1e-3)
 
+    @pytest.mark.extended
     def test_stage_parallelism_feature(self):
         x, y = make_classification(n_samples=256, n_features=6,
                                    random_state=7)
@@ -253,7 +256,8 @@ class TestGoldenGrid:
     @pytest.mark.parametrize("name,loader,floor", [
         ("iris", "load_iris", 0.90),     # 45-row test split: 3 errors = 0.93
         ("wine", "load_wine", 0.95),
-        ("digits", "load_digits", 0.95),
+        pytest.param("digits", "load_digits", 0.95,
+                     marks=pytest.mark.extended),
     ])
     def test_multiclass_accuracy_goldens(self, name, loader, floor):
         import sklearn.datasets as skd

@@ -72,6 +72,7 @@ def test_histogram_row_padding_masked(rng):
     assert float(np.asarray(hh).sum()) == pytest.approx(N * F)
 
 
+@pytest.mark.extended
 def test_transformer_flash_matches_blockwise(rng):
     """attn_impl='flash' must be numerically interchangeable."""
     import jax
@@ -105,6 +106,7 @@ def test_gbdt_pallas_hist_matches_segment(rng):
     np.testing.assert_allclose(predict(e1, x), predict(e2, x), atol=1e-5)
 
 
+@pytest.mark.extended
 def test_flash_attention_gradients():
     """flash_attention must be differentiable (custom VJP: kernel forward,
     blockwise-recompute backward) and match blockwise gradients."""
@@ -131,6 +133,7 @@ def test_flash_attention_gradients():
                                        rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.extended
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_bf16_forward_and_grad_parity(rng, causal):
     """The on-chip dtype: bf16 operands into every MXU matmul, f32

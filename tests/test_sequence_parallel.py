@@ -97,6 +97,7 @@ class TestTransformerSP:
         return DataFrame({"features": feats,
                           "label": y.astype(np.int64)}), y
 
+    @pytest.mark.extended
     def test_transformer_builds_and_applies(self):
         from mmlspark_tpu.models import build_model
         cfg = {"type": "transformer", "vocab_size": 50, "d_model": 32,
@@ -109,6 +110,7 @@ class TestTransformerSP:
         emb = m.apply(params, toks, output_layer="embed")
         assert emb.shape == (2, 16, 32)
 
+    @pytest.mark.extended
     @pytest.mark.parametrize("mode", ["ring", "ulysses"])
     def test_trainer_sequence_parallel(self, mode):
         from mmlspark_tpu.models import TpuLearner
@@ -123,6 +125,7 @@ class TestTransformerSP:
         out = model.transform(df)
         assert len(out.col("scores")) == len(y)
 
+    @pytest.mark.extended
     def test_sp_matches_single_device_loss(self):
         """Same seed, sp=4 vs sp=1 must produce near-identical trained params."""
         from mmlspark_tpu.models import TpuLearner
