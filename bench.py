@@ -4,6 +4,15 @@ Runs on the real TPU chip (BASELINE.md: the reference publishes no throughput
 numbers — notebook 401 trains a CIFAR ConvNet via CNTK/MPI on GPU VMs; this
 is the TPU-native replacement path). Synthetic CIFAR-shaped data (the metric
 is compute throughput, not accuracy). Prints ONE JSON line.
+
+Uses the SAME fast path TpuLearner.fit() uses: the epoch data is device-
+resident (uint8, the framework's image wire format), the host ships only a
+tiny shuffle plan (rotation + window permutation), and a whole epoch of
+optimizer steps runs per XLA dispatch via lax.scan with donated
+params/opt_state (models/trainer._make_scan_epoch_fn). Round 1 ran one
+jitted step per dispatch (~129k imgs/s); per-step RANDOM GATHER from HBM
+was measured at ~3x a train step on v5e (near-scalar for 1-byte rows), so
+shuffling is rotation+window-permutation instead — see ROOFLINE.md.
 """
 
 import json
@@ -14,50 +23,55 @@ import numpy as np
 
 def main():
     import jax
-    import jax.numpy as jnp
     import optax
     from mmlspark_tpu.models import build_model
-    from mmlspark_tpu.models.trainer import make_loss
+    from mmlspark_tpu.models.trainer import (_make_scan_epoch_fn, make_loss)
+    from mmlspark_tpu.parallel import mesh as meshlib
 
-    # batch swept on-chip: 1024->~110k, 4096->~119k, 8192->~123k imgs/s
-    # (MXU utilization rises with batch; donation measured neutral)
-    batch = 8192
+    batch = 8192          # r1 sweep: 1024->110k, 4096->119k, 8192->123k
+    k_steps = 15          # optimizer steps (windows) per epoch dispatch
+    n_dispatch = 4        # timed dispatches (K*n = 60 steps)
+    n_rows = k_steps * batch  # device-resident epoch (uint8: 360 MiB)
+
     module = build_model({"type": "resnet", "num_classes": 10})
+    mesh = meshlib.create_mesh()
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
-    y = jnp.asarray(rng.integers(0, 10, size=batch).astype(np.int32))
-    params = module.init(jax.random.PRNGKey(0), x[:1])
+    x = rng.integers(0, 256, size=(n_rows, 32, 32, 3)).astype(np.uint8)
+    y = rng.integers(0, 10, size=n_rows).astype(np.int32)
+    params = module.init(jax.random.PRNGKey(0), x[:1].astype(np.float32))
     tx = optax.sgd(0.01, momentum=0.9)
-    opt_state = tx.init(params)
-    loss_fn = make_loss("cross_entropy")
+    params = meshlib.put_replicated(params, mesh)
+    opt_state = jax.jit(tx.init)(params)
+    loss_fn = make_loss("cross_entropy", per_example=True)
+    scan_fn = _make_scan_epoch_fn(module, tx, loss_fn, False, 0.0, mesh,
+                                  batch)
 
-    @jax.jit
-    def step(params, opt_state, xb, yb):
-        def compute(p):
-            return loss_fn(module.apply(p, xb), yb)
-        loss, grads = jax.value_and_grad(compute)(params)
-        updates, opt2 = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt2, loss
+    margin = lambda a: np.concatenate([a, a[:batch]], axis=0)
+    x_dev = meshlib.shard_batch(margin(x), mesh)
+    y_dev = meshlib.shard_batch(margin(y), mesh)
+    w_dev = meshlib.shard_batch(np.ones(n_rows + batch, np.float32), mesh)
+    base = np.arange(k_steps, dtype=np.int32) * batch
+    def plan(seed):
+        r = np.random.default_rng(seed)
+        return ((base[r.permutation(k_steps)] + r.integers(0, n_rows))
+                % n_rows).astype(np.int32)
 
     # compile + warmup. NOTE: on the axon TPU tunnel block_until_ready()
     # returns before the chain actually executes — a host-side value fetch
     # (float()) is the only hard sync, so that is what brackets the timing.
-    params, opt_state, loss = step(params, opt_state, x, y)
-    float(loss)
-    for _ in range(3):
-        params, opt_state, loss = step(params, opt_state, x, y)
+    params, opt_state, loss = scan_fn(params, opt_state, x_dev, y_dev,
+                                      w_dev, plan(1))
     float(loss)
 
-    n_steps = 30
     t0 = time.perf_counter()
-    for _ in range(n_steps):
-        params, opt_state, loss = step(params, opt_state, x, y)
-    float(loss)  # hard sync: forces the whole 30-step chain to complete
+    for d in range(n_dispatch):
+        params, opt_state, loss = scan_fn(params, opt_state, x_dev, y_dev,
+                                          w_dev, plan(2 + d))
+    float(loss)  # hard sync: forces the whole chain to complete
     dt = time.perf_counter() - t0
 
-    # the jitted step is unsharded -> runs on exactly one chip regardless of
-    # how many are attached; per-chip throughput divides by 1, not device count
-    imgs_per_sec = n_steps * batch / dt
+    # the batch shards over every attached chip -> divide for per-chip
+    imgs_per_sec = n_dispatch * k_steps * batch / dt / mesh.size
     print(json.dumps({
         "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),

@@ -76,11 +76,16 @@ def make_loss(name: str, per_example: bool = False):
 
 def _stream_batch(b, cfg: dict, loss_name: str):
     """Normalize one (features, labels) generator item to device-ready
-    numpy: token models take int32 ids, labels follow the loss dtype."""
+    numpy: token models take int32 ids, labels follow the loss dtype.
+    uint8 image batches stay uint8 — the device cast is free and shipping
+    bytes is 4x less host->HBM traffic, the same wire contract fit() and
+    TpuModel._prep_input keep."""
     x, y = b
     x = np.asarray(x)
-    x = x.astype(np.int32 if cfg.get("type") in TOKEN_MODELS
-                 else np.float32)
+    if cfg.get("type") in TOKEN_MODELS:
+        x = x.astype(np.int32)
+    elif x.dtype != np.uint8:
+        x = x.astype(np.float32)
     y = np.asarray(y)
     y = (y.astype(np.int32) if loss_name == "cross_entropy"
          else y.astype(np.float32))
@@ -88,6 +93,34 @@ def _stream_batch(b, cfg: dict, loss_name: str):
         raise ValueError(f"batch features/labels length mismatch: "
                          f"{len(x)} vs {len(y)}")
     return x, y
+
+
+# fit() keeps the epoch data device-resident (one upload, indexed batches)
+# up to this many bytes; past it, the per-step host-feed path takes over.
+# Half of a v5e chip's 16 GiB HBM leaves room for params + activations.
+_DEVICE_DATA_CAP = 8 << 30
+
+# below this size the scan path re-uploads a freshly permuted epoch every
+# epoch (true reshuffle; the transfer is cheaper than one train step);
+# above it, shuffling is upload-permutation + per-epoch rotation/window
+# order (see _make_scan_epoch_fn)
+_EPOCH_RESHUFFLE_CAP = 32 << 20
+
+
+def _wrap_rows(arr: np.ndarray, n_pad: int) -> np.ndarray:
+    """Extend dim 0 to exactly ``n_pad`` rows by wrapping from the start
+    (the pad rows are weighted out by the caller)."""
+    if len(arr) == n_pad:
+        return arr
+    reps = -(-n_pad // max(1, len(arr)))
+    return np.concatenate([arr] * reps, axis=0)[:n_pad]
+
+
+def _scan_batch(bs: int, mesh) -> int:
+    """The scan path's device batch: requested batch rounded up to a
+    data-axis multiple (windows must shard evenly)."""
+    axis = mesh.shape["data"]
+    return -(-bs // axis) * axis
 
 
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
@@ -107,11 +140,12 @@ def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
     return params, jax.jit(tx.init)(params)
 
 
-def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float):
-    """One jitted optimizer step shared by fit() and fitStream()."""
+def _make_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float):
+    """The un-jitted optimizer step: loss -> grads -> update. Shared by the
+    one-step-per-dispatch path (fitStream, multi-host) and the scanned
+    multi-step path (fit's default)."""
 
-    @jax.jit
-    def train_step(params, opt_state, xb, yb, wb):
+    def step_body(params, opt_state, xb, yb, wb):
         # weighted mean so mesh-padding rows (weight 0) carry no gradient
         def compute(p):
             # MoE routing must see the row weights too: padded rows may
@@ -133,7 +167,60 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float):
         updates, opt2 = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt2, loss
 
-    return train_step
+    return step_body
+
+
+def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float):
+    """One jitted optimizer step (fitStream / multi-host feed path)."""
+    return jax.jit(_make_step_body(module, tx, loss_fn, is_moe, moe_aux))
+
+
+def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
+                        mesh, bs: int):
+    """A whole epoch of optimizer steps per XLA dispatch over
+    DEVICE-RESIDENT data.
+
+    The single-step loop pays one host dispatch (~ms) plus a host->HBM batch
+    transfer per step; here the epoch stays in HBM, the host ships only a
+    tiny shuffle plan, and ``lax.scan`` runs every step inside one jitted
+    call with params/opt_state donated, so the steady state is pure device
+    work. Reference contrast: cntk-train re-reads its training file from
+    disk every epoch (CommandBuilders.scala:200-228 scp + CNTK text reader).
+
+    Shuffling is rotation + window permutation, NOT a per-step random
+    gather: a row gather from HBM measures ~3x a whole ResNet-20 train
+    step on v5e (XLA lowers 1-byte-row gathers near-scalar), while
+    contiguous ``dynamic_slice`` windows from a resident array are pure
+    sequential HBM traffic (measured at full step rate). The epoch array
+    carries a bs-row wrap margin (its own first rows repeated) so a
+    rotated window never wraps; the host picks a fresh rotation and window
+    order per epoch — every row exactly once per epoch, batch boundaries
+    shifting every epoch.
+    """
+    from functools import partial
+
+    step_body = _make_step_body(module, tx, loss_fn, is_moe, moe_aux)
+    data_sh = meshlib.batch_sharding(mesh)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def run_epoch(params, opt_state, x_all, y_all, w_all, starts):
+        # starts: (S,) int32 rotated+permuted window offsets into an
+        # epoch array of n_pad + bs rows; w_all weights out padding rows
+        def body(carry, o):
+            p, opt = carry
+            xb = jax.lax.dynamic_slice_in_dim(x_all, o, bs, 0)
+            yb = jax.lax.dynamic_slice_in_dim(y_all, o, bs, 0)
+            wb = jax.lax.dynamic_slice_in_dim(w_all, o, bs, 0)
+            if mesh.size > 1:  # trivial meshes stay off the SPMD path
+                xb = jax.lax.with_sharding_constraint(xb, data_sh)
+                yb = jax.lax.with_sharding_constraint(yb, data_sh)
+            p, opt, loss = step_body(p, opt, xb, yb, wb)
+            return (p, opt), loss
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state), starts)
+        return params, opt_state, losses[-1]
+
+    return run_epoch
 
 
 class TpuLearner(Estimator):
@@ -171,6 +258,11 @@ class TpuLearner(Estimator):
         "raise when the epoch loss goes NaN/inf instead of training on "
         "garbage (failure detection the reference lacks, SURVEY.md §5)",
         default=True)
+    stepsPerDispatch = IntParam(
+        "optimizer steps fused into one XLA dispatch (lax.scan over "
+        "device-resident epoch windows, donated state); 0 = whole epoch. "
+        "Amortizes host dispatch latency — the single-host fit() fast "
+        "path", default=0, min=0)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -283,7 +375,6 @@ class TpuLearner(Estimator):
         is_moe = (cfg.get("type") == "transformer"
                   and cfg.get("num_experts", 0) > 0)
         moe_aux = self.getMoeAuxWeight() if is_moe else 0.0
-        train_step = _make_train_step(module, tx, loss_fn, is_moe, moe_aux)
 
         # multi-host: this process's df is its LOCAL shard of the dataset
         # (the Spark-partition analog); batchSize stays the GLOBAL batch.
@@ -300,6 +391,18 @@ class TpuLearner(Estimator):
         bs_global = max(1, min(self.getBatchSize(), n_global))
         bs = max(1, bs_global // nproc)
         steps = max(1, n_global // (bs * nproc))
+
+        train_step = None
+        scan_fn = None
+        if nproc == 1 and x.nbytes + y.nbytes <= _DEVICE_DATA_CAP:
+            scan_fn = _make_scan_epoch_fn(module, tx, loss_fn, is_moe,
+                                          moe_aux, mesh,
+                                          _scan_batch(bs_global, mesh))
+        else:
+            # multi-host (per-process shards feed put_global_batch) or a
+            # dataset too big for HBM residency: per-step host feed
+            train_step = _make_train_step(module, tx, loss_fn, is_moe,
+                                          moe_aux)
         rng_np = np.random.default_rng(self.getSeed() + jax.process_index())
         start_epoch = 0
         resume = self._latest_checkpoint()
@@ -334,7 +437,7 @@ class TpuLearner(Estimator):
             params, opt_state, last_loss = self._run_epochs(
                 start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
                 nproc=nproc, train_step=train_step, params=params,
-                opt_state=opt_state)
+                opt_state=opt_state, scan_fn=scan_fn)
 
         return self._package_model(cfg, params, last_loss)
 
@@ -446,7 +549,13 @@ class TpuLearner(Estimator):
         return self._package_model(cfg, params, last_loss)
 
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
-                    mesh, nproc, train_step, params, opt_state):
+                    mesh, nproc, train_step, params, opt_state,
+                    scan_fn=None):
+        if scan_fn is not None:
+            return self._run_epochs_scan(start_epoch, x, y, n, bs, steps,
+                                         order_rng=order_rng, mesh=mesh,
+                                         scan_fn=scan_fn, params=params,
+                                         opt_state=opt_state)
         last_loss = None
         for epoch in range(start_epoch, self.getEpochs()):
             order = (order_rng.permutation(n) if self.getShuffle()
@@ -480,5 +589,77 @@ class TpuLearner(Estimator):
                        if last_good is not None
                        else "Set checkpointDir to make divergence resumable."))
             if self.getCheckpointDir() and jax.process_index() == 0:
+                self._save_checkpoint(epoch, params, opt_state)
+        return params, opt_state, last_loss
+
+    def _run_epochs_scan(self, start_epoch, x, y, n, bs, steps, *,
+                         order_rng, mesh, scan_fn, params, opt_state):
+        """Single-host fast path: the epoch data lives in HBM (padded to
+        ``steps*bs_pad`` rows, pad rows weight 0) and every epoch is one
+        XLA dispatch — a random rotation plus a random permutation of the
+        contiguous bs-sized windows, scanned with donated state."""
+        bs_pad = _scan_batch(bs, mesh)
+        # ceil instead of the feed path's floor: window tiling must cover
+        # every row (the feed path re-slices a fresh permutation per step;
+        # here rows outside the tiling would never be seen)
+        steps = max(1, -(-n // bs_pad))
+        n_pad = steps * bs_pad
+        # Windows slice the RESIDENT order, so it must be random: datasets
+        # often arrive sorted by class, and class-pure batches wreck SGD.
+        # Small datasets get a TRUE fresh permutation per epoch (re-upload
+        # is cheaper than one train step at this size); big ones permute
+        # once at upload and vary per epoch by rotation + window order.
+        reshuffle = (self.getShuffle()
+                     and x.nbytes + y.nbytes <= _EPOCH_RESHUFFLE_CAP)
+        if self.getShuffle() and not reshuffle:
+            perm0 = order_rng.permutation(n)
+            x, y = x[perm0], y[perm0]
+        # wrap-pad so windows tile exactly (wrapped rows carry weight 0 —
+        # each real row counts once per epoch), plus a bs-row wrap margin
+        # so rotated windows never wrap
+        w_all = np.zeros(n_pad, dtype=np.float32)
+        w_all[:n] = 1.0
+
+        def margin(a):
+            ap = _wrap_rows(a, n_pad)
+            return np.concatenate([ap, ap[:bs_pad]], axis=0)
+
+        def upload(xa, ya):
+            return (meshlib.shard_batch(margin(xa), mesh),
+                    meshlib.shard_batch(margin(ya), mesh))
+        x_dev, y_dev = (None, None) if reshuffle else upload(x, y)
+        w_dev = meshlib.shard_batch(margin(w_all), mesh)
+        kpd = self.getStepsPerDispatch() or steps
+        base = np.arange(steps, dtype=np.int32) * bs_pad
+        last_loss = None
+        for epoch in range(start_epoch, self.getEpochs()):
+            if reshuffle:
+                perm = order_rng.permutation(n)
+                x_dev, y_dev = upload(x[perm], y[perm])
+                starts = base
+            elif self.getShuffle():
+                starts = ((base[order_rng.permutation(steps)]
+                           + order_rng.integers(0, n_pad)) % n_pad) \
+                    .astype(np.int32)
+            else:
+                starts = base
+            for lo in range(0, steps, kpd):
+                params, opt_state, loss = scan_fn(
+                    params, opt_state, x_dev, y_dev, w_dev,
+                    starts[lo:lo + kpd])
+            last_loss = float(loss)
+            log.info("epoch %d loss %.4f (%d-step dispatches)",
+                     epoch, last_loss, min(kpd, steps))
+            if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
+                last_good = self._latest_checkpoint() \
+                    if self.getCheckpointDir() else None
+                raise RuntimeError(
+                    f"training diverged: epoch {epoch} loss is {last_loss} "
+                    f"(lr={self.getLearningRate()}). "
+                    + (f"Last good checkpoint: epoch {last_good} in "
+                       f"{self.getCheckpointDir()!r}; refit resumes there."
+                       if last_good is not None
+                       else "Set checkpointDir to make divergence resumable."))
+            if self.getCheckpointDir():
                 self._save_checkpoint(epoch, params, opt_state)
         return params, opt_state, last_loss

@@ -85,7 +85,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def shard_batch(arrays, mesh: Mesh, batch_axis: str = "data"):
     """device_put a pytree of host arrays with dim-0 sharded over `data` —
     the one host->HBM hop that replaces the reference's per-element JNI
-    copies (CNTKModel.scala:67-74) and scp legs (CommandBuilders.scala:200-228)."""
+    copies (CNTKModel.scala:67-74) and scp legs (CommandBuilders.scala:200-228).
+
+    On a trivial (single-device) mesh the arrays are placed UNCOMMITTED
+    (plain ``jnp.asarray``): committed / sharding-annotated inputs were
+    measured 17-100x slower on single-chip tunnel backends (the plugin
+    re-ships committed buffers per dispatch, and NamedShardings force jit
+    through the SPMD partitioner) — and a 1-device sharding is
+    semantically a no-op anyway."""
+    if mesh.size == 1:
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(jnp.asarray, arrays)
     sh = batch_sharding(mesh, batch_axis)
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)
 
@@ -134,6 +144,9 @@ def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
     analog — its data stays in Spark partitions and is shipped per-worker
     over scp/JNI, CommandBuilders.scala:200-228)."""
     if jax.process_count() == 1:
+        if mesh.size == 1:  # trivial mesh: stay off the SPMD path
+            import jax.numpy as jnp
+            return jnp.asarray(arr)
         return jax.device_put(arr, batch_sharding(mesh, batch_axis))
     return jax.make_array_from_process_local_data(
         batch_sharding(mesh, batch_axis), np.asarray(arr))
@@ -141,7 +154,11 @@ def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
 
 def put_replicated(tree, mesh: Mesh):
     """Replicate a pytree over the whole (possibly multi-host) mesh. Every
-    process must hold identical values (same-seed init guarantees this)."""
+    process must hold identical values (same-seed init guarantees this).
+    Trivial meshes skip the NamedSharding (see shard_batch)."""
+    if mesh.size == 1:
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(jnp.asarray, tree)
     if jax.process_count() == 1:
         return jax.device_put(tree, replicated(mesh))
     sh = replicated(mesh)

@@ -269,6 +269,7 @@ class TestGoldens:
     (reference: tune-hyperparameters/.../benchmarkMetrics.csv and
     featurize/.../benchmark*.json)."""
 
+    @pytest.mark.extended
     def test_tune_golden(self):
         x, y = load_breast_cancer(return_X_y=True)
         feats = np.empty(len(x), dtype=object)

@@ -48,9 +48,12 @@ class TestMesh:
 class TestModules:
     @pytest.mark.parametrize("cfg", [
         {"type": "mlp", "input_dim": 8, "num_classes": 3},
-        {"type": "convnet", "num_classes": 10},
-        {"type": "resnet", "num_classes": 10},
-        {"type": "bilstm", "vocab_size": 50, "num_classes": 4, "seq_len": 6},
+        pytest.param({"type": "convnet", "num_classes": 10},
+                     marks=pytest.mark.extended),
+        pytest.param({"type": "resnet", "num_classes": 10},
+                     marks=pytest.mark.extended),
+        pytest.param({"type": "bilstm", "vocab_size": 50, "num_classes": 4,
+                      "seq_len": 6}, marks=pytest.mark.extended),
     ])
     def test_build_init_apply(self, cfg):
         m = build_model(cfg)
@@ -140,6 +143,7 @@ class TestTpuModelInference:
         got = np.stack(list(out.col("scores")))
         np.testing.assert_allclose(got, direct, rtol=2e-2, atol=2e-2)
 
+    @pytest.mark.extended
     def test_image_column_input(self):
         rng = np.random.default_rng(0)
         rows = np.empty(6, dtype=object)
@@ -355,6 +359,7 @@ def test_tpu_model_wire_dtypes():
     assert len(out.col("scores")) == 4
 
 
+@pytest.mark.extended
 def test_resnet50_family_and_truncation():
     """Bottleneck ResNet-50 (the reference ImageFeaturizer's headline
     model): builds, forward runs, and headless truncation emits the pooled
@@ -396,6 +401,7 @@ def test_resnet_config_validation():
                       np.zeros((1, 8, 8, 3), np.float32))
 
 
+@pytest.mark.extended
 def test_transformer_remat_parity():
     """remat=True must give identical outputs and gradients to remat=False
     (it only changes what's stored vs recomputed on the backward pass)."""
@@ -502,6 +508,7 @@ def test_export_stablehlo(tmp_path):
     assert "tensor<8x6xf32>" in open(tmp_path / "m8.stablehlo").read()
 
 
+@pytest.mark.extended
 def test_export_stablehlo_honors_input_shape(tmp_path):
     import jax
     import jax.numpy as jnp
@@ -514,6 +521,37 @@ def test_export_stablehlo_honors_input_shape(tmp_path):
              .setInputShape((3, 224, 224)))
     out = model.exportStableHLO(str(tmp_path / "r50.stablehlo"), batch=4)
     assert "tensor<4x224x224x3xf32>" in open(out).read()
+
+
+@pytest.mark.extended
+def test_export_stablehlo_matches_serving_dtypes(tmp_path):
+    """The exported artifact's input contract matches what transform()
+    actually serves: uint8 for image models fed image columns, bfloat16
+    under transferDtype, with an in_dtype override."""
+    import jax
+    import jax.numpy as jnp
+    from mmlspark_tpu.models import TpuModel, build_model
+
+    cfg = {"type": "resnet", "num_classes": 10, "blocks_per_stage": 1,
+           "widths": [4, 4, 4]}
+    m = build_model(cfg)
+    p = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    model = TpuModel().setModelConfig(cfg).setModelParams(p)
+    # image model, no inputShape -> uint8 wire (what _prep_input ships)
+    out = model.exportStableHLO(str(tmp_path / "img.stablehlo"), batch=4)
+    assert "tensor<4x32x32x3xui8>" in open(out).read()
+    # explicit override wins
+    out = model.exportStableHLO(str(tmp_path / "f32.stablehlo"), batch=4,
+                                in_dtype=np.float32)
+    assert "tensor<4x32x32x3xf32>" in open(out).read()
+    # flat-vector input under transferDtype=bfloat16 -> bf16 wire
+    cfg2 = {"type": "mlp", "input_dim": 6, "num_classes": 2, "hidden": [4]}
+    m2 = build_model(cfg2)
+    p2 = m2.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))
+    model2 = (TpuModel().setModelConfig(cfg2).setModelParams(p2)
+              .setTransferDtype("bfloat16"))
+    out = model2.exportStableHLO(str(tmp_path / "bf16.stablehlo"), batch=8)
+    assert "tensor<8x6xbf16>" in open(out).read()
 
 
 class TestFitStream:
@@ -582,7 +620,35 @@ class TestFitStream:
         with pytest.raises(ValueError, match="single-host"):
             learner.fitStream(self._stream_fn())
 
+    def test_stream_batch_keeps_uint8_wire(self):
+        """uint8 image batches must not be widened to f32 on the host —
+        fitStream ships bytes like fit()/_prep_input do (4x less traffic)."""
+        from mmlspark_tpu.models.trainer import _stream_batch
+        x = np.zeros((4, 8, 8, 3), np.uint8)
+        y = np.zeros(4, np.int64)
+        xs, ys = _stream_batch((x, y), {"type": "convnet"}, "cross_entropy")
+        assert xs.dtype == np.uint8
+        assert ys.dtype == np.int32
+        xs, _ = _stream_batch((x.astype(np.float64), y),
+                              {"type": "convnet"}, "cross_entropy")
+        assert xs.dtype == np.float32  # non-byte inputs still normalize
+        # and a uint8 stream actually trains end-to-end
+        def byte_stream():
+            r = np.random.default_rng(0)
+            for _ in range(4):
+                yb = r.integers(0, 2, 16)
+                xb = (yb[:, None, None, None] * 200).astype(np.uint8) + \
+                    r.integers(0, 20, (16, 8, 8, 3)).astype(np.uint8)
+                yield xb, yb
+        learner = TpuLearner().set(
+            modelConfig={"type": "convnet", "channels": [4], "dense": 8,
+                         "num_classes": 2, "height": 8, "width": 8},
+            epochs=2, learningRate=0.01)
+        model = learner.fitStream(byte_stream)
+        assert np.isfinite(model._final_loss)
 
+
+@pytest.mark.extended
 def test_fitstream_from_image_loader(tmp_path):
     """End-to-end out-of-core path: files -> io.loader.image_batches ->
     fitStream, never materializing the dataset."""

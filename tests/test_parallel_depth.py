@@ -126,6 +126,7 @@ def test_moe_capacity_drops_only_overflow():
     assert not np.any(np.all(np.asarray(y) == 0.0, axis=-1))
 
 
+@pytest.mark.extended
 def test_moe_transformer_build_and_grad():
     from mmlspark_tpu.models import build_model
     cfg = {"type": "transformer", "vocab_size": 50, "d_model": 16,
@@ -150,6 +151,7 @@ def test_moe_transformer_build_and_grad():
     assert any(float(jnp.abs(l).sum()) > 0 for l in g_exp)
 
 
+@pytest.mark.extended
 def test_learner_expert_parallel_end_to_end():
     """Full EP training step over a dp x ep mesh: the dryrun path."""
     from mmlspark_tpu import DataFrame
@@ -201,6 +203,7 @@ def test_distributed_axes_layout():
     assert mesh.devices.size == 8
 
 
+@pytest.mark.extended
 def test_moe_row_mask_ignores_padding():
     """Mesh-padding rows (weight 0) must not claim expert capacity nor move
     the balancing aux loss."""
@@ -232,6 +235,7 @@ def test_moe_row_mask_ignores_padding():
     assert np.isfinite(np.asarray(y_real_only)).all()
 
 
+@pytest.mark.extended
 def test_distributed_two_process_rendezvous(tmp_path):
     """REAL multi-process rendezvous: two OS processes join via the JAX
     coordination service (the MPI-hostfile / LightGBM-machine-list
@@ -286,6 +290,7 @@ def test_distributed_two_process_rendezvous(tmp_path):
         assert "WORKER_OK" in out
 
 
+@pytest.mark.extended
 def test_moe_inference_padding_invariant():
     """TpuModel scores for the same rows must not change with mesh padding
     (padded duplicates may not claim expert capacity at inference)."""
@@ -332,6 +337,7 @@ def test_mlp_config_with_stray_num_experts():
     assert len(model.transform(df).col("scores")) == 8
 
 
+@pytest.mark.extended
 def test_trainer_two_process_data_parallel(tmp_path):
     """REAL multi-host DP training: two OS processes, each feeding its LOCAL
     data shard; gradients all-reduce across processes via the coordination
@@ -400,6 +406,7 @@ def test_trainer_two_process_data_parallel(tmp_path):
     assert outs[0].split()[-1] == outs[1].split()[-1], outs
 
 
+@pytest.mark.extended
 def test_rendezvous_times_out_on_missing_worker(tmp_path):
     """Failure detection at rendezvous (the reference's only analog is
     LightGBM's 120 s listen timeout): a fleet missing one worker must fail
@@ -446,6 +453,7 @@ def test_rendezvous_times_out_on_missing_worker(tmp_path):
     assert elapsed < 60, f"timeout not honored: {elapsed:.0f}s"
 
 
+@pytest.mark.extended
 def test_worker_crash_then_checkpoint_resume(tmp_path):
     """Elasticity story the reference lacks entirely (SURVEY.md §5: any
     worker failure fails the job, no resume): run 1 loses a worker mid-
