@@ -26,7 +26,8 @@ from ..ops.image_stages import UnrollImage
 MAX_ONE_HOT = 32  # low-cardinality threshold for treating strings as categorical
 
 
-def _plan_column(df: DataFrame, name: str, one_hot: bool, num_features: int):
+def _plan_column(df: DataFrame, name: str, one_hot: bool, num_features: int,
+                 allow_unknown: bool = False):
     col = df.col(name)
     levels = CategoricalUtilities.getLevels(df, name)
     if levels is not None:
@@ -41,11 +42,18 @@ def _plan_column(df: DataFrame, name: str, one_hot: bool, num_features: int):
         if isinstance(first, str):
             uniq = {v for v in col.tolist()}
             if len(uniq) <= MAX_ONE_HOT:
+                # "inferred" marks levels discovered from the data (vs
+                # schema metadata): a sharded fit may revise the decision
+                # once every shard's levels are pooled
                 return {"kind": "categorical" if one_hot else "index",
-                        "levels": sorted(uniq)}
+                        "levels": sorted(uniq), "inferred": True}
             return {"kind": "text", "num_features": num_features}
         if np.ndim(first) >= 1 or hasattr(first, "toarray"):
             return {"kind": "vector"}
+    if allow_unknown and col.dtype.kind == "O" and not len(col):
+        # empty local shard of a sharded frame: another process's plan
+        # decides at the merge
+        return {"kind": "unknown"}
     raise ValueError(f"cannot featurize column {name!r} (dtype {col.dtype})")
 
 
@@ -106,12 +114,70 @@ class Featurize(Estimator, HasOutputCol):
                                 default=1 << 12, min=1)
 
     def fit(self, df: DataFrame) -> FeaturizeModel:
+        from ..parallel import dataplane
+        sharded = dataplane.is_sharded(df)
         cols = list(self.getInputCols()) or \
             [c for c in df.columns if c not in set(self.getExcludeCols())]
         plans = []
         for name in cols:
             plans.append((name, _plan_column(
                 df, name, self.getOneHotEncodeCategoricals(),
-                self.getNumberOfFeatures())))
+                self.getNumberOfFeatures(), allow_unknown=sharded)))
+        if sharded:
+            plans = _merge_sharded_plans(
+                plans, self.getOneHotEncodeCategoricals(),
+                self.getNumberOfFeatures())
         return (FeaturizeModel().setOutputCol(self.getOutputCol())
                 .setInputPlans(plans))
+
+
+def _merge_sharded_plans(local_plans, one_hot: bool, num_features: int):
+    """Combine per-process featurization plans into one fleet-wide plan —
+    the fitted statistics a single-frame fit would have computed over the
+    whole dataset (reference: Spark aggregates these cluster-wide inside
+    StringIndexer etc., AssembleFeatures.scala:442).
+
+    Merge rules per column: categorical levels union across shards; an
+    INFERRED string categorical whose pooled cardinality exceeds
+    MAX_ONE_HOT degrades to hashed text (the decision a global fit makes);
+    any shard seeing text makes the column text; 'unknown' (empty local
+    shard) defers to whichever shard had data."""
+    from ..parallel import dataplane
+    all_plans = dataplane.allgather_pyobj(local_plans)
+    merged = []
+    for i, (name, _) in enumerate(local_plans):
+        variants = [p[i][1] for p in all_plans]
+        kinds = {v["kind"] for v in variants} - {"unknown"}
+        if not kinds:
+            raise ValueError(f"column {name!r} is empty on every shard")
+        if kinds <= {"categorical", "index"}:
+            inferred = any(v.get("inferred") for v in variants)
+            if inferred:
+                levels = sorted(set().union(*[set(v.get("levels", ()))
+                                              for v in variants
+                                              if v["kind"] != "unknown"]))
+            else:
+                # schema-provided levels: every shard read the same column
+                # metadata — keep ITS order (re-sorting would scramble
+                # category indices vs a single-frame fit)
+                levels = list(next(v for v in variants
+                                   if v["kind"] != "unknown")["levels"])
+            if inferred and len(levels) > MAX_ONE_HOT:
+                merged.append((name, {"kind": "text",
+                                      "num_features": num_features}))
+            else:
+                plan = {"kind": "categorical" if one_hot else "index",
+                        "levels": levels}
+                if inferred:
+                    plan["inferred"] = True
+                merged.append((name, plan))
+        elif "text" in kinds:
+            merged.append((name, {"kind": "text",
+                                  "num_features": num_features}))
+        elif len(kinds) == 1:
+            merged.append((name, dict(next(v for v in variants
+                                           if v["kind"] != "unknown"))))
+        else:
+            raise ValueError(f"column {name!r} plans disagree across "
+                             f"shards: {sorted(kinds)}")
+    return merged

@@ -43,6 +43,14 @@ class ValueIndexerModel(Model, HasInputCol, HasOutputCol):
 class ValueIndexer(Estimator, HasInputCol, HasOutputCol):
     def fit(self, df: DataFrame) -> ValueIndexerModel:
         levels = _sorted_levels(df.col(self.getInputCol()))
+        from ..parallel import dataplane
+        if dataplane.is_sharded(df):
+            # fleet-wide dictionary: union of every shard's local levels
+            merged = set().union(*dataplane.allgather_pyobj(set(levels)))
+            try:
+                levels = sorted(merged)
+            except TypeError:
+                levels = sorted(merged, key=str)
         return (ValueIndexerModel()
                 .setInputCol(self.getInputCol())
                 .setOutputCol(self.getOutputCol())

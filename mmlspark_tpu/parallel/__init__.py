@@ -1,4 +1,5 @@
-from . import distributed, mesh, pipeline_parallel, sequence
+from . import dataplane, distributed, mesh, pipeline_parallel, sequence
+from .dataplane import ShardedDataFrame, shard_paths
 from .mesh import (batch_sharding, create_mesh, make_mesh,
                    pad_batch_to_devices, replicated, shard_batch,
                    shard_params_tp)
@@ -6,6 +7,7 @@ from .pipeline_parallel import (pipeline_apply, shard_pipeline_params,
                                 stack_stage_params)
 
 __all__ = ["mesh", "sequence", "distributed", "pipeline_parallel",
+           "dataplane", "ShardedDataFrame", "shard_paths",
            "create_mesh", "make_mesh", "batch_sharding", "replicated",
            "shard_batch", "pad_batch_to_devices", "shard_params_tp",
            "pipeline_apply", "stack_stage_params", "shard_pipeline_params"]

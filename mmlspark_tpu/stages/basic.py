@@ -97,6 +97,16 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
     def fit(self, df: DataFrame) -> "ClassBalancerModel":
         col = df.col(self.getInputCol())
         values, counts = np.unique(col, return_counts=True)
+        from ..parallel import dataplane
+        if dataplane.is_sharded(df):
+            # fleet-wide class frequencies: merge each shard's histogram
+            totals: dict = {}
+            for part in dataplane.allgather_pyobj(
+                    dict(zip(values.tolist(), counts.tolist()))):
+                for v, n in part.items():
+                    totals[v] = totals.get(v, 0) + n
+            values = np.array(sorted(totals, key=str))
+            counts = np.array([totals[v] for v in values.tolist()])
         weights = counts.max() / counts.astype(np.float64)
         return (ClassBalancerModel()
                 .setInputCol(self.getInputCol())
