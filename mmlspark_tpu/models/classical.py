@@ -254,9 +254,31 @@ class MultilayerPerceptronClassifier(Estimator, HasFeaturesCol, HasLabelCol):
     seed = IntParam("seed", default=0)
 
     def fit(self, df: DataFrame):
+        from ..core.utils import to_float32_matrix
         from .trainer import TpuLearner
         y = np.asarray(df.col(self.getLabelCol())).astype(np.int64)
         k = int(y.max()) + 1
+        # standardize features (fitted mean/std applied again at transform):
+        # MLP convergence on raw-scale columns is luck-of-the-batch-order;
+        # tree learners are scale-free so only this wrapper needs it
+        mat = to_float32_matrix(df.col(self.getFeaturesCol()))
+        from ..parallel import dataplane
+        if dataplane.is_sharded(df):
+            # fleet-wide moments: each shard must standardize identically
+            # (the DP gradient all-reduce mixes everyone's batches)
+            tot = dataplane.allreduce_sum(np.stack([
+                np.full(mat.shape[1], float(len(mat))),
+                mat.sum(axis=0, dtype=np.float64),
+                (mat.astype(np.float64) ** 2).sum(axis=0)]))
+            cnt = np.maximum(tot[0], 1.0)
+            mu = tot[1] / cnt
+            sd = np.sqrt(np.maximum(tot[2] / cnt - mu ** 2, 0.0))
+        else:
+            mu = mat.mean(axis=0)
+            sd = mat.std(axis=0)
+        sd[sd < 1e-7] = 1.0
+        sdf = df.withColumn(self.getFeaturesCol(),
+                            _vec_col(((mat - mu) / sd).astype(np.float32)))
         learner = (TpuLearner()
                    .setFeaturesCol(self.getFeaturesCol())
                    .setLabelCol(self.getLabelCol())
@@ -268,18 +290,25 @@ class MultilayerPerceptronClassifier(Estimator, HasFeaturesCol, HasLabelCol):
                    .setLearningRate(self.getStepSize())
                    .setOptimizer("adam")
                    .setSeed(self.getSeed()))
-        inner = learner.fit(df)
+        inner = learner.fit(sdf)
         return (MLPClassificationModel()
                 .setFeaturesCol(self.getFeaturesCol())
-                .setInner(inner))
+                .setInner(inner)
+                .setFeatureMean(mu.astype(np.float64))
+                .setFeatureScale(sd.astype(np.float64)))
 
 
 class MLPClassificationModel(_ProbClassifierModel):
     inner = ComplexParam("fitted TpuModel", default=None)
+    featureMean = ComplexParam("standardization mean", default=None)
+    featureScale = ComplexParam("standardization scale", default=None)
 
     def _probs(self, x):
         import scipy.special
         tm = self.getInner()
+        if self.getFeatureMean() is not None:
+            x = (x - np.asarray(self.getFeatureMean())) \
+                / np.asarray(self.getFeatureScale())
         feats = _vec_col(x.astype(np.float32))
         tmp = DataFrame({"features": feats})
         logits = np.stack(list(
