@@ -220,8 +220,10 @@ class RandomForestClassifier(LightGBMClassifier):
     baggingFreq = IntParam("resample every tree", default=1)
     featureFraction = FloatParam("features per tree", default=0.7)
 
-    def _engine_params(self, objective, num_class=1, alpha=0.9):
-        return super()._engine_params(objective, num_class, alpha) \
+    def _engine_params(self, objective, num_class=1, alpha=0.9,
+                       categorical=()):
+        return super()._engine_params(objective, num_class, alpha,
+                                      categorical) \
             ._replace(boosting_type="rf")
 
 
@@ -231,8 +233,10 @@ class RandomForestRegressor(LightGBMRegressor):
     baggingFreq = IntParam("resample every tree", default=1)
     featureFraction = FloatParam("features per tree", default=0.7)
 
-    def _engine_params(self, objective, num_class=1, alpha=0.9):
-        return super()._engine_params(objective, num_class, alpha) \
+    def _engine_params(self, objective, num_class=1, alpha=0.9,
+                       categorical=()):
+        return super()._engine_params(objective, num_class, alpha,
+                                      categorical) \
             ._replace(boosting_type="rf")
 
 

@@ -58,6 +58,15 @@ class GBDTParams(NamedTuple):
     #   auto    — shard rows and let XLA's auto-SPMD place the collectives
     #   serial  — single-device program even if a mesh is passed
     tree_learner: str = "data"      # data | feature | auto | serial
+    # LEAF-WISE growth (LightGBM's native policy, numLeaves default 31 at
+    # LightGBMParams.scala:34): num_leaves > 0 grows best-first via
+    # leafwise.grow_tree_leafwise; 0 keeps the level-wise engine above.
+    # max_depth still caps leaf depth when > 0 in leaf-wise mode.
+    num_leaves: int = 0
+    # feature ids treated as categorical (bins = category ids; splits are
+    # category SETS found by sorted-ratio prefix scan). Leaf-wise only.
+    categorical_feature: tuple = ()
+    cat_smooth: float = 10.0        # LightGBM cat_smooth default
 
 
 class TreeEnsemble(NamedTuple):
@@ -98,8 +107,14 @@ def compute_bin_edges(x: np.ndarray, max_bin: int,
     return np.ascontiguousarray(edges.astype(np.float32))
 
 
-def bin_data(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
+def bin_data(x: np.ndarray, edges: np.ndarray,
+             cat_features: Optional[np.ndarray] = None,
+             max_bin: int = 256) -> np.ndarray:
     """(n, d) floats -> (n, d) uint8 bin ids in [0, max_bin). NaN -> bin 0.
+
+    Categorical columns (``cat_features`` (d,) bool) bin by IDENTITY —
+    the category code IS the bin (clipped to the bin range), so category-set
+    splits see the original categories, not quantile buckets.
 
     uint8 is the wire format (ids top out at max_bin-1 <= 255; fit_gbdt
     enforces max_bin <= 256): the bin matrix is the one large host->HBM
@@ -109,7 +124,12 @@ def bin_data(x: np.ndarray, edges: np.ndarray) -> np.ndarray:
     out = np.empty((n, d), dtype=np.uint8)
     xf = x.astype(np.float32)
     for j in range(d):
-        out[:, j] = np.searchsorted(edges[j], xf[:, j], side="left")
+        if cat_features is not None and cat_features[j]:
+            with np.errstate(invalid="ignore"):
+                out[:, j] = np.clip(np.nan_to_num(xf[:, j]), 0,
+                                    max_bin - 1).astype(np.uint8)
+        else:
+            out[:, j] = np.searchsorted(edges[j], xf[:, j], side="left")
     out[np.isnan(xf)] = 0
     return out
 
@@ -441,6 +461,31 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     tree_learner = p.tree_learner if mesh is not None else "serial"
     if tree_learner == "serial":
         mesh = None
+    leafwise = p.num_leaves > 0
+    if leafwise and not 2 <= p.num_leaves <= 4096:
+        raise ValueError(f"num_leaves must be in [2, 4096], got {p.num_leaves}")
+    if leafwise and tree_learner == "feature":
+        raise ValueError(
+            "leaf-wise growth supports tree_learner=serial|data|auto "
+            "(feature-parallel candidates are level-wise only; set "
+            "num_leaves=0 or tree_learner='data')")
+    if p.categorical_feature and not leafwise:
+        raise ValueError("categorical_feature requires leaf-wise growth "
+                         "(set num_leaves > 0)")
+    cat_arr = np.zeros(d, dtype=bool)
+    for j in p.categorical_feature:
+        if not 0 <= j < d:
+            raise ValueError(f"categorical_feature index {j} out of range "
+                             f"for {d} features")
+        cat_arr[j] = True
+        with np.errstate(invalid="ignore"):
+            top = float(np.nanmax(x[:, j])) if len(x) else 0.0
+        if top >= p.max_bin:
+            from ...core.utils import get_logger
+            get_logger("gbdt").warning(
+                "categorical feature %d has codes up to %d but max_bin=%d; "
+                "codes >= max_bin alias into one bin — raise maxBin or "
+                "re-index the column", j, int(top), p.max_bin)
     K = p.num_class if p.objective == "multiclass" else 1
     is_rf = p.boosting_type == "rf"
     if is_rf and not ((p.bagging_fraction < 1.0 and p.bagging_freq > 0)
@@ -459,7 +504,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                      and mesh is None else "segment")
     real = slice(None) if sample_weight is None else sample_weight > 0
     edges = compute_bin_edges(x[real], p.max_bin)
-    bins = bin_data(x, edges)
+    bins = bin_data(x, edges, cat_arr if cat_arr.any() else None, p.max_bin)
     d_pad = d
     if tree_learner == "feature":
         # pad the feature axis to a device multiple; padded columns carry
@@ -481,7 +526,19 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         yj = meshlib.shard_batch(yj, mesh)
 
     builder = None
-    if mesh is not None and tree_learner in ("data", "feature"):
+    cat_j = jnp.asarray(cat_arr.astype(np.float32))
+    if leafwise:
+        from . import leafwise as lw
+        # 0 or -1 = uncapped (accept LightGBM's -1 convention)
+        lw_depth = max(0, p.max_depth)
+        if mesh is not None:   # data/auto: rows sharded, psum per round
+            builder = lw.make_sharded_builder_lw(
+                mesh, num_leaves=p.num_leaves, n_bins=p.max_bin,
+                lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                min_child_weight=p.min_child_weight,
+                min_split_gain=p.min_split_gain, cat_smooth=p.cat_smooth,
+                max_depth=lw_depth, hist_impl=hist_impl)
+    elif mesh is not None and tree_learner in ("data", "feature"):
         builder = make_sharded_builder(
             mesh, tree_learner, depth=p.max_depth, n_bins=p.max_bin,
             d_pad=d_pad, lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
@@ -512,7 +569,8 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                          else sample_weight * holdout)
     if eval_set is not None:
         bins_val = jnp.asarray(bin_data(
-            np.asarray(eval_set[0], dtype=np.float32), edges))
+            np.asarray(eval_set[0], dtype=np.float32), edges,
+            cat_arr if cat_arr.any() else None, p.max_bin))
         y_val = jnp.asarray(np.asarray(eval_set[1], dtype=np.float32))
         raw_val = jnp.broadcast_to(jnp.asarray(base)[None, :],
                                    (bins_val.shape[0], K)).astype(jnp.float32)
@@ -555,31 +613,55 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             feat_mask = np.ones(d, dtype=np.float32)
 
         fm = jnp.asarray(np.pad(feat_mask, (0, d_pad - d)))
-        if builder is not None:
-            f, t, lv = builder(bins_j, g, h, rm, fm)
-        else:
-            f, t, lv = _build_tree_multi(
-                bins_j, g, h, rm, fm,
-                depth=p.max_depth, n_bins=p.max_bin,
-                lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
-                min_child_weight=p.min_child_weight,
-                min_split_gain=p.min_split_gain, hist_impl=hist_impl)
-        # rf leaves stay unscaled here; the 1/T average is applied at the end
-        # over the ACTUAL forest size
-        lv = lv * (1.0 if is_rf else p.learning_rate)
-        feats.append(f)
-        thrs.append(t)
-        leaves.append(lv)
-        if not is_rf:
-            contrib = jnp.stack(
-                [_predict_tree(bins_j, f[k], t[k], lv[k], depth=p.max_depth)
+        if leafwise:
+            from . import leafwise as lw
+            if builder is not None:
+                tree = builder(bins_j, g, h, rm, fm, cat_j)
+            else:
+                tree = lw.build_tree_leafwise_multi(
+                    bins_j, g, h, rm, fm, cat_j,
+                    num_leaves=p.num_leaves, n_bins=p.max_bin,
+                    lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                    min_child_weight=p.min_child_weight,
+                    min_split_gain=p.min_split_gain,
+                    cat_smooth=p.cat_smooth, max_depth=lw_depth,
+                    hist_impl=hist_impl)
+            S, f, t, W, IC, lv, node_tr = tree
+            lv = lv * (1.0 if is_rf else p.learning_rate)
+            feats.append((S, f, t, W, IC))
+            leaves.append(lv)
+            # training rows' leaves are known from the grow: the raw update
+            # is a tiny-table gather, no split-sequence replay
+            step = lambda b: jnp.stack(
+                [lw.predict_tree_lw(b, S[k], f[k], t[k], W[k], IC[k], lv[k])
                  for k in range(K)], axis=1)
-            raw = raw + contrib
+            train_step_fn = lambda: jnp.stack(
+                [lv[k][node_tr[k]] for k in range(K)], axis=1)
+        else:
+            if builder is not None:
+                f, t, lv = builder(bins_j, g, h, rm, fm)
+            else:
+                f, t, lv = _build_tree_multi(
+                    bins_j, g, h, rm, fm,
+                    depth=p.max_depth, n_bins=p.max_bin,
+                    lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                    min_child_weight=p.min_child_weight,
+                    min_split_gain=p.min_split_gain, hist_impl=hist_impl)
+            # rf leaves stay unscaled here; the 1/T average is applied at
+            # the end over the ACTUAL forest size
+            lv = lv * (1.0 if is_rf else p.learning_rate)
+            feats.append(f)
+            thrs.append(t)
+            leaves.append(lv)
+            step = lambda b: jnp.stack(
+                [_predict_tree(b, f[k], t[k], lv[k], depth=p.max_depth)
+                 for k in range(K)], axis=1)
+            train_step_fn = lambda: step(bins_j)
+        if not is_rf:
+            raw = raw + train_step_fn()
 
         if p.early_stopping_round > 0:
-            raw_val = raw_val + jnp.stack(
-                [_predict_tree(bins_val, f[k], t[k], lv[k], depth=p.max_depth)
-                 for k in range(K)], axis=1)
+            raw_val = raw_val + step(bins_val)
             cur = float(_loss(raw_val, y_val, p.objective, p.alpha))
             if cur < best_loss - 1e-9:
                 best_loss, since_best, best_iter = cur, 0, it + 1
@@ -593,15 +675,33 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                                leaves[:best_iter])
     if is_rf:
         leaves = [lv / len(leaves) for lv in leaves]
+    if leafwise:
+        from .leafwise import LeafwiseEnsemble
+        return LeafwiseEnsemble(
+            split_leaf=jnp.stack([s for s, *_ in feats]),
+            feature=jnp.stack([f for _, f, *_ in feats]),
+            threshold=jnp.stack([t for _, _, t, *_ in feats]),
+            cat_bitset=jnp.stack([w for _, _, _, w, _ in feats]),
+            is_cat=jnp.stack([ic for *_, ic in feats]),
+            leaf=jnp.stack(leaves), bin_edges=edges,
+            cat_features=cat_arr, base=base, objective=p.objective)
     return TreeEnsemble(
         feature=jnp.stack(feats), threshold=jnp.stack(thrs),
         leaf=jnp.stack(leaves), bin_edges=edges, base=base,
         objective=p.objective)
 
 
-def predict_raw(ens: TreeEnsemble, x: np.ndarray,
+def predict_raw(ens, x: np.ndarray,
                 num_iteration: Optional[int] = None) -> np.ndarray:
-    """Raw ensemble scores (n, K)."""
+    """Raw ensemble scores (n, K). Accepts level-wise TreeEnsemble or
+    leafwise.LeafwiseEnsemble."""
+    from .leafwise import LeafwiseEnsemble, predict_raw_lw
+    if isinstance(ens, LeafwiseEnsemble):
+        bins = jnp.asarray(bin_data(
+            x, ens.bin_edges,
+            ens.cat_features if ens.cat_features.any() else None,
+            ens.bin_edges.shape[1] + 1))
+        return predict_raw_lw(ens, bins, num_iteration)
     bins = jnp.asarray(bin_data(x, ens.bin_edges))
     T, K, _ = ens.feature.shape
     depth = int(np.log2(ens.leaf.shape[2]))

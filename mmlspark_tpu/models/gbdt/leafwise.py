@@ -1,0 +1,337 @@
+"""Leaf-wise (best-first) tree growth with categorical splits.
+
+Native LightGBM grows trees best-first: repeatedly split the leaf with the
+highest gain until ``num_leaves`` leaves exist (the reference exposes
+``numLeaves``, default 31 — lightgbm/.../LightGBMParams.scala:34; the boost
+loop that drives it is LGBM_BoosterUpdateOneIter, TrainUtils.scala:63-77).
+That is inherently data-dependent control flow, which XLA can't trace — so
+the TPU formulation fixes the shape of the work instead of the shape of the
+tree:
+
+  * exactly ``num_leaves - 1`` split rounds run under one ``lax.scan``;
+  * each round argmaxes a per-leaf candidate cache (gain, feature,
+    threshold/category-set), splits that leaf, and rebuilds candidates for
+    ONLY the two fresh leaves with a single full-data histogram pass
+    (rows outside the split leaf land in a discard slot — the static-shape
+    equivalent of LightGBM walking just the leaf's row index list);
+  * a leaf whose best gain can't clear ``min_split_gain`` is retired
+    (its cache entry pinned to -inf), so exhausted trees finish early as
+    no-op rounds — same result as LightGBM's early exit, fixed shapes.
+
+Trees are recorded as the SPLIT SEQUENCE itself: round r splits leaf
+``split_leaf[r]`` and the right child becomes leaf id r+1. Prediction
+replays the sequence with a scan — num_leaves-1 masked updates, fully
+vectorized over rows.
+
+Categorical features split as category SETS (LightGBM's many-vs-many):
+per (leaf, feature) the category bins sort by grad/hess ratio and a prefix
+scan over the sorted order finds the optimal partition (the classic
+exact-for-convex-loss trick LightGBM uses); the winning set is stored as a
+256-bit bitmask per split. Categorical feature ids come from the column
+metadata contract (core/schema.py CategoricalUtilities -> FastVectorAssembler
+slot ranges), the reference's MML categorical-metadata path.
+
+Data-parallel mode: the same grow program runs inside shard_map with rows
+sharded; per-round histograms and final leaf sums psum over ICI — the
+socket all-reduce ring of TrainUtils.scala:141 as XLA collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: 256 bits of category membership per split (max_bin <= 256)
+CAT_WORDS = 8
+
+
+class LeafwiseEnsemble(NamedTuple):
+    """Fitted leaf-wise booster. T trees x K classes; L = num_leaves.
+
+    split_leaf: (T,K,L-1) int32 — leaf id split at round r (-1 = no-op)
+    feature:    (T,K,L-1) int32 — split feature
+    threshold:  (T,K,L-1) int32 — numeric split bin (right if bin > thr)
+    cat_bitset: (T,K,L-1,CAT_WORDS) uint32 — category set routed right
+    is_cat:     (T,K,L-1) bool
+    leaf:       (T,K,L) f32 — leaf values (learning rate applied)
+    """
+    split_leaf: jnp.ndarray
+    feature: jnp.ndarray
+    threshold: jnp.ndarray
+    cat_bitset: jnp.ndarray
+    is_cat: jnp.ndarray
+    leaf: jnp.ndarray
+    bin_edges: np.ndarray
+    cat_features: np.ndarray      # (d,) bool
+    base: np.ndarray
+    objective: str
+
+
+def _soft(gsum, l1):
+    return jnp.sign(gsum) * jnp.maximum(jnp.abs(gsum) - l1, 0.0)
+
+
+def _leaf_score(gsum, hsum, l2, l1):
+    gs = _soft(gsum, l1)
+    return gs * gs / (hsum + l2)
+
+
+def _candidates_2(hg, hh, feat_mask, cat_feats, n_bins, l2, l1,
+                  min_child_weight, cat_smooth):
+    """Best split per node from (2, d, B) histograms, numeric AND
+    categorical forms evaluated per feature.
+
+    Returns per node: gain (2,), feat (2,), thr (2,) (numeric bin or
+    sorted-prefix length for categorical), bitset (2, CAT_WORDS) uint32.
+    """
+    n_nodes, d, B = hg.shape
+
+    gt = hg.sum(axis=2, keepdims=True)
+    ht = hh.sum(axis=2, keepdims=True)
+    parent = _leaf_score(gt, ht, l2, l1)
+
+    # ---- numeric: prefix over the natural (value-ordered) bin axis ----
+    gl = jnp.cumsum(hg, axis=2)
+    hl = jnp.cumsum(hh, axis=2)
+    gain_n = (_leaf_score(gl, hl, l2, l1)
+              + _leaf_score(gt - gl, ht - hl, l2, l1) - parent)
+    valid_n = (hl >= min_child_weight) & (ht - hl >= min_child_weight)
+    gain_n = jnp.where(valid_n, gain_n, -jnp.inf)
+    gain_n = gain_n.at[:, :, -1].set(-jnp.inf)  # all-left split is no split
+    bin_n = jnp.argmax(gain_n, axis=2)
+    best_n = jnp.take_along_axis(gain_n, bin_n[:, :, None], axis=2)[:, :, 0]
+
+    # ---- categorical: prefix over bins sorted by grad/hess ratio ----
+    ratio = hg / (hh + cat_smooth)
+    order = jnp.argsort(ratio, axis=2)              # ascending
+    sg = jnp.take_along_axis(hg, order, axis=2)
+    sh = jnp.take_along_axis(hh, order, axis=2)
+    cgl = jnp.cumsum(sg, axis=2)
+    chl = jnp.cumsum(sh, axis=2)
+    gain_c = (_leaf_score(cgl, chl, l2, l1)
+              + _leaf_score(gt - cgl, ht - chl, l2, l1) - parent)
+    valid_c = (chl >= min_child_weight) & (ht - chl >= min_child_weight)
+    gain_c = jnp.where(valid_c, gain_c, -jnp.inf)
+    gain_c = gain_c.at[:, :, -1].set(-jnp.inf)
+    k_c = jnp.argmax(gain_c, axis=2)                # prefix END index
+    best_c = jnp.take_along_axis(gain_c, k_c[:, :, None], axis=2)[:, :, 0]
+
+    # ---- per-feature choice, then per-node argmax over features ----
+    is_cat = cat_feats[None, :] > 0
+    gain_f = jnp.where(is_cat, best_c, best_n)
+    gain_f = jnp.where(feat_mask[None, :] > 0, gain_f, -jnp.inf)
+    bf = jnp.argmax(gain_f, axis=1)                          # (2,)
+    gain = jnp.take_along_axis(gain_f, bf[:, None], axis=1)[:, 0]
+    thr_f = jnp.where(is_cat, k_c, bin_n)
+    thr = jnp.take_along_axis(thr_f, bf[:, None], axis=1)[:, 0]
+
+    # winner bitset: categories in the winning feature's sorted prefix
+    # [0..thr] route LEFT -> the RIGHT set is ranks > thr. Store the RIGHT
+    # set so numeric and categorical routing agree ("right when test hits").
+    win_order = jnp.take_along_axis(
+        order, bf[:, None, None], axis=1)[:, 0, :]           # (2, B)
+    ranks = jnp.argsort(win_order, axis=1)                   # bin -> rank
+    member = ranks > thr[:, None]                            # (2, B) bool
+    bits = jnp.arange(B, dtype=jnp.uint32)
+    word_id = (bits >> 5).astype(jnp.int32)
+    bit_in_word = jnp.uint32(1) << (bits & jnp.uint32(31))
+    bitset = jnp.zeros((n_nodes, CAT_WORDS), dtype=jnp.uint32)
+    contrib = jnp.where(member, bit_in_word[None, :], jnp.uint32(0))
+    # pack the membership bits into words (8-way static loop; bins within a
+    # word have distinct bit values so a sum is an OR)
+    for w in range(CAT_WORDS):
+        in_w = (word_id == w)
+        word_val = jnp.where(in_w[None, :], contrib,
+                             jnp.uint32(0)).sum(axis=1, dtype=jnp.uint32)
+        bitset = bitset.at[:, w].set(word_val)
+    return gain, bf.astype(jnp.int32), thr.astype(jnp.int32), bitset
+
+
+def _bit_test(bitset_row, rb):
+    """bitset_row (CAT_WORDS,) uint32, rb (n,) int32 -> (n,) bool."""
+    word = bitset_row[(rb >> 5)]
+    return ((word >> (rb & 31).astype(jnp.uint32)) & jnp.uint32(1)) == 1
+
+
+def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
+                       cat_feats, feat_mask, lambda_l2, lambda_l1,
+                       min_child_weight, min_split_gain, cat_smooth: float,
+                       max_depth: int = 0, hist_impl: str = "segment",
+                       axis_name: Optional[str] = None):
+    """One leaf-wise tree. bins (n, d) int; g/h (n,) f32 (already masked).
+
+    Returns (split_leaf (L-1,), feature (L-1,), threshold (L-1,),
+    cat_bitset (L-1, CAT_WORDS), is_cat (L-1,), leaf (L,)).
+    """
+    from .engine import _histograms
+
+    n, d = bins.shape
+    L = num_leaves
+    cat_feats = jnp.asarray(cat_feats, jnp.float32)
+    neg_inf = jnp.float32(-jnp.inf)
+
+    def hist_pair(node, a, b):
+        """Histograms for leaves a and b in ONE pass; other rows discard."""
+        ids = jnp.where(node == a, 0, jnp.where(node == b, 1, 2)) \
+            .astype(jnp.int32)
+        hg, hh = _histograms(bins, g, h, ids, 3, n_bins, hist_impl)
+        if axis_name is not None:
+            hg = jax.lax.psum(hg, axis_name)
+            hh = jax.lax.psum(hh, axis_name)
+        return hg[:2], hh[:2]
+
+    def cand_pair(node, a, b):
+        hg, hh = hist_pair(node, a, b)
+        return _candidates_2(hg, hh, feat_mask, cat_feats, n_bins,
+                             lambda_l2, lambda_l1, min_child_weight,
+                             cat_smooth)
+
+    node0 = jnp.zeros(n, dtype=jnp.int32)
+    g0, f0, t0, w0 = cand_pair(node0, 0, -1)   # root candidates (slot 0)
+    cg = jnp.full(L, neg_inf).at[0].set(g0[0])
+    cf = jnp.zeros(L, jnp.int32).at[0].set(f0[0])
+    ct = jnp.zeros(L, jnp.int32).at[0].set(t0[0])
+    cw = jnp.zeros((L, CAT_WORDS), jnp.uint32).at[0].set(w0[0])
+    dep = jnp.zeros(L, jnp.int32)
+
+    def round_fn(carry, r):
+        node, cg, cf, ct, cw, dep = carry
+        s = jnp.argmax(cg).astype(jnp.int32)
+        ok = cg[s] > min_split_gain
+        f, t, w = cf[s], ct[s], cw[s]
+        f_is_cat = cat_feats[f] > 0
+        rb = bins[jnp.arange(n), f].astype(jnp.int32)
+        right = jnp.where(f_is_cat, _bit_test(w, rb), rb > t)
+        right = right & (node == s) & ok
+        node = jnp.where(right, r + 1, node)
+
+        rec = (jnp.where(ok, s, -1), f, t, w, f_is_cat & ok)
+
+        gain2, f2, t2, w2 = cand_pair(node, s, r + 1)
+        childdep = dep[s] + 1
+        depth_ok = (max_depth == 0) | (childdep < max_depth)
+        gain2 = jnp.where(depth_ok, gain2, neg_inf)
+        cg = cg.at[s].set(jnp.where(ok, gain2[0], neg_inf))
+        cg = cg.at[r + 1].set(jnp.where(ok, gain2[1], neg_inf))
+        cf = cf.at[s].set(jnp.where(ok, f2[0], cf[s]))
+        cf = cf.at[r + 1].set(f2[1])
+        ct = ct.at[s].set(jnp.where(ok, t2[0], ct[s]))
+        ct = ct.at[r + 1].set(t2[1])
+        cw = cw.at[s].set(jnp.where(ok, w2[0], cw[s]))
+        cw = cw.at[r + 1].set(w2[1])
+        dep = dep.at[s].set(jnp.where(ok, childdep, dep[s]))
+        dep = dep.at[r + 1].set(childdep)
+        return (node, cg, cf, ct, cw, dep), rec
+
+    (node, *_), (S, F, T, W, IC) = jax.lax.scan(
+        round_fn, (node0, cg, cf, ct, cw, dep),
+        jnp.arange(L - 1, dtype=jnp.int32))
+
+    lg = jax.ops.segment_sum(g, node, num_segments=L)
+    lh = jax.ops.segment_sum(h, node, num_segments=L)
+    if axis_name is not None:
+        lg = jax.lax.psum(lg, axis_name)
+        lh = jax.lax.psum(lh, axis_name)
+    leaf = -_soft(lg, lambda_l1) / (lh + lambda_l2)
+    # node (each row's final leaf) goes back too: the boosting loop's raw
+    # update is then a free (L,)-table gather instead of replaying the
+    # whole split sequence over the training set every iteration
+    return (S.astype(jnp.int32), F, T, W, IC, leaf, node)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_leaves", "n_bins", "max_depth", "hist_impl"))
+def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
+                              cat_feats, *, num_leaves, n_bins, lambda_l2,
+                              lambda_l1, min_child_weight, min_split_gain,
+                              cat_smooth, max_depth, hist_impl="segment"):
+    """vmap over the class axis (K leaf-wise trees per boosting iter)."""
+    def one(g, h):
+        return grow_tree_leafwise(
+            bins, g * row_mask, h * row_mask, num_leaves=num_leaves,
+            n_bins=n_bins, cat_feats=cat_feats, feat_mask=feat_mask,
+            lambda_l2=lambda_l2, lambda_l1=lambda_l1,
+            min_child_weight=min_child_weight,
+            min_split_gain=min_split_gain, cat_smooth=cat_smooth,
+            max_depth=max_depth, hist_impl=hist_impl)
+    return jax.vmap(one, in_axes=1, out_axes=0)(grad, hess)
+
+
+def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
+                            lambda_l1, min_child_weight, min_split_gain,
+                            cat_smooth, max_depth, hist_impl="segment",
+                            axis_name: str = "data"):
+    """Data-parallel leaf-wise builder: rows sharded over `axis_name`,
+    per-round histograms + leaf sums psum'ed (the LightGBM data-parallel
+    ring, TrainUtils.scala:141, as ICI collectives)."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(bins, g, h, rm, fm, cat):
+        def one(g1, h1):
+            return grow_tree_leafwise(
+                bins, g1 * rm, h1 * rm, num_leaves=num_leaves,
+                n_bins=n_bins, cat_feats=cat, feat_mask=fm,
+                lambda_l2=lambda_l2, lambda_l1=lambda_l1,
+                min_child_weight=min_child_weight,
+                min_split_gain=min_split_gain, cat_smooth=cat_smooth,
+                max_depth=max_depth, hist_impl=hist_impl,
+                axis_name=axis_name)
+        return jax.vmap(one, in_axes=1, out_axes=0)(g, h)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis_name, None), P(axis_name, None), P(axis_name, None),
+                  P(axis_name), P(None), P(None)),
+        # tree arrays replicate; the per-row node assignment stays sharded
+        # like the rows it describes
+        out_specs=(P(None), P(None), P(None), P(None), P(None), P(None),
+                   P(None, axis_name)),
+        check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def predict_tree_lw(bins, S, F, T, W, IC, leaf):
+    """Replay one tree's split sequence: bins (n,d) -> (n,) leaf values."""
+    n = bins.shape[0]
+    L1 = S.shape[0]
+
+    def body(pos, xs):
+        new_id, s, f, t, w, ic = xs
+        rb = bins[jnp.arange(n), f].astype(jnp.int32)
+        hit = jnp.where(ic, _bit_test(w, rb), rb > t)
+        right = (pos == s) & (s >= 0) & hit
+        return jnp.where(right, new_id, pos), None
+
+    pos, _ = jax.lax.scan(
+        body, jnp.zeros(n, jnp.int32),
+        (jnp.arange(1, L1 + 1, dtype=jnp.int32), S, F, T, W, IC))
+    return leaf[pos]
+
+
+def predict_raw_lw(ens: LeafwiseEnsemble, bins,
+                   num_iteration: Optional[int] = None) -> np.ndarray:
+    """Raw scores (n, K) for a leaf-wise ensemble from binned features."""
+    T, K = ens.feature.shape[:2]
+    T = min(T, num_iteration) if num_iteration else T
+
+    @jax.jit
+    def run(bins, S, F, Th, W, IC, leaf):
+        def body(raw, tree):
+            s, f, t, w, ic, lv = tree
+            contrib = jnp.stack(
+                [predict_tree_lw(bins, s[k], f[k], t[k], w[k], ic[k], lv[k])
+                 for k in range(K)], axis=1)
+            return raw + contrib, None
+        init = jnp.broadcast_to(jnp.asarray(ens.base)[None, :],
+                                (bins.shape[0], K)).astype(jnp.float32)
+        raw, _ = jax.lax.scan(body, init, (S, F, Th, W, IC, leaf))
+        return raw
+
+    return np.asarray(run(bins, ens.split_leaf[:T], ens.feature[:T],
+                          ens.threshold[:T], ens.cat_bitset[:T],
+                          ens.is_cat[:T], ens.leaf[:T]))

@@ -17,7 +17,7 @@ import jax
 
 from ...core.dataframe import DataFrame
 from ...core.params import (ComplexParam, FloatParam, HasFeaturesCol,
-                            HasLabelCol, IntParam, StringParam)
+                            HasLabelCol, IntParam, ListParam, StringParam)
 from ...core.pipeline import Estimator, Model
 from ...core.schema import SparkSchema
 from ...ops.text_ops import rows_to_matrix
@@ -28,10 +28,25 @@ from . import engine
 class _BoosterParams:
     numIterations = IntParam("number of boosting iterations", default=100, min=1)
     learningRate = FloatParam("shrinkage rate", default=0.1, min=0.0)
-    numLeaves = IntParam("max leaves per tree (level-wise: rounded up to a "
-                         "power of two)", default=31, min=2)
+    numLeaves = IntParam("max leaves per tree (LightGBMParams.scala:34 "
+                         "default 31; best-first growth under the default "
+                         "growthPolicy)", default=31, min=2)
     maxBin = IntParam("max feature histogram bins", default=255, min=2)
-    maxDepth = IntParam("tree depth; 0 derives it from numLeaves", default=0, min=0)
+    maxDepth = IntParam("depth cap; 0 = uncapped for leaf-wise growth / "
+                        "derived from numLeaves for depthwise", default=0,
+                        min=0)
+    growthPolicy = StringParam(
+        "leafwise = native-LightGBM best-first growth to numLeaves leaves "
+        "(supports categorical splits); depthwise = level-wise to maxDepth "
+        "(the feature_parallel mode's form)", default="leafwise",
+        choices=("leafwise", "depthwise"))
+    categoricalSlotIndexes = ListParam(
+        "feature-vector slot indexes to split as category sets; [] also "
+        "auto-detects single-slot categorical columns from the assembled "
+        "features metadata (core/schema categorical levels -> "
+        "FastVectorAssembler slot ranges)", default=())
+    catSmooth = FloatParam("categorical smoothing (LightGBM cat_smooth)",
+                           default=10.0, min=0.0)
     lambdaL1 = FloatParam("L1 regularization", default=0.0, min=0.0)
     lambdaL2 = FloatParam("L2 regularization", default=1.0, min=0.0)
     minSumHessianInLeaf = FloatParam("min child hessian", default=1e-3, min=0.0)
@@ -62,11 +77,37 @@ class _BoosterParams:
         return max(1, int(np.ceil(np.log2(self.getOrDefault("numLeaves")))))
 
     def _engine_params(self, objective: str, num_class: int = 1,
-                       alpha: float = 0.9) -> engine.GBDTParams:
+                       alpha: float = 0.9,
+                       categorical: tuple = ()) -> engine.GBDTParams:
+        leafwise = self.getOrDefault("growthPolicy") == "leafwise"
+        if leafwise and self._tree_learner() == "feature":
+            # feature-parallel split candidates are level-wise only
+            from ...core.utils import get_logger
+            get_logger("gbdt").warning(
+                "growthPolicy=leafwise is unavailable with "
+                "feature_parallel; using depthwise growth")
+            leafwise = False
+        if categorical and not leafwise:
+            if self.getOrDefault("categoricalSlotIndexes"):
+                raise ValueError(
+                    "categorical splits need growthPolicy='leafwise' (and "
+                    "a non-feature_parallel parallelism)")
+            # AUTO-detected categorical metadata must not break configs
+            # that trained fine before categorical support existed
+            from ...core.utils import get_logger
+            get_logger("gbdt").warning(
+                "ignoring auto-detected categorical slots %s: this growth "
+                "mode treats them numerically (set growthPolicy='leafwise' "
+                "for category-set splits)", list(categorical))
+            categorical = ()
         return engine.GBDTParams(
             num_iterations=self.getOrDefault("numIterations"),
             learning_rate=self.getOrDefault("learningRate"),
-            max_depth=self._depth(),
+            max_depth=(self.getOrDefault("maxDepth") if leafwise
+                       else self._depth()),
+            num_leaves=(self.getOrDefault("numLeaves") if leafwise else 0),
+            categorical_feature=tuple(int(j) for j in categorical),
+            cat_smooth=self.getOrDefault("catSmooth"),
             max_bin=self.getOrDefault("maxBin"),
             lambda_l1=self.getOrDefault("lambdaL1"),
             lambda_l2=self.getOrDefault("lambdaL2"),
@@ -131,8 +172,39 @@ def _select_features(mat, cap: int):
     return sel
 
 
-def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
-    p = params_holder._engine_params(objective, num_class, alpha)
+def _categorical_slots(df: DataFrame, feat_col: str, explicit, sel):
+    """Categorical feature-vector slot indexes: the explicit param, else
+    width-1 categorical slots auto-read from the assembled-features
+    metadata (FastVectorAssembler propagates core/schema categorical
+    levels as slot ranges — the reference's MML categorical-metadata
+    contract). One-hot (width>1) slots are already binary and stay
+    numeric. Indexes remap through the sparse feature selection."""
+    from ...core.schema import MML_TAG
+    idxs = [int(i) for i in explicit]
+    was_explicit = bool(idxs)
+    if not idxs:
+        asm = df.metadata(feat_col).get(MML_TAG, {}).get("assembled")
+        if asm:
+            for slot in asm.get("slots", {}).values():
+                if slot.get("categorical") is not None \
+                        and slot.get("width") == 1:
+                    idxs.append(int(slot["start"]))
+    if sel is not None:
+        pos = {int(c): i for i, c in enumerate(sel)}
+        dropped = [j for j in idxs if j not in pos]
+        if dropped and was_explicit:
+            raise ValueError(
+                f"categoricalSlotIndexes {dropped} were removed by the "
+                f"sparse feature selection (maxDenseFeatures kept "
+                f"{len(pos)} columns); raise maxDenseFeatures or drop "
+                f"those indexes")
+        idxs = [pos[j] for j in idxs if j in pos]
+    return tuple(sorted(set(idxs)))
+
+
+def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
+                  categorical=()):
+    p = params_holder._engine_params(objective, num_class, alpha, categorical)
     mesh = params_holder._mesh(x.shape[0])
     if mesh is not None and p.tree_learner != "feature":
         # row-sharded modes need the batch padded to a device multiple;
@@ -151,16 +223,38 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9):
         return engine.fit_gbdt(x, y, p, mesh=mesh, sample_weight=w)
 
 
-def _ensemble_to_state(ens: engine.TreeEnsemble) -> dict:
-    return {"feature": np.asarray(ens.feature),
-            "threshold": np.asarray(ens.threshold),
-            "leaf": np.asarray(ens.leaf),
-            "bin_edges": np.asarray(ens.bin_edges),
-            "base": np.asarray(ens.base)}
+def _ensemble_to_state(ens) -> dict:
+    from .leafwise import LeafwiseEnsemble
+    state = {"feature": np.asarray(ens.feature),
+             "threshold": np.asarray(ens.threshold),
+             "leaf": np.asarray(ens.leaf),
+             "bin_edges": np.asarray(ens.bin_edges),
+             "base": np.asarray(ens.base)}
+    if isinstance(ens, LeafwiseEnsemble):
+        state.update(kind="leafwise",
+                     split_leaf=np.asarray(ens.split_leaf),
+                     cat_bitset=np.asarray(ens.cat_bitset),
+                     is_cat=np.asarray(ens.is_cat),
+                     cat_features=np.asarray(ens.cat_features))
+    return state
 
 
-def _state_to_ensemble(state: dict, objective: str) -> engine.TreeEnsemble:
+def _state_to_ensemble(state: dict, objective: str):
     import jax.numpy as jnp
+    if state.get("kind") == "leafwise":
+        from .leafwise import LeafwiseEnsemble
+        return LeafwiseEnsemble(
+            split_leaf=jnp.asarray(state["split_leaf"]),
+            feature=jnp.asarray(state["feature"]),
+            threshold=jnp.asarray(state["threshold"]),
+            cat_bitset=jnp.asarray(np.asarray(state["cat_bitset"])
+                                   .astype(np.uint32)),
+            is_cat=jnp.asarray(np.asarray(state["is_cat"]).astype(bool)),
+            leaf=jnp.asarray(state["leaf"]),
+            bin_edges=np.asarray(state["bin_edges"]),
+            cat_features=np.asarray(state["cat_features"]).astype(bool),
+            base=np.asarray(state["base"]),
+            objective=objective)
     return engine.TreeEnsemble(
         feature=jnp.asarray(state["feature"]),
         threshold=jnp.asarray(state["threshold"]),
@@ -219,8 +313,11 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
                 f"{classes.tolist()}; index them first (e.g. ValueIndexer)")
         num_class = len(classes)
         objective = "binary" if num_class <= 2 else "multiclass"
+        cats = _categorical_slots(df, self.getFeaturesCol(),
+                                  self.getCategoricalSlotIndexes(), sel)
         ens = _fit_ensemble(self, x, y, objective,
-                            num_class=(num_class if objective == "multiclass" else 1))
+                            num_class=(num_class if objective == "multiclass" else 1),
+                            categorical=cats)
         return (LightGBMClassificationModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(objective)
@@ -261,8 +358,10 @@ class LightGBMRegressor(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams):
         sel = _select_features(mat, self.getMaxDenseFeatures())
         x = _densify(mat, sel)
         y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
+        cats = _categorical_slots(df, self.getFeaturesCol(),
+                                  self.getCategoricalSlotIndexes(), sel)
         ens = _fit_ensemble(self, x, y, self.getApplication(),
-                            alpha=self.getAlpha())
+                            alpha=self.getAlpha(), categorical=cats)
         return (LightGBMRegressionModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(self.getApplication())
