@@ -1,5 +1,6 @@
 from .distributed import (DistributedHTTPSource, DistributedServingLoop,
                           SharedVariable, serve_distributed)
+from .fleet import ProcessHTTPSource, ReplayServingLoop, serve_fleet
 from .server import HTTPSink, HTTPSource, ServingLoop, serve_pipeline
 from .transformer import (CustomInputParser, CustomOutputParser,
                           HTTPTransformer, JSONInputParser, JSONOutputParser,

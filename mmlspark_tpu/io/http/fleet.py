@@ -1,0 +1,315 @@
+"""Cross-process serving fleet with streaming-source offset/replay semantics.
+
+The driver-side half of the reference's distributed serving: worker servers
+live in SEPARATE OS processes (the executor-JVM analog — every executor runs
+a JVMSharedServer, DistributedHTTPSource.scala:270) and the driver runs the
+micro-batch loop behind Spark structured streaming's Source contract
+(HTTPSource.scala:43-147): ``getOffset`` advances as requests arrive,
+``getBatch(start, end)`` is REPLAYABLE — the same offset range returns the
+same rows until ``commit`` — so a failed pipeline step re-processes its
+batch instead of dropping client requests.
+
+Failure containment: a worker process dying takes down ONLY its own
+in-flight clients (their TCP connections die with it); the fleet marks it
+dead at the next poll and keeps batching the survivors — matching the
+reference, where one executor's crash fails its exchanges while the
+streaming query continues on the rest.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from ...core.dataframe import DataFrame
+from ...core.utils import get_logger, object_column
+from .server import HTTPSink
+
+log = get_logger("http.fleet")
+
+
+class _Worker:
+    """Driver-side handle to one worker process."""
+
+    SPAWN_TIMEOUT = 30.0
+
+    def __init__(self, host: str, port: int, control_port: int,
+                 spawn: bool = True):
+        self.host = host
+        self.alive = True
+        self.proc = None
+        self.pending_ack: list[str] = []   # ids appended, not yet acked
+        if spawn:
+            import os
+            env = dict(os.environ)
+            self.proc = subprocess.Popen(
+                [sys.executable, "-m", "mmlspark_tpu.io.http.worker",
+                 "--host", host, "--port", str(port),
+                 "--control-port", str(control_port)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env)
+            # bounded startup: a child that dies (or hangs) before printing
+            # its ports must raise a real error, not block or JSON-crash
+            box: dict = {}
+            reader = threading.Thread(
+                target=lambda: box.update(line=self.proc.stdout.readline()),
+                daemon=True)
+            reader.start()
+            reader.join(timeout=self.SPAWN_TIMEOUT)
+            line = box.get("line", "")
+            if not line:
+                err = ""
+                try:
+                    self.proc.kill()
+                    err = (self.proc.stderr.read() or "")[-800:]
+                except Exception:
+                    pass
+                raise RuntimeError(
+                    f"serving worker failed to start (no port line within "
+                    f"{self.SPAWN_TIMEOUT:.0f}s): {err}")
+            info = json.loads(line)
+            self.port, self.control = info["port"], info["control"]
+        else:
+            self.port, self.control = port, control_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/"
+
+    def _call(self, path: str, payload: dict, timeout: float = 5.0) -> dict:
+        req = urllib.request.Request(
+            f"http://{self.host}:{self.control}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def poll(self, max_rows: int, timeout: float) -> list:
+        """Poll new rows, acknowledging the previously received ones (the
+        at-least-once handoff: unacked rows re-deliver)."""
+        ack, self.pending_ack = self.pending_ack, []
+        try:
+            return self._call("/poll", {"max": max_rows, "timeout": timeout,
+                                        "ack": ack})["rows"]
+        except Exception:
+            self.pending_ack = ack + self.pending_ack   # re-ack next time
+            raise
+
+    def respond(self, replies: list) -> None:
+        self._call("/respond", {"replies": replies})
+
+    def probably_dead(self) -> bool:
+        """Distinguish crashed from merely slow: process exit is
+        definitive; otherwise one /health round-trip decides."""
+        if self.proc is not None and self.proc.poll() is not None:
+            return True
+        try:
+            with urllib.request.urlopen(
+                    f"http://{self.host}:{self.control}/health",
+                    timeout=2.0) as r:
+                return r.status != 200
+        except Exception:
+            return True
+
+    def kill(self) -> None:
+        if self.proc is not None:
+            self.proc.kill()
+            self.proc.wait()
+        self.alive = False
+
+
+class ProcessHTTPSource:
+    """N worker PROCESSES behind one replayable offset log.
+
+    ``getOffset()`` polls every live worker and appends fresh rows to the
+    uncommitted log; ``getBatch(start, end)`` serves (start, end] from the
+    log — identical rows on every call until ``commit(end)`` trims it (the
+    reference's streaming-source contract, HTTPSource.scala:43-147).
+    Replies buffer per worker and ``flush()`` ships them grouped (one
+    control round-trip per worker per batch)."""
+
+    def __init__(self, n_workers: int = 2, host: str = "127.0.0.1",
+                 base_port: int = 0, poll_timeout: float = 0.02):
+        self.workers: list[_Worker] = []
+        port = base_port
+        try:
+            for _ in range(n_workers):
+                w = _Worker(host, port, 0)
+                self.workers.append(w)
+                if base_port:
+                    port = w.port + 1
+        except Exception:
+            # a failed spawn must not orphan the already-running workers
+            for w in self.workers:
+                w.kill()
+            raise
+        self.poll_timeout = poll_timeout
+        self._log: list[tuple[int, str, str]] = []  # (offset, id, value)
+        self._log_ids: set[str] = set()   # uncommitted ids (re-delivery dedupe)
+        self._offset = 0          # highest offset assigned
+        self._committed = 0       # offsets <= this are gone
+        self._reply_buf: dict[int, list] = {}
+        self._lock = threading.Lock()
+        log.info("fleet of %d worker processes on ports %s",
+                 n_workers, [w.port for w in self.workers])
+
+    @property
+    def urls(self) -> list[str]:
+        return [w.url for w in self.workers if w.alive]
+
+    def aliveCount(self) -> int:
+        return sum(w.alive for w in self.workers)
+
+    # ---- streaming-source contract ----
+    def getOffset(self) -> int:
+        """Poll the fleet; new requests extend the offset log. Re-delivered
+        rows (a previous poll response lost in transit) dedupe against the
+        uncommitted log — at-least-once handoff, exactly-once offsets."""
+        for wi, w in enumerate(self.workers):
+            if not w.alive:
+                continue
+            try:
+                rows = w.poll(256, self.poll_timeout)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # slow and dead look identical on one failed call; only a
+                # failed health check (or process exit) is a death verdict.
+                # A dead worker loses ONLY its own in-flight clients (their
+                # sockets died with it); the fleet serves on.
+                if w.probably_dead():
+                    log.warning("worker %d (%s) dead, marking: %s",
+                                wi, w.url, e)
+                    w.alive = False
+                else:
+                    log.warning("worker %d poll failed (still healthy, "
+                                "retrying next round): %s", wi, e)
+                continue
+            with self._lock:
+                for ex_id, value in rows:
+                    qid = f"{wi}:{ex_id}"
+                    w.pending_ack.append(ex_id)
+                    if qid in self._log_ids:
+                        continue    # re-delivery of an unacked row
+                    self._offset += 1
+                    self._log.append((self._offset, qid, value))
+                    self._log_ids.add(qid)
+        return self._offset
+
+    def committedOffset(self) -> int:
+        return self._committed
+
+    def getBatch(self, start: int, end: int) -> DataFrame:
+        """Rows with offsets in (start, end] — replayable until commit."""
+        if start < self._committed:
+            raise ValueError(f"offset {start} already committed "
+                             f"(committed={self._committed}); a committed "
+                             f"batch cannot be replayed")
+        with self._lock:
+            rows = [(i, v) for off, i, v in self._log
+                    if start < off <= end]
+        if not rows:
+            return DataFrame({"id": np.array([], dtype=object),
+                              "value": np.array([], dtype=object)})
+        return DataFrame({"id": object_column([i for i, _ in rows]),
+                          "value": object_column([v for _, v in rows])})
+
+    def commit(self, offset: int) -> None:
+        with self._lock:
+            self._committed = max(self._committed, offset)
+            done = [e for e in self._log if e[0] <= self._committed]
+            self._log = [e for e in self._log if e[0] > self._committed]
+            self._log_ids -= {qid for _, qid, _ in done}
+
+    # ---- reply path (HTTPSink surface) ----
+    def respond(self, ex_id: str, code: int, body) -> None:
+        wi, raw = str(ex_id).split(":", 1)
+        self._reply_buf.setdefault(int(wi), []).append(
+            [raw, int(code), body if isinstance(body, str)
+             else body.decode("utf-8")])
+
+    def flush(self) -> None:
+        buf, self._reply_buf = self._reply_buf, {}
+        for wi, replies in buf.items():
+            w = self.workers[wi]
+            if not w.alive:
+                continue
+            try:
+                w.respond(replies)
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                log.warning("worker %d reply delivery failed: %s", wi, e)
+                w.alive = False
+
+    def killWorker(self, i: int) -> None:
+        """Hard-kill one worker process (failure-injection hook)."""
+        self.workers[i].kill()
+
+    def close(self) -> None:
+        for w in self.workers:
+            w.kill()
+
+
+class ReplayServingLoop:
+    """Micro-batch loop over the fleet with exactly-once processing per
+    offset range: poll -> getBatch -> transform -> reply -> commit. A
+    transform failure REPLAYS the same batch once (same rows, by the source
+    contract) before failing the clients with 500s — crash recovery the
+    single-process loop can't offer."""
+
+    def __init__(self, source: ProcessHTTPSource, transformer,
+                 max_retries: int = 1):
+        self.source = source
+        self.sink = HTTPSink(source)
+        self.transformer = transformer
+        self.max_retries = max_retries
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self._stop.is_set():
+            start = self.source.committedOffset()
+            end = self.source.getOffset()
+            if end == start:
+                time.sleep(0.005)
+                continue
+            for attempt in range(self.max_retries + 1):
+                batch = self.source.getBatch(start, end)  # replay-stable
+                try:
+                    out = self.transformer.transform(batch)
+                    self.sink.addBatch(out)
+                    break
+                except Exception as e:
+                    log.warning("batch (%d, %d] attempt %d failed: %s",
+                                start, end, attempt, e)
+                    if attempt == self.max_retries:
+                        for ex_id in batch.col("id"):
+                            self.source.respond(
+                                str(ex_id), 500,
+                                json.dumps({"error": str(e)}))
+            self.source.flush()
+            self.source.commit(end)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.source.close()
+
+
+def serve_fleet(transformer, n_workers: int = 2, host: str = "127.0.0.1",
+                base_port: int = 0):
+    """Spawn the worker fleet + replay loop; returns (source, loop). One
+    transformer call per micro-batch serves every worker process's
+    in-flight requests."""
+    source = ProcessHTTPSource(n_workers=n_workers, host=host,
+                               base_port=base_port)
+    loop = ReplayServingLoop(source, transformer).start()
+    return source, loop

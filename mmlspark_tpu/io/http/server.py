@@ -42,6 +42,21 @@ class _BurstyHTTPServer(ThreadingHTTPServer):
     request_queue_size = 128
 
 
+def bind_with_probing(host: str, port: int, handler,
+                      max_probes: int = 20) -> _BurstyHTTPServer:
+    """Bind a server on ``port`` or the next free port above it (port 0 =
+    kernel-assigned). The reference's probing loop,
+    DistributedHTTPSource.scala:237-250."""
+    last_err = None
+    for probe in range(max_probes):
+        try:
+            return _BurstyHTTPServer((host, port + probe if port else 0),
+                                     handler)
+        except OSError as e:
+            last_err = e
+    raise OSError(f"no free port after {max_probes} probes: {last_err}")
+
+
 class _Exchange:
     """One in-flight request awaiting a reply (the HttpExchange analog)."""
 
@@ -92,17 +107,7 @@ class HTTPSource:
                 pass
 
         # port probing (reference DistributedHTTPSource.scala:237-250)
-        last_err = None
-        for probe in range(max_port_probes):
-            try:
-                self.server = _BurstyHTTPServer(
-                    (host, port + probe if port else 0), Handler)
-                break
-            except OSError as e:
-                last_err = e
-        else:
-            raise OSError(f"no free port after {max_port_probes} probes: "
-                          f"{last_err}")
+        self.server = bind_with_probing(host, port, Handler, max_port_probes)
         self.host, self.port = self.server.server_address[:2]
         self.reply_timeout = 30.0
         self._thread = threading.Thread(target=self.server.serve_forever,

@@ -1,0 +1,120 @@
+"""Serving worker process: a client-facing HTTP server + a control channel.
+
+The executor-side half of the reference's serving architecture: every Spark
+executor JVM runs a JVMSharedServer holding in-flight HttpExchanges
+(DistributedHTTPSource.scala:100-260), and the driver's micro-batch loop
+pulls requests out / pushes replies back across the cluster. Here the worker
+is an OS process: clients POST to its public port and block; the driver
+process polls ``/poll`` on the control port for pending (id, value) rows and
+posts grouped replies to ``/respond`` — the exchange lifecycle stays inside
+the worker, so a driver restart (or batch replay) never loses a client
+connection that's still waiting.
+
+Run as ``python -m mmlspark_tpu.io.http.worker [--host H] [--port P]
+[--control-port C]``; prints ONE json line {"port": .., "control": ..} so
+the spawner learns the probed ports.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler
+
+from ...core.utils import get_logger
+from .server import HTTPSource, bind_with_probing
+
+log = get_logger("http.worker")
+
+
+class WorkerServer:
+    """Client server + control server inside one worker process.
+
+    The poll handoff is AT-LEAST-ONCE: drained exchanges stay in an
+    ``unacked`` buffer until the driver's next poll acknowledges their ids,
+    so a poll response lost in transit re-delivers the same rows instead of
+    stranding their clients (a drain-and-forget handoff would drop them)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 control_port: int = 0):
+        self.source = HTTPSource(host=host, port=port, name="worker")
+        self._unacked: dict[str, str] = {}   # id -> value, insertion order
+        self._lock = threading.Lock()
+        worker = self
+
+        class Control(BaseHTTPRequestHandler):
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health":
+                    self._json(200, {"ok": True,
+                                     "port": worker.source.port})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/poll":
+                    with worker._lock:
+                        for ex_id in req.get("ack", ()):
+                            worker._unacked.pop(str(ex_id), None)
+                    batch = worker.source.getBatch(
+                        int(req.get("max", 256)),
+                        timeout=float(req.get("timeout", 0.02)))
+                    with worker._lock:
+                        for i, v in zip(batch.col("id"),
+                                        batch.col("value")):
+                            worker._unacked[str(i)] = str(v)
+                        rows = [[i, v] for i, v in worker._unacked.items()]
+                    self._json(200, {"rows": rows})
+                elif self.path == "/respond":
+                    for ex_id, code, body in req.get("replies", ()):
+                        worker.source.respond(str(ex_id), int(code),
+                                              str(body))
+                    self._json(200, {})
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):
+                pass
+
+        self.control = bind_with_probing(host, control_port, Control)
+        self.control_port = self.control.server_address[1]
+        self._thread = threading.Thread(target=self.control.serve_forever,
+                                        daemon=True, name="http-control")
+        self._thread.start()
+
+    def close(self):
+        self.source.close()
+        self.control.shutdown()
+        self.control.server_close()
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--control-port", type=int, default=0)
+    args = ap.parse_args(argv)
+    w = WorkerServer(args.host, args.port, args.control_port)
+    print(json.dumps({"port": w.source.port, "control": w.control_port}),
+          flush=True)
+    try:
+        threading.Event().wait()   # serve until killed
+    except KeyboardInterrupt:
+        pass
+    w.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

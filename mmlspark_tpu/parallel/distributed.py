@@ -15,6 +15,15 @@ ssh, no hostfiles, no socket rings.
 Single-process (local[*]-style) use needs no initialize call at all — the
 same code paths run on the local devices, the analog of the reference's
 partitions-as-workers local mode (SURVEY.md §4).
+
+Failure model: a worker missing at rendezvous fails the fleet inside
+MMLTPU_INIT_TIMEOUT (default 120 s, LightGBM's bound); a worker dying
+BETWEEN collectives is caught by coordination-service heartbeats
+(MMLTPU_HEARTBEAT_TIMEOUT) — the survivors terminate with an error inside
+the bound instead of hanging in the next collective. Recovery = relaunch
+the fleet and refit with the same checkpointDir: TpuLearner resumes from
+the last complete epoch (the crash→relaunch→resume path has a real
+two-process test in tests/test_parallel_depth.py).
 """
 
 from __future__ import annotations

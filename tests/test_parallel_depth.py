@@ -545,3 +545,80 @@ def test_worker_crash_then_checkpoint_resume(tmp_path):
         line = [l for l in out.splitlines() if "WORKER_OK" in l][-1]
         resumed = int(line.split()[-1])
         assert 0 <= resumed <= 5, line  # resumed from a run-1 checkpoint
+
+
+@pytest.mark.extended
+def test_dead_worker_detected_between_collectives(tmp_path):
+    """Heartbeat failure detection AFTER rendezvous: a worker that dies
+    between collectives must take the survivor down within the configured
+    heartbeat bound — not leave it hanging in the next psum forever. (The
+    reference's only bounded-failure story is LightGBM's 120 s listen
+    timeout at rendezvous, LightGBMConstants.scala:9-12; post-rendezvous
+    death hangs its MPI/socket rings. Recovery guidance: relaunch the fleet
+    and resume from checkpointDir — covered by
+    test_worker_crash_then_checkpoint_resume.)"""
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+    import os as _os
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "heartbeat_worker.py"
+    worker.write_text(
+        "import os, sys, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from mmlspark_tpu.parallel import distributed as dist\n"
+        "assert dist.initialize_from_env() is True\n"
+        "pid = jax.process_index()\n"
+        "mesh = dist.global_mesh()\n"
+        "def allsum():\n"
+        "    x = jax.make_array_from_process_local_data(\n"
+        "        NamedSharding(mesh, P('data')),\n"
+        "        np.ones((jax.local_device_count(),), 'float32'),\n"
+        "        (jax.device_count(),))\n"
+        "    return float(jax.jit(lambda a: a.sum(),\n"
+        "        out_shardings=NamedSharding(mesh, P()))(x))\n"
+        "assert allsum() == jax.device_count()\n"
+        "print('FIRST_COLLECTIVE_OK', pid, flush=True)\n"
+        "if pid == 1:\n"
+        "    os._exit(17)    # crash WITHOUT shutdown: no goodbye to anyone\n"
+        "time.sleep(2)\n"
+        "print('SURVIVOR_ENTERING_SECOND_COLLECTIVE', flush=True)\n"
+        "try:\n"
+        "    allsum()\n"
+        "    print('SECOND_COLLECTIVE_UNEXPECTEDLY_OK', flush=True)\n"
+        "except Exception as e:\n"
+        "    print('DEAD_PEER_DETECTED', type(e).__name__, flush=True)\n"
+        "    raise SystemExit(5)\n")
+
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    procs = []
+    for pid in range(2):
+        env = dict(_os.environ, PYTHONPATH=repo,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   MMLTPU_NUM_PROCESSES="2",
+                   MMLTPU_PROCESS_ID=str(pid),
+                   MMLTPU_HEARTBEAT_TIMEOUT="10")
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    out1, _ = procs[1].communicate(timeout=120)
+    assert procs[1].returncode == 17 and "FIRST_COLLECTIVE_OK" in out1
+    t0 = _time.monotonic()
+    # the survivor must TERMINATE within the heartbeat bound (+ margin),
+    # either by a raised error or the runtime aborting — never a hang
+    out0, err0 = procs[0].communicate(timeout=110)
+    elapsed = _time.monotonic() - t0
+    assert "SURVIVOR_ENTERING_SECOND_COLLECTIVE" in out0, (out0, err0[-800:])
+    assert "SECOND_COLLECTIVE_UNEXPECTEDLY_OK" not in out0, out0
+    assert procs[0].returncode != 0, (out0, err0[-800:])
+    assert elapsed < 100, f"survivor took {elapsed:.0f}s to notice the death"

@@ -1,0 +1,172 @@
+"""Cross-process serving fleet: worker processes, offset/replay semantics,
+kill-a-worker failure containment (reference: DistributedHTTPSource.scala:270
+executor-JVM servers; HTTPSource.scala:43-147 streaming-source offsets)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.io.http.fleet import ProcessHTTPSource, ReplayServingLoop
+
+
+class _Echo:
+    """Transformer echoing each request value, tagged."""
+
+    def transform(self, df: DataFrame) -> DataFrame:
+        replies = object_column(
+            [json.dumps({"echo": v}) for v in df.col("value")])
+        return df.withColumn("reply", replies)
+
+
+class _FailOnce(_Echo):
+    def __init__(self):
+        self.calls = 0
+        self.batches = []
+
+    def transform(self, df):
+        self.calls += 1
+        self.batches.append(sorted(df.col("id").tolist()))
+        if self.calls == 1:
+            raise RuntimeError("injected transform crash")
+        return super().transform(df)
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(url, data=payload.encode(),
+                                 headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.mark.extended
+def test_fleet_serves_across_processes():
+    src, loop = None, None
+    try:
+        src = ProcessHTTPSource(n_workers=2)
+        loop = ReplayServingLoop(src, _Echo()).start()
+        results = {}
+
+        def client(i, url):
+            results[i] = _post(url, f"msg-{i}")
+
+        threads = [threading.Thread(target=client,
+                                    args=(i, src.urls[i % 2]))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=20)
+        assert len(results) == 8
+        for i, (code, body) in results.items():
+            assert code == 200
+            assert json.loads(body)["echo"] == f"msg-{i}"
+    finally:
+        if loop:
+            loop.stop()
+        elif src:
+            src.close()
+
+
+@pytest.mark.extended
+def test_kill_worker_only_fails_its_clients():
+    src, loop = None, None
+    try:
+        src = ProcessHTTPSource(n_workers=2)
+        loop = ReplayServingLoop(src, _Echo()).start()
+        url_dead, url_alive = src.workers[0].url, src.workers[1].url
+        # warm both workers
+        assert _post(url_dead, "warm0")[0] == 200
+        assert _post(url_alive, "warm1")[0] == 200
+
+        src.killWorker(0)
+        # clients of the dead worker fail at the transport level
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _post(url_dead, "lost", timeout=3)
+        # the survivor keeps serving through the same loop
+        deadline = time.monotonic() + 15
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                code, body = _post(url_alive, "still-alive", timeout=10)
+                ok = code == 200 and json.loads(body)["echo"] == "still-alive"
+                if ok:
+                    break
+            except Exception:
+                time.sleep(0.2)
+        assert ok, "survivor worker stopped serving after peer death"
+        assert src.aliveCount() == 1
+    finally:
+        if loop:
+            loop.stop()
+        elif src:
+            src.close()
+
+
+@pytest.mark.extended
+def test_transform_crash_replays_same_batch():
+    """The source contract: an uncommitted offset range re-polls the SAME
+    rows, so one transform failure costs a retry, not client requests."""
+    src, loop = None, None
+    try:
+        src = ProcessHTTPSource(n_workers=2)
+        tf = _FailOnce()
+        loop = ReplayServingLoop(src, tf).start()
+        results = {}
+
+        def client(i):
+            results[i] = _post(src.urls[i % len(src.urls)], f"r-{i}")
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=25)
+        assert all(code == 200 for code, _ in results.values()), results
+        assert tf.calls >= 2
+        # the replayed batch carried exactly the crashed batch's rows
+        assert tf.batches[0] == tf.batches[1], tf.batches[:2]
+    finally:
+        if loop:
+            loop.stop()
+        elif src:
+            src.close()
+
+
+@pytest.mark.extended
+def test_offset_log_replay_and_commit():
+    src = None
+    try:
+        src = ProcessHTTPSource(n_workers=1)
+        got = {}
+        t = threading.Thread(target=lambda: got.update(
+            r=_post(src.urls[0], "payload", timeout=15)))
+        t.start()
+        start = src.committedOffset()
+        end = 0
+        deadline = time.monotonic() + 10
+        while end == start and time.monotonic() < deadline:
+            end = src.getOffset()
+        assert end > start
+        b1 = src.getBatch(start, end)
+        b2 = src.getBatch(start, end)     # replay: identical rows
+        assert b1.col("id").tolist() == b2.col("id").tolist()
+        assert b1.col("value").tolist() == ["payload"]
+        for ex_id in b1.col("id"):
+            src.respond(str(ex_id), 200, json.dumps({"ok": True}))
+        src.flush()
+        src.commit(end)
+        with pytest.raises(ValueError, match="committed"):
+            src.getBatch(start, end)      # committed ranges are gone
+        t.join(timeout=10)
+        assert got["r"][0] == 200
+    finally:
+        if src:
+            src.close()
