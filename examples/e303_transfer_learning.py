@@ -1,13 +1,23 @@
 """Example 303 — transfer learning by DNN featurization (reference:
 notebooks/samples/"303 - Transfer Learning by DNN Featurization - Airplane
-or Automobile": a pre-trained net, truncated below its classifier head via
-ImageFeaturizer, embeds images; a cheap classifier trains on the
-embeddings).
+or Automobile": ModelDownloader pulls a pretrained net from the model repo,
+ImageFeaturizer truncates it below the classifier head, and a cheap
+classifier trains on the embeddings).
 
-The truncation mechanism is the reference's layerNames/cutOutputLayers
-surface: the flax module taps an inner layer and returns it (pytree slice,
-no recompute of the head).
+This runs the REAL pipeline end to end: the committed zoo/ artifact
+(ResNet-20 trained on shapes10 by tools/build_zoo.py, held-out acc in
+zoo/README.md) is served over HTTP by a throwaway static server (the CDN
+role, ModelDownloader.scala:109), downloaded with sha256 verification
+(Schema.scala:34-40), truncated at the pooled features, and transferred to
+a new small-data task — beating the same architecture with random weights,
+which is the point of transfer learning.
 """
+
+import functools
+import http.server
+import os
+import tempfile
+import threading
 
 import numpy as np
 
@@ -17,40 +27,57 @@ from mmlspark_tpu.core.schema import make_image_row
 from mmlspark_tpu.core.utils import object_column
 from mmlspark_tpu.models import (ImageFeaturizer, LogisticRegression,
                                  TpuModel, build_model)
+from mmlspark_tpu.models.downloader import ModelDownloader
+from mmlspark_tpu.testing.datagen import make_shapes10
 
-rng = np.random.default_rng(0)
-n = 64
-# two synthetic "classes": bright-top vs bright-bottom images
-labels = rng.integers(0, 2, n)
-rows = []
-for i in range(n):
-    img = rng.integers(0, 90, (32, 32, 3))
-    half = slice(0, 16) if labels[i] == 0 else slice(16, 32)
-    img[half] += 120
-    rows.append(make_image_row(f"img{i}", 32, 32, 3,
-                               img.astype(np.uint8)))
-df = DataFrame({"image": object_column(rows),
-                "label": labels.astype(np.int64)})
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZOO = os.path.join(REPO, "zoo")
 
-# pre-trained stand-in: a CIFAR ResNet; cut the head, keep pooled features
-cfg = {"type": "resnet", "num_classes": 10}
-module = build_model(cfg)
-params = module.init(jax.random.PRNGKey(0),
-                     np.zeros((1, 32, 32, 3), np.float32))
-backbone = TpuModel().setModelConfig(cfg).setModelParams(params)
-print("layers:", backbone.layerNames()[-4:])
+# --- serve the committed zoo over HTTP (the reference's CDN role) ---
+handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                            directory=ZOO)
+server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+threading.Thread(target=server.serve_forever, daemon=True).start()
+url = f"http://127.0.0.1:{server.server_address[1]}/"
 
-featurizer = (ImageFeaturizer().setInputCol("image").setOutputCol("features")
-              .setModel(backbone).setCutOutputLayers(1))  # drop 'logits'
-embedded = featurizer.transform(df)
-dim = embedded.col("features")[0].shape[0]
-print("embedding dim:", dim)
+local = tempfile.mkdtemp(prefix="zoo_local_")
+downloader = ModelDownloader(local_path=local, server_url=url)
+print("remote models:", [(s.name, s.dataset, s.size)
+                         for s in downloader.remoteModels()])
+schema = downloader.downloadByName("ResNet20", "shapes10")  # sha256-gated
+print("downloaded:", schema.uri, "layers:", schema.layerNames[-3:])
 
-train, test = embedded.randomSplit([0.75, 0.25], seed=1)
-clf = LogisticRegression().setMaxIter(60).fit(train)
-pred = clf.transform(test)
-acc = float((np.asarray(pred.col("prediction"))
-             == np.asarray(test.col("label"))).mean())
-print("transfer accuracy:", round(acc, 3))
-assert acc > 0.8, "embeddings should separate the two synthetic classes"
+# --- a NEW small-data task: 2 shape families, 56 labeled examples ---
+xt, yt = make_shapes10(56, seed=100, num_classes=2, class_offset=6)
+xe, ye = make_shapes10(80, seed=101, num_classes=2, class_offset=6)
+
+
+def frame(xa, ya):
+    rows = object_column([make_image_row(f"i{i}", 32, 32, 3, xa[i])
+                          for i in range(len(xa))])
+    return DataFrame({"image": rows, "label": ya})
+
+
+def transfer_accuracy(backbone: TpuModel) -> float:
+    feat = (ImageFeaturizer().setInputCol("image").setOutputCol("features")
+            .setModel(backbone).setCutOutputLayers(1))   # pooled features
+    clf = LogisticRegression().setMaxIter(80).fit(feat.transform(frame(xt, yt)))
+    pred = clf.transform(feat.transform(frame(xe, ye)))
+    return float((np.asarray(pred.col("prediction")) == ye).mean())
+
+
+pretrained = TpuModel().setModelSchema(schema)
+acc_pre = transfer_accuracy(pretrained)
+
+cfg = pretrained.getModelConfig()
+rand_params = build_model(cfg).init(jax.random.PRNGKey(0),
+                                    np.zeros((1, 32, 32, 3), np.float32))
+acc_rand = transfer_accuracy(
+    TpuModel().setModelConfig(cfg).setModelParams(rand_params))
+
+print(f"transfer accuracy: pretrained {acc_pre:.3f} "
+      f"vs random-init {acc_rand:.3f}")
+assert acc_pre > 0.85, acc_pre
+assert acc_pre >= acc_rand, (acc_pre, acc_rand)
+server.shutdown()
 print("example 303 OK")

@@ -1,30 +1,33 @@
 """Example 305 — augmentation + featurization (reference: notebooks/samples/
 "305 - Flowers ImageFeaturizer": ImageSetAugmenter multiplies the training
 set with flips before DNN featurization + classifier training).
+
+The featurizer here is the committed zoo/ artifact (ResNet-20 pretrained on
+shapes10 — see tools/build_zoo.py and zoo/README.md), loaded through the
+ModelDownloader local-repo path; the classifier trains on its pooled
+embeddings of the augmented set.
 """
+
+import os
 
 import numpy as np
 
-import jax
 from mmlspark_tpu import DataFrame
 from mmlspark_tpu.core.schema import make_image_row
 from mmlspark_tpu.core.utils import object_column
-from mmlspark_tpu.models import (ImageFeaturizer, LogisticRegression,
-                                 TpuModel, build_model)
+from mmlspark_tpu.models import ImageFeaturizer, LogisticRegression
+from mmlspark_tpu.models.downloader import ModelDownloader
 from mmlspark_tpu.ops import ImageSetAugmenter
+from mmlspark_tpu.testing.datagen import make_shapes10
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 rng = np.random.default_rng(0)
-n = 48
-labels = rng.integers(0, 2, n)
-rows = []
-for i in range(n):
-    img = rng.integers(0, 90, (24, 24, 3))
-    half = slice(0, 12) if labels[i] == 0 else slice(12, 24)
-    img[half, :] += 120   # top-bright vs bottom-bright "flowers" — the
-    # class signal is invariant to the left-right flips the augmenter adds
-    rows.append(make_image_row(f"f{i}", 24, 24, 3, img.astype(np.uint8)))
-df = DataFrame({"image": object_column(rows),
-                "label": labels.astype(np.int64)})
+# a small 2-class "flowers" stand-in whose class signal is flip-invariant
+x, labels = make_shapes10(64, seed=5, num_classes=2, class_offset=0)
+rows = object_column([make_image_row(f"f{i}", 32, 32, 3, x[i])
+                      for i in range(len(x))])
+df = DataFrame({"image": rows, "label": labels})
 
 train, test = df.randomSplit([0.7, 0.3], seed=1)  # held-out BEFORE augment
 aug = (ImageSetAugmenter().setInputCol("image").setOutputCol("image")
@@ -33,14 +36,11 @@ augmented = aug.transform(train)
 print(f"augmentation: {train.count()} -> {augmented.count()} rows")
 assert augmented.count() == 2 * train.count()
 
-cfg = {"type": "convnet", "channels": [8, 16], "dense": 32,
-       "num_classes": 2, "height": 24, "width": 24}
-module = build_model(cfg)
-params = module.init(jax.random.PRNGKey(0),
-                     np.zeros((1, 24, 24, 3), np.float32))
+# pretrained backbone from the committed local model repo
+schema = ModelDownloader(os.path.join(REPO, "zoo")) \
+    .downloadByName("ResNet20", "shapes10")
 featurizer = (ImageFeaturizer().setInputCol("image").setOutputCol("features")
-              .setModel(TpuModel().setModelConfig(cfg).setModelParams(params))
-              .setCutOutputLayers(1))
+              .setModelSchema(schema).setCutOutputLayers(1))
 embedded = featurizer.transform(augmented)
 
 clf = LogisticRegression().setMaxIter(60).fit(embedded)
@@ -48,5 +48,5 @@ pred = clf.transform(featurizer.transform(test))  # held-out eval
 acc = float((np.asarray(pred.col("prediction"))
              == np.asarray(test.col("label"))).mean())
 print("accuracy:", round(acc, 3))
-assert acc > 0.8
+assert acc > 0.85
 print("example 305 OK")

@@ -47,14 +47,14 @@ class _Worker:
         self.proc = None
         self.pending_ack: list[str] = []   # ids appended, not yet acked
         if spawn:
-            import os
-            env = dict(os.environ)
+            # stderr -> DEVNULL: a PIPE nobody drains would block the
+            # worker once 64KB of warnings accumulate
             self.proc = subprocess.Popen(
                 [sys.executable, "-m", "mmlspark_tpu.io.http.worker",
                  "--host", host, "--port", str(port),
                  "--control-port", str(control_port)],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True, env=env)
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True)
             # bounded startup: a child that dies (or hangs) before printing
             # its ports must raise a real error, not block or JSON-crash
             box: dict = {}
@@ -65,15 +65,14 @@ class _Worker:
             reader.join(timeout=self.SPAWN_TIMEOUT)
             line = box.get("line", "")
             if not line:
-                err = ""
                 try:
                     self.proc.kill()
-                    err = (self.proc.stderr.read() or "")[-800:]
                 except Exception:
                     pass
                 raise RuntimeError(
                     f"serving worker failed to start (no port line within "
-                    f"{self.SPAWN_TIMEOUT:.0f}s): {err}")
+                    f"{self.SPAWN_TIMEOUT:.0f}s, exit "
+                    f"{self.proc.poll()})")
             info = json.loads(line)
             self.port, self.control = info["port"], info["control"]
         else:
@@ -177,7 +176,11 @@ class ProcessHTTPSource:
                 continue
             try:
                 rows = w.poll(256, self.poll_timeout)
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
+            except Exception as e:
+                # catch-all: a worker dying MID-RESPONSE raises
+                # http.client.IncompleteRead / JSONDecodeError, not just
+                # URLError — any escape here would kill the serving loop
+                # thread and strand every worker's clients.
                 # slow and dead look identical on one failed call; only a
                 # failed health check (or process exit) is a death verdict.
                 # A dead worker loses ONLY its own in-flight clients (their
@@ -241,9 +244,17 @@ class ProcessHTTPSource:
                 continue
             try:
                 w.respond(replies)
-            except (urllib.error.URLError, ConnectionError, OSError) as e:
-                log.warning("worker %d reply delivery failed: %s", wi, e)
-                w.alive = False
+            except Exception as e:
+                # same slow-vs-dead policy as the poll path: only a failed
+                # health check (or process exit) is a death verdict
+                if w.probably_dead():
+                    log.warning("worker %d dead during reply delivery: %s",
+                                wi, e)
+                    w.alive = False
+                else:
+                    log.warning("worker %d reply delivery failed (worker "
+                                "healthy; its clients will see their "
+                                "reply_timeout): %s", wi, e)
 
     def killWorker(self, i: int) -> None:
         """Hard-kill one worker process (failure-injection hook)."""

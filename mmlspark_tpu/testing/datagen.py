@@ -111,3 +111,74 @@ def generate_dataset(constraints: Optional[DatasetConstraints] = None,
     if with_label:
         cols["label"] = (rng.random(n) > 0.5).astype(np.float64)
     return DataFrame(cols)
+
+
+# ---------------------------------------------------------------- shapes10
+# Procedural image-classification corpus for the model zoo: zero-egress
+# environments can't fetch CIFAR, but a deterministic generator gives every
+# process the SAME distribution from a seed — so a pretrained artifact
+# (zoo/) remains meaningfully evaluable anywhere. 10 geometric classes
+# with randomized position/scale/colors/noise; generation is pure numpy.
+
+SHAPES10_CLASSES = ("circle", "square", "triangle", "cross", "hstripes",
+                    "vstripes", "ring", "diamond", "checker", "dots")
+
+
+def _shape_mask(cls: int, size: int, rng) -> np.ndarray:
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    cy = rng.uniform(size * 0.35, size * 0.65)
+    cx = rng.uniform(size * 0.35, size * 0.65)
+    r = rng.uniform(size * 0.18, size * 0.32)
+    dy, dx = yy - cy, xx - cx
+    if cls == 0:      # circle
+        return dy * dy + dx * dx <= r * r
+    if cls == 1:      # square
+        return (np.abs(dy) <= r) & (np.abs(dx) <= r)
+    if cls == 2:      # triangle
+        return (dy >= -r) & (dy <= r) & (np.abs(dx) <= (dy + r) * 0.6)
+    if cls == 3:      # cross
+        w = r * 0.35
+        return ((np.abs(dy) <= w) & (np.abs(dx) <= r)) | \
+               ((np.abs(dx) <= w) & (np.abs(dy) <= r))
+    if cls == 4:      # horizontal stripes
+        period = max(2.0, r * 0.8)
+        return ((yy / period).astype(np.int32) % 2 == 0)
+    if cls == 5:      # vertical stripes
+        period = max(2.0, r * 0.8)
+        return ((xx / period).astype(np.int32) % 2 == 0)
+    if cls == 6:      # ring
+        d2 = dy * dy + dx * dx
+        return (d2 <= r * r) & (d2 >= (0.55 * r) ** 2)
+    if cls == 7:      # diamond
+        return np.abs(dy) + np.abs(dx) <= r * 1.2
+    if cls == 8:      # checkerboard
+        period = max(2.0, r * 0.9)
+        return (((yy / period).astype(np.int32)
+                 + (xx / period).astype(np.int32)) % 2 == 0)
+    # dots grid
+    period = max(3.0, r * 0.9)
+    return (np.mod(yy, period) <= period * 0.4) & \
+        (np.mod(xx, period) <= period * 0.4) & \
+        (dy * dy + dx * dx <= (size * 0.45) ** 2)
+
+
+def make_shapes10(n: int, size: int = 32, num_classes: int = 10,
+                  seed: int = 0, class_offset: int = 0):
+    """(x uint8 (n, size, size, 3), y int64 (n,)) — the shapes10 corpus.
+
+    ``class_offset`` rotates which of the 10 shape families map to labels
+    (transfer-learning examples hold some families out of pretraining)."""
+    rng = np.random.default_rng(seed)
+    x = np.empty((n, size, size, 3), dtype=np.uint8)
+    y = rng.integers(0, num_classes, size=n)
+    for i in range(n):
+        cls = (int(y[i]) + class_offset) % len(SHAPES10_CLASSES)
+        bg = rng.uniform(0, 120, 3)
+        fg = rng.uniform(135, 255, 3)
+        if rng.random() < 0.5:
+            bg, fg = fg, bg
+        mask = _shape_mask(cls, size, rng)
+        img = np.where(mask[..., None], fg[None, None], bg[None, None])
+        img = img + rng.normal(0, 18, img.shape)
+        x[i] = np.clip(img, 0, 255).astype(np.uint8)
+    return x, y.astype(np.int64)

@@ -29,7 +29,8 @@ def test_example_runs(path):
     # the axon TPU plugin overrides env-var platform selection; the config
     # knob pins the example to the virtual CPU mesh (same trick as conftest)
     code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
-            f"exec(compile(open({path!r}).read(), {path!r}, 'exec'))")
+            f"exec(compile(open({path!r}).read(), {path!r}, 'exec'), "
+            f"{{'__file__': {path!r}, '__name__': '__main__'}})")
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, env=env, timeout=420, cwd=REPO)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])

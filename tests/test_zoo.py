@@ -1,0 +1,54 @@
+"""The committed model zoo (reference: the CDN repo of pretrained nets
+ModelDownloader serves, Schema.scala:54-72). Artifact built by
+tools/build_zoo.py on the TPU; held-out accuracy committed in zoo/README.md.
+Full transfer-learning E2E (HTTP remote + sha256 + beats-random-init) runs
+as examples e303/e305 in the extended tier."""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu.models.downloader import (LocalRepo, MANIFEST, ModelSchema)
+
+ZOO = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "zoo")
+
+
+@pytest.fixture(scope="module")
+def zoo_schema():
+    repo = LocalRepo(ZOO)
+    schemas = repo.listSchemas()
+    assert schemas, "zoo/ is empty — run tools/build_zoo.py"
+    return repo, schemas[0]
+
+
+def test_artifact_hash_verifies(zoo_schema):
+    repo, s = zoo_schema
+    blob = repo.getBytes(s)
+    s.assertMatchingHash(blob)              # sha256 gate (Schema.scala:34)
+    assert s.size == len(blob)
+    # a corrupted blob must fail the gate
+    with pytest.raises(ValueError, match="does not match"):
+        s.assertMatchingHash(blob[:-1] + bytes([blob[-1] ^ 1]))
+
+
+def test_manifest_lists_artifact(zoo_schema):
+    _, s = zoo_schema
+    with open(os.path.join(ZOO, MANIFEST)) as f:
+        names = f.read().split()
+    assert f"{s.name}_{s.dataset}.model.meta" in names
+
+
+def test_artifact_loads_with_matching_layers(zoo_schema):
+    from mmlspark_tpu.models import TpuModel
+    _, s = zoo_schema
+    tm = TpuModel().setModelSchema(s)
+    assert tm.layerNames() == list(s.layerNames)
+    assert s.numLayers == len(s.layerNames)
+    leaves = [np.asarray(a) for a in
+              __import__("jax").tree_util.tree_leaves(tm.getModelParams())]
+    assert all(np.isfinite(a).all() for a in leaves)
+    # trained weights, not an init: the head kernel can't be near-zero-norm
+    assert sum(float(np.abs(a).sum()) for a in leaves) > 100

@@ -1,0 +1,101 @@
+"""Build the committed model zoo: train the flagship net, pack, index.
+
+The reference ships a CDN repository of pretrained CNTK models with hashes
+and layerNames (downloader/.../Schema.scala:54-72, DefaultModelRepo at
+ModelDownloader.scala:109) that ImageFeaturizer consumes for transfer
+learning. This zero-egress build publishes its own: ResNet-20 trained on the
+procedurally generated shapes10 corpus (mmlspark_tpu.testing.datagen —
+deterministic from a seed, so the artifact is evaluable on any machine),
+packed as a .model zip and indexed with sha256 in ``zoo/`` (a LocalRepo
+directory that doubles as a RemoteRepo when served over HTTP: MANIFEST +
+metas + blobs).
+
+Run on a TPU host: ``python tools/build_zoo.py [--epochs 8] [--n 20000]``.
+Rewrites zoo/ and prints the held-out accuracy that goes into zoo/README.md.
+"""
+
+import argparse
+import hashlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20000)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--out", default=os.path.join(REPO, "zoo"))
+    args = ap.parse_args()
+
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.schema import make_image_row
+    from mmlspark_tpu.models import TpuLearner, TpuModel, build_model
+    from mmlspark_tpu.models.downloader import (LocalRepo, MANIFEST,
+                                                ModelSchema,
+                                                canonical_model_filename,
+                                                pack_model)
+    from mmlspark_tpu.testing.datagen import make_shapes10
+
+    x, y = make_shapes10(args.n, seed=7)
+    xv, yv = make_shapes10(4000, seed=8)
+
+    from mmlspark_tpu.core.utils import object_column
+
+    def frame(xa, ya):
+        rows = object_column([make_image_row(f"s{i}", 32, 32, 3, xa[i])
+                              for i in range(len(xa))])
+        return DataFrame({"image": rows, "label": ya})
+
+    cfg = {"type": "resnet", "num_classes": 10}
+    learner = (TpuLearner().setFeaturesCol("image")
+               .setModelConfig(cfg)
+               .setEpochs(args.epochs).setBatchSize(args.batch)
+               .setOptimizer("momentum").setLearningRate(0.05).setSeed(0))
+    model = learner.fit(frame(x, y))
+    out = model.setInputCol("image").transform(frame(xv, yv))
+    preds = np.stack(list(out.col("scores"))).argmax(axis=1)
+    acc = float((preds == yv).mean())
+    print(f"held-out accuracy: {acc:.4f} (final loss "
+          f"{model._final_loss:.4f})")
+
+    blob = pack_model(cfg, model.getModelParams())
+    module = build_model(cfg)
+    schema = ModelSchema(
+        name="ResNet20", dataset="shapes10", modelType="image",
+        hash=hashlib.sha256(blob).hexdigest(), size=len(blob),
+        numLayers=len(module.layer_names()),
+        layerNames=module.layer_names())
+    repo = LocalRepo(args.out)
+    repo.addBytes(schema, blob)
+    fn = canonical_model_filename(schema.name, schema.dataset)
+    with open(os.path.join(args.out, MANIFEST), "w") as f:
+        f.write(fn + ".meta\n")
+    with open(os.path.join(args.out, "README.md"), "w") as f:
+        f.write(
+            "# Model zoo\n\n"
+            "Pretrained artifacts served by `models.downloader` (LocalRepo "
+            "on this directory, or RemoteRepo over any static HTTP server "
+            "pointed here — MANIFEST + `.meta` schemas + `.model` blobs, "
+            "sha256-verified on every transfer).\n\n"
+            "| model | dataset | held-out acc | size | trained by |\n"
+            "|---|---|---|---|---|\n"
+            f"| ResNet20 | shapes10 (procedural, "
+            f"`testing.datagen.make_shapes10`) | {acc:.4f} | "
+            f"{len(blob)//1024} KiB | `tools/build_zoo.py --epochs "
+            f"{args.epochs} --n {args.n}` on 1x TPU v5e |\n\n"
+            "`ImageFeaturizer` consumes these for transfer learning "
+            "(examples e303/e305); `TpuModel.setModelSchema` serves them "
+            "directly.\n")
+    print(f"zoo written to {args.out}: {fn} ({len(blob)//1024} KiB), "
+          f"acc {acc:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
