@@ -116,11 +116,12 @@ def _wrap_rows(arr: np.ndarray, n_pad: int) -> np.ndarray:
     return np.concatenate([arr] * reps, axis=0)[:n_pad]
 
 
-def _scan_batch(bs: int, mesh) -> int:
+def _scan_batch(bs: int, mesh, micro: int = 1) -> int:
     """The scan path's device batch: requested batch rounded up to a
-    data-axis multiple (windows must shard evenly)."""
-    axis = mesh.shape["data"]
-    return -(-bs // axis) * axis
+    data-axis multiple (windows must shard evenly); pipeline runs also
+    need divisibility by microbatches x data axis."""
+    mult = mesh.shape["data"] * max(1, micro)
+    return -(-bs // mult) * mult
 
 
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
@@ -170,13 +171,35 @@ def _make_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float):
     return step_body
 
 
-def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float):
+def _make_pp_step_body(cfg: dict, mesh, tx, loss_fn, n_micro: int):
+    """Optimizer step whose forward runs the encoder stack as a GPipe
+    pipeline over the mesh's ``pipe`` axis (parallel.pipeline_parallel.
+    transformer_pp_forward); params keep the plain flax layout so
+    checkpoints/TpuModel reuse the tree unchanged."""
+    from ..parallel.pipeline_parallel import transformer_pp_forward
+
+    def step_body(params, opt_state, xb, yb, wb):
+        def compute(p):
+            preds = transformer_pp_forward(cfg, p, xb, mesh,
+                                           n_microbatches=n_micro)
+            losses = loss_fn(preds, yb)
+            return jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt2 = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt2, loss
+
+    return step_body
+
+
+def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
+                     step_body=None):
     """One jitted optimizer step (fitStream / multi-host feed path)."""
-    return jax.jit(_make_step_body(module, tx, loss_fn, is_moe, moe_aux))
+    return jax.jit(step_body or
+                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux))
 
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
-                        mesh, bs: int):
+                        mesh, bs: int, step_body=None):
     """A whole epoch of optimizer steps per XLA dispatch over
     DEVICE-RESIDENT data.
 
@@ -199,7 +222,8 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
     """
     from functools import partial
 
-    step_body = _make_step_body(module, tx, loss_fn, is_moe, moe_aux)
+    step_body = step_body or _make_step_body(module, tx, loss_fn, is_moe,
+                                             moe_aux)
     data_sh = meshlib.batch_sharding(mesh)
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -252,6 +276,11 @@ class TpuLearner(Estimator):
                          choices=("ring", "ulysses"))
     expertParallel = IntParam("size of the expert (EP) mesh axis (MoE "
                               "transformer only)", default=1, min=1)
+    pipelineParallel = IntParam(
+        "size of the pipeline (PP) mesh axis: the transformer's encoder "
+        "blocks split into stages run as a GPipe microbatch pipeline over "
+        "ppermute (transformer only; layers must divide by it)", default=1,
+        min=1)
     moeAuxWeight = FloatParam("weight of the MoE load-balancing aux loss",
                               default=0.01, min=0.0)
     haltOnNonFinite = BooleanParam(
@@ -310,10 +339,15 @@ class TpuLearner(Estimator):
         tp = self.getTensorParallel()
         sp = self.getSequenceParallel()
         ep = self.getExpertParallel()
+        pp = self.getPipelineParallel()
         attn_fn = None
         if sp > 1 and ep > 1:
             raise ValueError("sequenceParallel and expertParallel cannot both "
                              "exceed 1 (compose dp x sp or dp x ep meshes)")
+        if pp > 1 and (sp > 1 or ep > 1 or tp > 1):
+            raise ValueError("pipelineParallel currently composes with data "
+                             "parallelism only (dp x pp mesh); run tp/sp/ep "
+                             "without pp")
         if sp > 1:
             if cfg.get("type") != "transformer":
                 raise ValueError("sequenceParallel>1 requires a transformer "
@@ -346,6 +380,25 @@ class TpuLearner(Estimator):
                     f"the device count ({n_dev})")
             mesh = meshlib.make_mesh({"data": n_dev // (ep * tp),
                                       "expert": ep, "model": tp})
+        elif pp > 1:
+            if cfg.get("type") != "transformer":
+                raise ValueError("pipelineParallel>1 requires a transformer "
+                                 f"model, got {cfg.get('type')!r}")
+            if cfg.get("num_experts", 0) > 0:
+                raise ValueError("pipelineParallel with MoE blocks is not "
+                                 "supported (expert routing state does not "
+                                 "pipeline); use expertParallel instead")
+            if cfg.get("layers", 2) % pp != 0:
+                raise ValueError(f"layers ({cfg.get('layers', 2)}) must be "
+                                 f"divisible by pipelineParallel ({pp})")
+            n_dev = len(jax.devices())
+            if n_dev % pp != 0:
+                raise ValueError(f"pipelineParallel ({pp}) must divide the "
+                                 f"device count ({n_dev})")
+            if jax.process_count() > 1:
+                raise ValueError("pipelineParallel is single-host (see the "
+                                 "multi-host scope note below)")
+            mesh = meshlib.make_mesh({"data": n_dev // pp, "pipe": pp})
         else:
             mesh = meshlib.create_mesh(model=tp)
         module = build_model(cfg, attn_fn=attn_fn)
@@ -392,17 +445,19 @@ class TpuLearner(Estimator):
         bs = max(1, bs_global // nproc)
         steps = max(1, n_global // (bs * nproc))
 
+        pp_body = (None if pp <= 1 else
+                   _make_pp_step_body(cfg, mesh, tx, loss_fn, n_micro=pp))
         train_step = None
         scan_fn = None
         if nproc == 1 and x.nbytes + y.nbytes <= _DEVICE_DATA_CAP:
-            scan_fn = _make_scan_epoch_fn(module, tx, loss_fn, is_moe,
-                                          moe_aux, mesh,
-                                          _scan_batch(bs_global, mesh))
+            scan_fn = _make_scan_epoch_fn(
+                module, tx, loss_fn, is_moe, moe_aux, mesh,
+                _scan_batch(bs_global, mesh, pp), step_body=pp_body)
         else:
             # multi-host (per-process shards feed put_global_batch) or a
             # dataset too big for HBM residency: per-step host feed
             train_step = _make_train_step(module, tx, loss_fn, is_moe,
-                                          moe_aux)
+                                          moe_aux, step_body=pp_body)
         rng_np = np.random.default_rng(self.getSeed() + jax.process_index())
         start_epoch = 0
         resume = self._latest_checkpoint()
@@ -466,10 +521,12 @@ class TpuLearner(Estimator):
         """
         cfg = dict(self.getModelConfig())
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
+                or self.getPipelineParallel() > 1
                 or jax.process_count() > 1):
             raise ValueError(
                 "fitStream is single-host data(+tensor)-parallel; use "
-                "fit() for sequence/expert parallelism or multi-host")
+                "fit() for sequence/expert/pipeline parallelism or "
+                "multi-host")
         tp = self.getTensorParallel()
         mesh = meshlib.create_mesh(model=tp)
         first_iter = iter(batches_fn())
@@ -560,6 +617,7 @@ class TpuLearner(Estimator):
         for epoch in range(start_epoch, self.getEpochs()):
             order = (order_rng.permutation(n) if self.getShuffle()
                      else np.arange(n))
+            micro = self.getPipelineParallel()
             for s in range(steps):
                 # cyclic slice: a process whose shard is shorter than its
                 # share of the global batch wraps (repeats) its rows so every
@@ -569,6 +627,11 @@ class TpuLearner(Estimator):
                        else meshlib.pad_batch_to_devices)
                 xb, nb = pad(x[idx], mesh)
                 yb, _ = pad(y[idx], mesh)
+                if micro > 1:
+                    # pipeline steps also need microbatch divisibility
+                    tgt = _scan_batch(len(xb), mesh, micro)
+                    xb = _wrap_rows(xb, tgt)
+                    yb = _wrap_rows(yb, tgt)
                 wb = np.zeros(len(xb), dtype=np.float32)
                 wb[:nb] = 1.0
                 xb = meshlib.put_global_batch(xb, mesh)
@@ -598,7 +661,7 @@ class TpuLearner(Estimator):
         ``steps*bs_pad`` rows, pad rows weight 0) and every epoch is one
         XLA dispatch — a random rotation plus a random permutation of the
         contiguous bs-sized windows, scanned with donated state."""
-        bs_pad = _scan_batch(bs, mesh)
+        bs_pad = _scan_batch(bs, mesh, self.getPipelineParallel())
         # ceil instead of the feed path's floor: window tiling must cover
         # every row (the feed path re-slices a fresh permutation per step;
         # here rows outside the tiling would never be seen)

@@ -99,3 +99,66 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh,
                         in_specs=(p_spec, x_spec), out_specs=out_spec,
                         check_vma=False)(stacked_params, x_mb)
     return out.reshape(N, *out.shape[2:])
+
+
+def transformer_pp_forward(cfg: dict, params, tokens, mesh: Mesh,
+                           n_microbatches: int = None,
+                           axis_name: str = "pipe",
+                           batch_axis: str = "data"):
+    """Forward pass of the transformer family with its encoder-block stack
+    run as a GPipe pipeline over the ``pipe`` mesh axis.
+
+    This is how ``TpuLearner.setPipelineParallel(k)`` trains: the embed and
+    head (a few % of the FLOPs) run replicated across the pipe axis, the L
+    encoder blocks split into ``k`` stages of L/k blocks each, and
+    microbatch activations hop stage-to-stage over ``ppermute`` — one
+    differentiable jitted program, so ``jax.grad`` of a loss on these
+    logits yields the full pipelined backward with no hand-written
+    schedule. ``params`` keeps the ORIGINAL flax layout (block subtrees are
+    stacked inside the trace), so the optimizer, checkpoints, and TpuModel
+    inference reuse the fitted tree unchanged.
+    """
+    import flax.linen as nn
+
+    from ..models.modules import build_model
+
+    enc = build_model(cfg)          # field access only (dtype, dims, attn)
+    L, pp = enc.layers, mesh.shape[axis_name]
+    if L % pp != 0:
+        raise ValueError(f"layers ({L}) must divide by the pipe axis ({pp})")
+    p = params["params"] if "params" in params else params
+    B, T = tokens.shape
+    emb = nn.Embed(enc.vocab_size, enc.d_model, dtype=enc.dtype).apply(
+        {"params": p["Embed_0"]}, tokens)
+    pos = nn.Embed(enc.max_len, enc.d_model, dtype=enc.dtype).apply(
+        {"params": p["Embed_1"]}, jnp.arange(T)[None, :])
+    h = (emb + pos).astype(enc.dtype)
+
+    # stage j = blocks [j*k, (j+1)*k): leaf shapes (pp, k, ...)
+    k = L // pp
+    stages = [stack_stage_params([p[f"block{j * k + i}"] for i in range(k)])
+              for j in range(pp)]
+    stacked = stack_stage_params(stages)
+
+    from ..models.modules import _EncoderBlock
+    Block = nn.remat(_EncoderBlock) if enc.remat else _EncoderBlock
+    block = Block(d_model=enc.d_model, heads=enc.heads,
+                  mlp_ratio=enc.mlp_ratio, dtype=enc.dtype,
+                  attention=enc._attention)
+
+    def stage_fn(stage_params, hm):
+        def body(hc, blk_p):
+            return block.apply({"params": blk_p}, hc), None
+        out, _ = lax.scan(body, hm, stage_params)
+        return out
+
+    h = pipeline_apply(stage_fn, stacked, h, mesh, axis_name=axis_name,
+                       n_microbatches=n_microbatches or pp,
+                       batch_axis=batch_axis)
+    h = nn.LayerNorm(dtype=enc.dtype).apply(
+        {"params": p["LayerNorm_0"]}, h)
+    if enc.pool == "mean":
+        h = jnp.mean(h, axis=1)
+    logits = nn.Dense(enc.num_classes, dtype=enc.dtype).apply(
+        {"params": p["Dense_0"]}, h)
+    return logits.astype(jnp.float32)

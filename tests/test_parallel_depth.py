@@ -622,3 +622,54 @@ def test_dead_worker_detected_between_collectives(tmp_path):
     assert "SECOND_COLLECTIVE_UNEXPECTEDLY_OK" not in out0, out0
     assert procs[0].returncode != 0, (out0, err0[-800:])
     assert elapsed < 100, f"survivor took {elapsed:.0f}s to notice the death"
+
+
+@pytest.mark.extended
+def test_learner_pipeline_parallel_matches_sequential():
+    """setPipelineParallel trains the transformer's block stack as a GPipe
+    pipeline (dp x pp mesh) and must land where the sequential trainer
+    lands — the pipelined program computes the same function."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    rng = np.random.default_rng(0)
+    n, T, V = 128, 16, 40
+    toks = rng.integers(0, V, size=(n, T))
+    y = (toks[:, :4].sum(axis=1) > 2 * V).astype(np.int64)
+    df = DataFrame({"features": object_column(
+        [t.astype(np.float32) for t in toks]), "label": y})
+    cfg = {"type": "transformer", "vocab_size": V, "d_model": 16,
+           "heads": 2, "layers": 4, "num_classes": 2, "max_len": T,
+           "attn_impl": "blockwise"}
+    base = dict(modelConfig=cfg, epochs=4, batchSize=64,
+                learningRate=0.01, optimizer="adam", seed=0)
+    m_pp = TpuLearner().set(pipelineParallel=4, **base).fit(df)
+    m_sq = TpuLearner().set(**base).fit(df)
+    assert np.isfinite(m_pp._final_loss)
+    # same data plan + same init => closely matching loss trajectories
+    assert abs(m_pp._final_loss - m_sq._final_loss) < 0.05, \
+        (m_pp._final_loss, m_sq._final_loss)
+    out = m_pp.transform(df)  # fitted tree serves through plain TpuModel
+    assert len(out.col("scores")) == n
+
+
+def test_learner_pp_validation():
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    rng = np.random.default_rng(0)
+    df = DataFrame({"features": object_column(
+        [r for r in rng.normal(size=(8, 6)).astype(np.float32)]),
+        "label": rng.integers(0, 2, 8).astype(np.int64)})
+    with pytest.raises(ValueError, match="transformer"):
+        TpuLearner().set(modelConfig={"type": "mlp", "num_classes": 2},
+                         pipelineParallel=2, epochs=1).fit(df)
+    cfg = {"type": "transformer", "vocab_size": 9, "layers": 3,
+           "d_model": 8, "heads": 2, "num_classes": 2, "max_len": 8}
+    with pytest.raises(ValueError, match="divisible"):
+        TpuLearner().set(modelConfig=cfg, pipelineParallel=2,
+                         epochs=1).fit(df)
+    with pytest.raises(ValueError, match="data parallelism only"):
+        TpuLearner().set(modelConfig=dict(cfg, layers=2),
+                         pipelineParallel=2, tensorParallel=2,
+                         epochs=1).fit(df)
