@@ -88,6 +88,14 @@ class DataFrame:
         return DataFrame(data, npartitions=npartitions)
 
     @staticmethod
+    def fromArrowStream(source) -> "DataFrame":
+        """Materialize an Arrow record-batch stream (reader, table, batch
+        iterable, or IPC file path) — columnar all the way, no Python rows
+        (io.arrow; the streaming forms there feed fitStream out-of-core)."""
+        from ..io.arrow import frame_from_arrow_stream
+        return frame_from_arrow_stream(source)
+
+    @staticmethod
     def fromRows(rows: Sequence[dict], npartitions: int = 1) -> "DataFrame":
         if not rows:
             return DataFrame({})

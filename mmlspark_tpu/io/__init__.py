@@ -1,7 +1,9 @@
 """IO layer (reference: src/io). `readImages`/`readBinaryFiles` mirror the
 reference's session implicits (io/src/main/scala/Readers.scala:14-45)."""
 
-from . import binary, csv, http, image, loader, powerbi
+from . import arrow, binary, csv, http, image, loader, powerbi
+from .arrow import (arrow_feature_batches, arrow_frames,
+                    batch_to_matrix, frame_from_arrow_stream)
 from .binary import read_binary_files, recurse_path
 from .csv import read_csv, read_csv_matrix
 from .image import decode_image, read_images, write_images

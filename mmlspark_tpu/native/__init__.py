@@ -68,6 +68,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
     lib.mmltpu_csv_parse.restype = ctypes.c_int
+    fpp = ctypes.POINTER(ctypes.POINTER(ctypes.c_float))
+    lib.mmltpu_interleave_f32.argtypes = [
+        fpp, ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int]
+    lib.mmltpu_interleave_f32.restype = None
     return lib
 
 
@@ -222,3 +227,34 @@ def read_csv(path: str, skip_header: bool = False, delim: str = ",",
     finally:
         lib.mmltpu_free(out)
     return mat.reshape(rows.value, cols.value)
+
+
+def interleave_f32(cols: list, out: np.ndarray,
+                   threads: int = 0) -> bool:
+    """Columnar float32 arrays -> row-major ``out`` (n, d) staging matrix
+    via the threaded cache-blocked C++ transpose (the Arrow->device bridge;
+    replaces the reference's per-element JNI copies,
+    CNTKModel.scala:67-74). Returns False without the native lib — callers
+    fall back to np.stack."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    n, d = out.shape
+    if len(cols) != d:
+        raise ValueError(f"{len(cols)} columns for a {d}-wide output")
+    if out.dtype != np.float32 or not out.flags.c_contiguous:
+        raise TypeError("output must be C-contiguous float32")
+    fp = ctypes.POINTER(ctypes.c_float)
+    ptrs = (fp * d)()
+    for j, c in enumerate(cols):
+        # real raises, not asserts: python -O must not hand C++ bad buffers
+        if c.dtype != np.float32 or not c.flags.c_contiguous:
+            raise TypeError(f"column {j} must be contiguous float32, "
+                            f"got {c.dtype}")
+        if len(c) != n:
+            raise ValueError(f"column {j} has {len(c)} rows, output {n}")
+        ptrs[j] = c.ctypes.data_as(fp)
+    if threads <= 0:
+        threads = min(8, os.cpu_count() or 1)
+    lib.mmltpu_interleave_f32(ptrs, d, n, out.ctypes.data_as(fp), threads)
+    return True
