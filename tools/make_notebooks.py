@@ -1,0 +1,253 @@
+"""Emit the sample notebooks (reference: notebooks/samples/*.ipynb).
+
+The reference ships executable notebooks as its user-facing documentation
+and runs them in CI via an nbconvert harness (tools/notebook/tester/
+NotebookTestSuite.py). This script writes the TPU-native analogs into
+``notebooks/`` as real .ipynb artifacts (committed); the runner is
+tests/test_notebooks.py (extended tier).
+
+Regenerate with ``python tools/make_notebooks.py`` after editing the cell
+sources below.
+"""
+
+import os
+import sys
+
+import nbformat as nbf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "notebooks")
+
+#: first cell of every notebook: pin the 8-device virtual CPU mesh before
+#: any jax import (same trick as tests/conftest.py) and put the repo on the
+#: path regardless of the kernel's cwd
+BOOTSTRAP = """\
+import os, sys
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+for up in (".", ".."):
+    cand = os.path.abspath(up)
+    if os.path.isdir(os.path.join(cand, "mmlspark_tpu")):
+        sys.path.insert(0, cand)
+        break
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+print("devices:", len(jax.devices()), jax.default_backend())"""
+
+
+def nb(title: str, *cells):
+    book = nbf.v4.new_notebook()
+    book.metadata["kernelspec"] = {"name": "python3",
+                                   "display_name": "Python 3",
+                                   "language": "python"}
+    book.cells = [nbf.v4.new_markdown_cell(f"# {title}"),
+                  nbf.v4.new_code_cell(BOOTSTRAP)]
+    for c in cells:
+        kind, src = c
+        book.cells.append(nbf.v4.new_markdown_cell(src) if kind == "md"
+                          else nbf.v4.new_code_cell(src))
+    return book
+
+
+md = lambda s: ("md", s)
+code = lambda s: ("code", s)
+
+
+N103 = nb(
+    "103 - Before and After mmlspark_tpu",
+    md("The reference notebook contrasts a hand-assembled Spark ML pipeline "
+       "with the one-stage MMLSpark flow (`notebooks/samples/103`). Same "
+       "story here: **before** — index categoricals, assemble features, "
+       "fit, score, and compute metrics by hand; **after** — "
+       "`TrainClassifier` + `ComputeModelStatistics` do all of it."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+n = 400
+education = np.array(["HS", "BSc", "MSc", "PhD"], dtype=object)[
+    rng.integers(0, 4, n)]
+hours = rng.integers(20, 60, n).astype(np.float64)
+age = rng.integers(18, 70, n).astype(np.float64)
+income = ((hours > 42) & (education != "HS")) ^ (rng.random(n) < 0.1)
+df = DataFrame({"education": education, "hours": hours, "age": age,
+                "income": income.astype(np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)
+train.count(), test.count()"""),
+    md("## Before: every step by hand"),
+    code("""\
+from mmlspark_tpu.automl import ValueIndexer
+from mmlspark_tpu.stages import FastVectorAssembler
+from mmlspark_tpu.models import LogisticRegression
+
+vi = ValueIndexer().setInputCol("education").setOutputCol("edu_idx") \\
+    .fit(train)
+asm = FastVectorAssembler().setInputCols(("edu_idx", "hours", "age")) \\
+    .setOutputCol("features")
+prep = lambda d: asm.transform(vi.transform(d))
+lr_model = (LogisticRegression().setLabelCol("income")
+            .setMaxIter(120).fit(prep(train)))
+scored = lr_model.transform(prep(test))
+manual_acc = float((np.asarray(scored.col("prediction"))
+                    == np.asarray(test.col("income"))).mean())
+print("manual pipeline accuracy:", round(manual_acc, 3))"""),
+    md("## After: one estimator"),
+    code("""\
+from mmlspark_tpu.automl import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.models import LogisticRegression
+
+model = (TrainClassifier().setLabelCol("income")
+         .setModel(LogisticRegression().setMaxIter(120)).fit(train))
+out = model.transform(test)
+stats = (ComputeModelStatistics().setLabelCol("income")
+         .setScoredLabelsCol("scored_labels").transform(out))
+auto_acc = float(stats.col("accuracy")[0])
+print("TrainClassifier accuracy:", round(auto_acc, 3))
+assert auto_acc > 0.75 and manual_acc > 0.7
+print("103 OK")"""))
+
+
+N104 = nb(
+    "104 - Price Prediction Regression (Auto Imports)",
+    md("Analog of `notebooks/samples/104`: the Auto Imports car dataset — "
+       "mixed numeric/categorical columns with missing values — cleaned "
+       "with `CleanMissingData`, auto-featurized inside `TrainRegressor`, "
+       "and two learners compared with `ComputePerInstanceStatistics`."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(1)
+n = 360
+make = np.array(["toyota", "bmw", "audi", "mazda"], dtype=object)[
+    rng.integers(0, 4, n)]
+horsepower = rng.uniform(60, 260, n)
+weight = rng.uniform(800, 2400, n)
+price = (90 * horsepower + 12 * weight
+         + 4000 * (make == "bmw") + 3000 * (make == "audi")
+         + rng.normal(0, 900, n))
+horsepower[rng.random(n) < 0.12] = np.nan      # the dataset's famous '?'s
+df = DataFrame({"make": make, "horsepower": horsepower,
+                "weight": weight, "price": price})
+df.count()"""),
+    code("""\
+from mmlspark_tpu.stages import CleanMissingData
+clean = CleanMissingData().setInputCols(("horsepower",)) \\
+    .setCleaningMode("Mean").fit(df)
+dfc = clean.transform(df)
+assert not np.isnan(np.asarray(dfc.col("horsepower"))).any()
+train, test = dfc.randomSplit([0.8, 0.2], seed=2)"""),
+    code("""\
+from mmlspark_tpu.automl import ComputePerInstanceStatistics, TrainRegressor
+from mmlspark_tpu.models import GBTRegressor, LinearRegression
+
+results = {}
+for name, algo in [("linear", LinearRegression()),
+                   ("gbt", GBTRegressor().setNumIterations(30))]:
+    model = TrainRegressor().setLabelCol("price").setModel(algo).fit(train)
+    out = model.transform(test)
+    per = (ComputePerInstanceStatistics().setLabelCol("price")
+           .setEvaluationMetric("regression").transform(out))
+    rmse = float(np.sqrt(np.mean(np.asarray(per.col("L2_loss")))))
+    results[name] = rmse
+    print(name, "RMSE:", round(rmse, 1))
+base = float(np.std(np.asarray(test.col("price"))))
+assert min(results.values()) < 0.5 * base
+print("104 OK")"""))
+
+
+N105 = nb(
+    "105 - Regression with DataConversion",
+    md("Analog of `notebooks/samples/105`: columns arrive as STRINGS (the "
+       "raw CSV reality); `DataConversion` casts them to typed columns and "
+       "tags a categorical before `TrainRegressor` runs."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(2)
+n = 320
+rooms = rng.integers(1, 8, n)
+sqm = rng.uniform(25, 180, n)
+zone = np.array(["A", "B", "C"], dtype=object)[rng.integers(0, 3, n)]
+rent = 9 * sqm + 120 * rooms + 300 * (zone == "A") + rng.normal(0, 80, n)
+df = DataFrame({  # everything stringly-typed, like a raw CSV
+    "rooms": np.array([str(v) for v in rooms], dtype=object),
+    "sqm": np.array([f"{v:.1f}" for v in sqm], dtype=object),
+    "zone": zone,
+    "rent": rent})
+print(df.dtypes())"""),
+    code("""\
+from mmlspark_tpu.stages import DataConversion
+df2 = DataConversion().setCols(("rooms",)).setConvertTo("integer") \\
+    .transform(df)
+df2 = DataConversion().setCols(("sqm",)).setConvertTo("double") \\
+    .transform(df2)
+df2 = DataConversion().setCols(("zone",)).setConvertTo("toCategorical") \\
+    .transform(df2)
+assert df2.col("rooms").dtype.kind == "i"
+assert df2.col("sqm").dtype.kind == "f"
+from mmlspark_tpu.core.schema import CategoricalUtilities
+assert CategoricalUtilities.getLevels(df2, "zone") is not None
+print(df2.dtypes())"""),
+    code("""\
+from mmlspark_tpu.automl import ComputeModelStatistics, TrainRegressor
+from mmlspark_tpu.models import GBTRegressor
+train, test = df2.randomSplit([0.8, 0.2], seed=3)
+model = (TrainRegressor().setLabelCol("rent")
+         .setModel(GBTRegressor().setNumIterations(40)).fit(train))
+out = model.transform(test)
+stats = (ComputeModelStatistics().setLabelCol("rent")
+         .setEvaluationMetric("regression").transform(out))
+rmse = float(stats.col("rmse")[0])
+print("RMSE:", round(rmse, 1))
+assert rmse < 0.6 * float(np.std(np.asarray(test.col("rent"))))
+print("105 OK")"""))
+
+
+N302 = nb(
+    "302 - Pipeline Image Transformations",
+    md("Analog of `notebooks/samples/302`: chained image ops — resize, "
+       "crop, flip, blur — as ONE `ImageTransformer` stage (the reference "
+       "runs an OpenCV stage list per row; here the chain compiles to one "
+       "fused XLA program per shape bucket), then `UnrollImage` for "
+       "downstream learners."),
+    code("""\
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.testing.datagen import make_shapes10
+x, y = make_shapes10(24, size=48, seed=3)
+rows = object_column([make_image_row(f"img{i}", 48, 48, 3, x[i])
+                      for i in range(len(x))])
+df = DataFrame({"image": rows, "label": y})
+df.count()"""),
+    code("""\
+from mmlspark_tpu.ops import ImageTransformer
+it = (ImageTransformer().setInputCol("image").setOutputCol("proc")
+      .resize(36, 36).crop(2, 2, 32, 32).flip(1).blur(3, 3))
+out = it.transform(df)
+first = out.col("proc")[0]
+print("processed:", first["height"], "x", first["width"])
+assert (first["height"], first["width"]) == (32, 32)"""),
+    code("""\
+from mmlspark_tpu.ops.image_stages import UnrollImage
+un = UnrollImage().setInputCol("proc").setOutputCol("features")
+flat = un.transform(out)
+vec = flat.col("features")[0]
+print("unrolled dim:", vec.shape)
+assert vec.shape == (32 * 32 * 3,)
+print("302 OK")"""))
+
+
+def main() -> int:
+    os.makedirs(OUT, exist_ok=True)
+    books = {"103_before_and_after.ipynb": N103,
+             "104_price_prediction_auto_imports.ipynb": N104,
+             "105_regression_with_dataconversion.ipynb": N105,
+             "302_pipeline_image_transformations.ipynb": N302}
+    for name, book in books.items():
+        path = os.path.join(OUT, name)
+        nbf.write(book, path)
+        print("wrote", path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
