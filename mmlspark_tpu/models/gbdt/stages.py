@@ -79,14 +79,13 @@ class _BoosterParams:
     def _engine_params(self, objective: str, num_class: int = 1,
                        alpha: float = 0.9,
                        categorical: tuple = ()) -> engine.GBDTParams:
-        leafwise = self.getOrDefault("growthPolicy") == "leafwise"
-        if leafwise and self._tree_learner() == "feature":
+        leafwise = self._effective_leafwise()
+        if not leafwise and self.getOrDefault("growthPolicy") == "leafwise":
             # feature-parallel split candidates are level-wise only
             from ...core.utils import get_logger
             get_logger("gbdt").warning(
                 "growthPolicy=leafwise is unavailable with "
                 "feature_parallel; using depthwise growth")
-            leafwise = False
         if categorical and not leafwise:
             if self.getOrDefault("categoricalSlotIndexes"):
                 raise ValueError(
@@ -120,6 +119,13 @@ class _BoosterParams:
             seed=self.getOrDefault("seed"),
             tree_learner=self._tree_learner())
 
+    def _effective_leafwise(self) -> bool:
+        """The ONE place the growth decision lives: leaf-wise unless the
+        user chose depthwise or a feature-parallel learner (whose split
+        candidates are level-wise only)."""
+        return (self.getOrDefault("growthPolicy") == "leafwise"
+                and self._tree_learner() != "feature")
+
     def _tree_learner(self) -> str:
         return {"data_parallel": "data", "voting_parallel": "data",
                 "feature_parallel": "feature",
@@ -138,6 +144,54 @@ class _BoosterParams:
         if not explicit and n_rows is not None and n_rows < 8192:
             return None
         return meshlib.create_mesh()
+
+
+def _prepare_fit_features(stage, df):
+    """Feature matrix for a booster fit. Narrow/dense inputs pass through;
+    wide sparse inputs keep the maxDenseFeatures densest columns numeric
+    and BUNDLE the tail into categorical composites (EFB-lite, efb.py) when
+    the growth mode supports category-set splits — round 1 truncated the
+    tail entirely. Returns (x, selection, bundles, bundle_cat_ids)."""
+    mat = rows_to_matrix(df.col(stage.getFeaturesCol()))
+    if hasattr(mat, "tocsc"):
+        mat = mat.tocsc()
+    cap = stage.getMaxDenseFeatures()
+    if hasattr(mat, "tocsc") and mat.shape[1] > cap \
+            and stage._effective_leafwise():
+        from .efb import apply_bundles, plan_and_split
+        dense, bundles = plan_and_split(mat, cap,
+                                        stage.getOrDefault("maxBin"),
+                                        stage.getOrDefault("seed"))
+        xd = _densify(mat, dense)
+        if not bundles:
+            return xd, dense, None, ()
+        xb = apply_bundles(mat, bundles)
+        from ...core.utils import get_logger
+        get_logger("gbdt").info(
+            "EFB: %d sparse tail columns bundled into %d categorical "
+            "composites (+%d dense)", sum(len(b) for b in bundles),
+            len(bundles), len(dense))
+        x = np.concatenate([xd, xb], axis=1)
+        return (x, dense, bundles,
+                tuple(range(xd.shape[1], x.shape[1])))
+    sel = _select_features(mat, cap)
+    return _densify(mat, sel), sel, None, ()
+
+
+def _predict_features(df, col, selection, bundles) -> np.ndarray:
+    """Transform-time twin of _prepare_fit_features for a fitted model."""
+    if not bundles:
+        return _features_matrix(df, col, selection)
+    from .efb import apply_bundles
+    mat = rows_to_matrix(df.col(col))
+    if not hasattr(mat, "tocsc"):
+        import scipy.sparse as sp
+        mat = sp.csc_matrix(np.asarray(mat))
+    else:
+        mat = mat.tocsc()
+    xd = _densify(mat, selection)
+    xb = apply_bundles(mat, [np.asarray(b) for b in bundles])
+    return np.concatenate([xd, xb], axis=1)
 
 
 def _densify(mat, selection=None) -> np.ndarray:
@@ -272,13 +326,17 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)
+    featureBundles = ComplexParam(
+        "EFB bundles: tail sparse columns per categorical composite",
+        default=None)
 
     def _ensemble(self):
         return _state_to_ensemble(self.getBoosterState(), self.getObjective())
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = _features_matrix(df, self.getFeaturesCol(),
-                             self.getFeatureSelection())
+        x = _predict_features(df, self.getFeaturesCol(),
+                              self.getFeatureSelection(),
+                              self.getFeatureBundles())
         ens = self._ensemble()
         raw = engine.predict_raw(ens, x)
         prob = engine.prob_from_raw(ens.objective, raw)
@@ -299,11 +357,7 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
     """Binary/multiclass boosted trees (reference: LightGBMClassifier.scala:32)."""
 
     def fit(self, df: DataFrame) -> LightGBMClassificationModel:
-        mat = rows_to_matrix(df.col(self.getFeaturesCol()))
-        if hasattr(mat, "tocsc"):
-            mat = mat.tocsc()  # once; the helpers' tocsc() are then no-ops
-        sel = _select_features(mat, self.getMaxDenseFeatures())
-        x = _densify(mat, sel)
+        x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
         y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
         classes = np.unique(y.astype(np.int64))
         if not np.array_equal(classes, np.arange(len(classes))) or \
@@ -317,11 +371,12 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
                                   self.getCategoricalSlotIndexes(), sel)
         ens = _fit_ensemble(self, x, y, objective,
                             num_class=(num_class if objective == "multiclass" else 1),
-                            categorical=cats)
+                            categorical=tuple(cats) + bundle_cats)
         return (LightGBMClassificationModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(objective)
                 .setFeatureSelection(sel)
+                .setFeatureBundles(bundles)
                 .setBoosterState(_ensemble_to_state(ens)))
 
 
@@ -331,10 +386,14 @@ class LightGBMRegressionModel(Model, HasFeaturesCol):
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)
+    featureBundles = ComplexParam(
+        "EFB bundles: tail sparse columns per categorical composite",
+        default=None)
 
     def transform(self, df: DataFrame) -> DataFrame:
-        x = _features_matrix(df, self.getFeaturesCol(),
-                             self.getFeatureSelection())
+        x = _predict_features(df, self.getFeaturesCol(),
+                              self.getFeatureSelection(),
+                              self.getFeatureBundles())
         ens = _state_to_ensemble(self.getBoosterState(), self.getObjective())
         pred = engine.predict(ens, x).astype(np.float64)
         out = df.withColumn(self.getPredictionCol(), pred)
@@ -352,18 +411,16 @@ class LightGBMRegressor(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams):
     alpha = FloatParam("quantile level", default=0.9, min=0.0, max=1.0)
 
     def fit(self, df: DataFrame) -> LightGBMRegressionModel:
-        mat = rows_to_matrix(df.col(self.getFeaturesCol()))
-        if hasattr(mat, "tocsc"):
-            mat = mat.tocsc()  # once; the helpers' tocsc() are then no-ops
-        sel = _select_features(mat, self.getMaxDenseFeatures())
-        x = _densify(mat, sel)
+        x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
         y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
         cats = _categorical_slots(df, self.getFeaturesCol(),
                                   self.getCategoricalSlotIndexes(), sel)
         ens = _fit_ensemble(self, x, y, self.getApplication(),
-                            alpha=self.getAlpha(), categorical=cats)
+                            alpha=self.getAlpha(),
+                            categorical=tuple(cats) + bundle_cats)
         return (LightGBMRegressionModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(self.getApplication())
                 .setFeatureSelection(sel)
+                .setFeatureBundles(bundles)
                 .setBoosterState(_ensemble_to_state(ens)))

@@ -8,6 +8,8 @@
 #   ZONE     default us-central1-a
 #   TYPE     default v5litepod-8   (one host, 8 chips — the bench target)
 #   VERSION  default tpu-ubuntu2204-base
+#   MMLTPU_DRYRUN=1 prints the gcloud commands instead of executing them
+#   (what CI runs; no gcloud credentials needed).
 set -euo pipefail
 
 NAME="${1:?usage: tpu-vm-setup.sh NAME [ZONE] [TYPE] [VERSION]}"
@@ -15,13 +17,21 @@ ZONE="${2:-us-central1-a}"
 TYPE="${3:-v5litepod-8}"
 VERSION="${4:-tpu-ubuntu2204-base}"
 
-gcloud compute tpus tpu-vm create "$NAME" \
+run() {
+  if [ -n "${MMLTPU_DRYRUN:-}" ]; then
+    printf 'DRYRUN:'; printf ' %q' "$@"; printf '\n'
+  else
+    "$@"
+  fi
+}
+
+run gcloud compute tpus tpu-vm create "$NAME" \
   --zone="$ZONE" --accelerator-type="$TYPE" --version="$VERSION"
 
 # install the framework on every host of the slice (multi-host slices run
 # the same command on each worker; the MMLTPU_* env contract in
 # mmlspark_tpu.parallel.distributed handles rendezvous at run time)
-gcloud compute tpus tpu-vm ssh "$NAME" --zone="$ZONE" --worker=all --command='
+run gcloud compute tpus tpu-vm ssh "$NAME" --zone="$ZONE" --worker=all --command='
   set -e
   python3 -m pip install -q "jax[tpu]" flax optax
   python3 -m pip install -q mmlspark-tpu  # or: pip install <wheel you scp>
