@@ -190,6 +190,7 @@ class TestStages:
         pred = model.transform(_df_from_matrix(x, y)).col("prediction")
         assert abs(float((y <= pred).mean()) - 0.9) < 0.1
 
+    @pytest.mark.extended
     def test_multiclass_classifier_stage(self):
         x, y = make_classification(n_samples=600, n_features=10,
                                    n_informative=6, n_classes=3,
@@ -317,6 +318,7 @@ class TestSparseWideInput:
              .setNumFeatures(1 << 16).setUseIDF(False).fit(df))
         return m.transform(df), np.array(ys)
 
+    @pytest.mark.extended
     def test_wide_sparse_fit_and_selection_persistence(self, tmp_path):
         df, y = self._text_df()
         clf = (LightGBMClassifier().setNumIterations(20).setMaxBin(15)
@@ -372,6 +374,7 @@ class TestLeafwise:
                      np.floor((x0 - 0.75) * 64) * 0.9)
         return x, (y + rng.normal(size=n) * 0.05).astype(np.float32)
 
+    @pytest.mark.extended
     def test_leafwise_beats_levelwise_imbalanced_golden(self):
         x, y = self._imbalanced(n=4000)
         xt, xv, yt, yv = train_test_split(x, y, test_size=0.4,
@@ -388,6 +391,7 @@ class TestLeafwise:
         assert_golden(GOLDENS, "hetero_staircase", "leafwise16", "rmse",
                       r_lw, tolerance=0.03)
 
+    @pytest.mark.extended
     def test_categorical_split_beats_numeric_treatment(self):
         rng = np.random.default_rng(1)
         n = 4000
@@ -409,6 +413,7 @@ class TestLeafwise:
         assert auc_cat > auc_num + 0.01, (auc_cat, auc_num)
         assert auc_cat > 0.95, auc_cat
 
+    @pytest.mark.extended
     def test_distributed_leafwise_matches_serial(self):
         from mmlspark_tpu.parallel import mesh as meshlib
         x, y = self._imbalanced(seed=2, n=1200)
@@ -531,6 +536,7 @@ class TestEFB:
         feats = object_column([mat.getrow(i) for i in range(mat.shape[0])])
         return DataFrame({"features": feats, "label": y})
 
+    @pytest.mark.extended
     def test_tail_signal_survives_bundling(self, tmp_path):
         mat, y = self._wide_sparse()
         tr = np.arange(len(y)) % 4 != 0        # held-out eval: the tail

@@ -58,6 +58,7 @@ def test_pipeline_composes_with_dp():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.extended
 def test_pipeline_differentiable():
     """Gradients through the pipelined program must equal sequential grads —
     this is what makes the primitive a training substrate, not an
@@ -96,6 +97,7 @@ def test_pipeline_rejects_bad_microbatching():
 
 # ------------------------------------------------------------------ moe
 
+@pytest.mark.extended
 def test_moe_forward_and_balance():
     from mmlspark_tpu.models.moe import MoEMLP, read_moe_aux_loss
     m = MoEMLP(num_experts=4, d_hidden=32, top_k=2, capacity_factor=2.0,
