@@ -179,7 +179,19 @@ class TuneHyperparameters(Estimator, HasLabelCol):
         jobs = [(ci, fi) for ci in range(len(candidates))
                 for fi in range(self.getNumFolds())]
         results = np.zeros(len(jobs))
-        with ThreadPoolExecutor(self.getParallelism()) as pool:
+        import jax
+        width = self.getParallelism()
+        if jax.process_count() > 1 and width > 1:
+            # multi-process fleets must issue collective fits in the SAME
+            # order everywhere; a thread pool completes in nondeterministic
+            # order per process, which would pair one process's fit-A
+            # collectives with another's fit-B. Width 1 = submission order.
+            from ..core.utils import get_logger
+            get_logger("tune").warning(
+                "multi-process fleet: forcing tuner parallelism 1 so "
+                "collective fits stay ordered across processes")
+            width = 1
+        with ThreadPoolExecutor(width) as pool:
             futs = {pool.submit(eval_fold, candidates[ci][0],
                                 candidates[ci][1], fi): j
                     for j, (ci, fi) in enumerate(jobs)}

@@ -503,7 +503,37 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         hist_impl = ("pallas" if jax.default_backend() == "tpu"
                      and mesh is None else "segment")
     real = slice(None) if sample_weight is None else sample_weight > 0
-    edges = compute_bin_edges(x[real], p.max_bin)
+    nproc = jax.process_count()
+    if nproc > 1:
+        # MULTI-PROCESS fit: `x` is THIS process's row shard (the Spark-
+        # partition analog; the reference's per-partition LightGBM workers,
+        # LightGBMClassifier.scala:35-47). Fitted statistics must be
+        # IDENTICAL everywhere: bin edges and the init score come from a
+        # pooled per-process sample (same trade as LightGBM's
+        # bin_construct_sample_cnt, here split across the fleet).
+        if tree_learner not in ("data", "auto"):
+            raise ValueError(
+                f"multi-process fits support tree_learner=data|auto (rows "
+                f"are sharded across processes), got {tree_learner!r}")
+        from ...parallel import dataplane
+        cap = max(1, 200_000 // nproc)
+        # sample INDICES first: masking/casting the whole shard would copy
+        # multi-GB transients just to keep <= cap rows
+        cand = (np.arange(n) if sample_weight is None
+                else np.flatnonzero(sample_weight > 0))
+        if len(cand) > cap:
+            cand = np.random.default_rng(p.seed).choice(cand, cap,
+                                                        replace=False)
+        xr = x[cand].astype(np.float32)
+        yr = y[cand].astype(np.float32)
+        pooled = dataplane.allgather_pyobj((xr, yr))
+        gx = np.concatenate([a for a, _ in pooled])
+        gy = np.concatenate([b for _, b in pooled])
+        edges = compute_bin_edges(gx, p.max_bin)
+        base_global = _init_score(gy, p)
+    else:
+        edges = compute_bin_edges(x[real], p.max_bin)
+        base_global = None
     bins = bin_data(x, edges, cat_arr if cat_arr.any() else None, p.max_bin)
     d_pad = d
     if tree_learner == "feature":
@@ -513,17 +543,23 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         d_pad = -(-d // n_dev) * n_dev
         if d_pad != d:
             bins = np.pad(bins, ((0, 0), (0, d_pad - d)))
-    yj = jnp.asarray(y.astype(np.float32))
-    base = _init_score(y[real], p)
-    raw = jnp.broadcast_to(jnp.asarray(base)[None, :], (n, K)).astype(jnp.float32)
-    bins_j = jnp.asarray(bins)
+    base = base_global if base_global is not None else _init_score(y[real], p)
+    raw_np = np.broadcast_to(base[None, :], (n, K)).astype(np.float32)
 
     shard_rows = mesh is not None and tree_learner in ("data", "auto")
     if shard_rows:
         from ...parallel import mesh as meshlib
-        bins_j = meshlib.shard_batch(bins_j, mesh)
-        raw = meshlib.shard_batch(raw, mesh)
-        yj = meshlib.shard_batch(yj, mesh)
+        # single-process: one device_put sharded over `data`; multi-process:
+        # each process contributes ITS rows to the global array
+        bins_j = meshlib.put_global_batch(bins, mesh)
+        raw = meshlib.put_global_batch(raw_np, mesh)
+        yj = meshlib.put_global_batch(y.astype(np.float32), mesh)
+    else:
+        # nproc > 1 cannot reach here: the multi-process check above forces
+        # tree_learner data|auto, which always carries a mesh
+        bins_j = jnp.asarray(bins)
+        raw = jnp.asarray(raw_np)
+        yj = jnp.asarray(y.astype(np.float32))
 
     builder = None
     cat_j = jnp.asarray(cat_arr.astype(np.float32))
@@ -545,7 +581,12 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             min_child_weight=p.min_child_weight,
             min_split_gain=p.min_split_gain, hist_impl=hist_impl)
 
-    rng = np.random.default_rng(p.seed)
+    # per-ROW randomness (bagging, holdout) is process-local data and may
+    # diverge across processes; the FEATURE mask is replicated and must be
+    # identical everywhere — separate streams
+    rng = np.random.default_rng(p.seed + (jax.process_index()
+                                          if nproc > 1 else 0))
+    feat_rng = np.random.default_rng(p.seed ^ 0x5EED)
     feats, thrs, leaves = [], [], []
     best_loss, since_best, best_iter = np.inf, 0, None
     if is_rf:
@@ -580,11 +621,11 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                # (an (n,) f32 transfer per iteration dominated 10M-row fits)
 
     def _ship_row_mask(row_mask):
-        m = jnp.asarray(row_mask)
         if shard_rows:
             from ...parallel import mesh as meshlib
-            m = meshlib.shard_batch(m, mesh)
-        return m
+            return meshlib.put_global_batch(
+                np.asarray(row_mask, np.float32), mesh)
+        return jnp.asarray(row_mask)
 
     for it in range(p.num_iterations):
         # rf mode (LightGBM boosting=rf): every tree fits the INITIAL
@@ -605,9 +646,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                         else sample_weight.astype(np.float32))
             rm = _ship_row_mask(row_mask)
         if p.feature_fraction < 1.0:
-            fm = (rng.random(d) < p.feature_fraction)
+            fm = (feat_rng.random(d) < p.feature_fraction)
             if not fm.any():
-                fm[rng.integers(0, d)] = True
+                fm[feat_rng.integers(0, d)] = True
             feat_mask = fm.astype(np.float32)
         else:
             feat_mask = np.ones(d, dtype=np.float32)
@@ -631,9 +672,14 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             feats.append((S, f, t, W, IC))
             leaves.append(lv)
             # training rows' leaves are known from the grow: the raw update
-            # is a tiny-table gather, no split-sequence replay
+            # is a tiny-table gather, no split-sequence replay. The eval
+            # `step` localizes replicated tree arrays under multi-process
+            # (the val set is process-local; mixing global and local arrays
+            # in one jit is undefined).
+            loc = (lambda a: np.asarray(a)) if nproc > 1 else (lambda a: a)
             step = lambda b: jnp.stack(
-                [lw.predict_tree_lw(b, S[k], f[k], t[k], W[k], IC[k], lv[k])
+                [lw.predict_tree_lw(b, loc(S[k]), loc(f[k]), loc(t[k]),
+                                    loc(W[k]), loc(IC[k]), loc(lv[k]))
                  for k in range(K)], axis=1)
             train_step_fn = lambda: jnp.stack(
                 [lv[k][node_tr[k]] for k in range(K)], axis=1)
@@ -653,8 +699,10 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             feats.append(f)
             thrs.append(t)
             leaves.append(lv)
+            loc = (lambda a: np.asarray(a)) if nproc > 1 else (lambda a: a)
             step = lambda b: jnp.stack(
-                [_predict_tree(b, f[k], t[k], lv[k], depth=p.max_depth)
+                [_predict_tree(b, loc(f[k]), loc(t[k]), loc(lv[k]),
+                               depth=p.max_depth)
                  for k in range(K)], axis=1)
             train_step_fn = lambda: step(bins_j)
         if not is_rf:
@@ -663,6 +711,13 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         if p.early_stopping_round > 0:
             raw_val = raw_val + step(bins_val)
             cur = float(_loss(raw_val, y_val, p.objective, p.alpha))
+            if nproc > 1:
+                # the stop decision must be identical fleet-wide: average
+                # the per-process validation losses (row-weighted)
+                from ...parallel import dataplane
+                tot = dataplane.allreduce_sum(
+                    np.array([cur * len(y_val), float(len(y_val))]))
+                cur = float(tot[0] / max(tot[1], 1.0))
             if cur < best_loss - 1e-9:
                 best_loss, since_best, best_iter = cur, 0, it + 1
             else:

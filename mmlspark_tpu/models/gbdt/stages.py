@@ -138,6 +138,11 @@ class _BoosterParams:
         the user left ``parallelism`` at its default, small fits fall back
         to the single-device program (also keeps thread-pooled tuning over
         small folds collective-free); an explicit setting is honored."""
+        if jax.process_count() > 1:
+            # multi-process fleets ALWAYS run the collective program — the
+            # small-fit heuristic would diverge on per-process shard sizes
+            # (SPMD demands every process make the same choice)
+            return meshlib.create_mesh()
         if self._tree_learner() == "serial" or len(jax.devices()) < 2:
             return None
         explicit = self.isSet("parallelism")
@@ -260,10 +265,27 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
                   categorical=()):
     p = params_holder._engine_params(objective, num_class, alpha, categorical)
     mesh = params_holder._mesh(x.shape[0])
+    nproc = jax.process_count()
+    if nproc > 1 and p.tree_learner not in ("data", "auto"):
+        raise ValueError(
+            "multi-process GBDT fits shard rows across processes and need "
+            "parallelism=data_parallel (the reference's per-partition "
+            "workers, LightGBMClassifier.scala:35-47); got "
+            f"{params_holder.getOrDefault('parallelism')!r}")
     if mesh is not None and p.tree_learner != "feature":
         # row-sharded modes need the batch padded to a device multiple;
         # feature-parallel keeps full rows on every device
-        x, n = meshlib.pad_batch_to_devices(x, mesh)
+        if nproc > 1:
+            # `x` is this process's shard; every process must contribute an
+            # EQUAL slice of the global array — pad to the fleet-wide max
+            x, n = meshlib.pad_batch_to_local_devices(x, mesh)
+            from ...parallel import dataplane
+            target = max(dataplane.allgather_pyobj(len(x)))
+            if len(x) < target:
+                x = np.concatenate(
+                    [x, np.zeros((target - len(x),) + x.shape[1:], x.dtype)])
+        else:
+            x, n = meshlib.pad_batch_to_devices(x, mesh)
         y = np.concatenate([y, np.zeros(len(x) - n, y.dtype)])
         w = np.concatenate([np.ones(n, np.float32),
                             np.zeros(len(x) - n, np.float32)])
