@@ -81,6 +81,21 @@ class TextFeaturizer(Estimator, _TextChainParams):
                      if k not in ("idfWeights",)})
         if self.getUseIDF():
             tf = _featurize_tokens(self, df.col(self.getInputCol()))
-            model.setIdfWeights(
-                text_ops.idf_weights(tf, self.getMinDocFreq()))
+            from ..parallel import dataplane
+            if dataplane.is_sharded(df):
+                # fleet-wide IDF: document frequencies and the corpus size
+                # sum across shards in one collective (Spark's IDF
+                # aggregates over the whole cluster the same way)
+                df_local = np.asarray((tf > 0).sum(axis=0)).ravel() \
+                    .astype(np.float64)
+                tot = dataplane.allreduce_sum(
+                    np.concatenate([[float(tf.shape[0])], df_local]))
+                m, dfreq = tot[0], tot[1:]
+                w = np.log((m + 1.0) / (dfreq + 1.0))
+                if self.getMinDocFreq() > 0:
+                    w = np.where(dfreq >= self.getMinDocFreq(), w, 0.0)
+                model.setIdfWeights(w.astype(np.float32))
+            else:
+                model.setIdfWeights(
+                    text_ops.idf_weights(tf, self.getMinDocFreq()))
         return model

@@ -173,6 +173,21 @@ np.testing.assert_allclose(row["Mean"],
 np.testing.assert_allclose(row["Count"], union.count(), rtol=0)
 np.testing.assert_allclose(row["Min"], np.asarray(union.col("x")).min())
 
+from mmlspark_tpu.ops import TextFeaturizer
+tdf = sdf.withColumn("txt", dp.object_column(
+    [f"w{i % 5} common token{pid}" for i in range(sdf.count())]))
+tfm = (TextFeaturizer().setInputCol("txt").setOutputCol("tfv")
+       .setNumFeatures(64).fit(tdf))
+w_sharded = np.asarray(tfm.getIdfWeights())
+union_txt = dp.allgather_pyobj(list(tdf.col("txt")))
+flat = [t for part in union_txt for t in part]
+from mmlspark_tpu.core.dataframe import DataFrame as _DF
+w_union = np.asarray(
+    (TextFeaturizer().setInputCol("txt").setOutputCol("tfv")
+     .setNumFeatures(64)
+     .fit(_DF({"txt": np.array(flat, dtype=object)}))).getIdfWeights())
+np.testing.assert_allclose(w_sharded, w_union, rtol=1e-6)
+
 fz = Featurize().setInputCols(("k", "x")).setOutputCol("f").fit(sdf)
 plans = dict(fz.getInputPlans())
 assert plans["k"]["levels"] == ["a", "b", "c"]
