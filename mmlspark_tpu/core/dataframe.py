@@ -274,16 +274,20 @@ class DataFrame:
         for k in on:  # validate keys exist on both sides (col() raises)
             self.col(k)
             other.col(k)
+        # SQL join semantics: a null key matches NOTHING (null = null is not
+        # true), while NaN keys DO equate (Spark's join comparator) — so the
+        # groupBy/distinct null sentinel must not flow into the hash maps
         rmap: dict[tuple, list[int]] = {}
         for j, t in enumerate(zip(*[[_hashable(v) for v in other.col(k).tolist()]
                                     for k in on])):
-            rmap.setdefault(t, []).append(j)
+            if _NULL_SENTINEL not in t:
+                rmap.setdefault(t, []).append(j)
         li: list[int] = []
         ri: list[int] = []
         matched: set[int] = set()
         for i, t in enumerate(zip(*[[_hashable(v) for v in self.col(k).tolist()]
                                     for k in on])):
-            js = rmap.get(t)
+            js = None if _NULL_SENTINEL in t else rmap.get(t)
             if js:
                 for j in js:
                     li.append(i)
@@ -304,8 +308,10 @@ class DataFrame:
         meta: dict[str, dict] = {}
         for k, v in self._cols.items():
             if k in on:
-                # key columns never null (a key exists on >=1 side), so take
-                # raw values from whichever side matched — no NaN widening
+                # a key VALUE exists on >=1 side of every output row (null-
+                # keyed rows emit with their own None key, object dtype), so
+                # take raw values from whichever side matched — no NaN
+                # widening of numeric keys
                 rv = other.col(k)
                 lg = _safe_take(v, lidx)
                 rg = _safe_take(rv, ridx)

@@ -17,6 +17,7 @@ the spawner learns the probed ports.
 
 from __future__ import annotations
 
+import itertools
 import json
 import sys
 import threading
@@ -63,17 +64,27 @@ class WorkerServer:
                 length = int(self.headers.get("Content-Length", 0))
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if self.path == "/poll":
+                    cap = max(1, int(req.get("max", 256)))
                     with worker._lock:
                         for ex_id in req.get("ack", ()):
                             worker._unacked.pop(str(ex_id), None)
-                    batch = worker.source.getBatch(
-                        int(req.get("max", 256)),
-                        timeout=float(req.get("timeout", 0.02)))
+                        backlog = len(worker._unacked)
+                    # honor the driver's cap: the unacked backlog goes out
+                    # first (oldest rows, at-least-once redelivery), and the
+                    # source is only drained for the REMAINING headroom —
+                    # a driver that falls behind must not see the response
+                    # payload grow without bound
+                    if backlog < cap:
+                        batch = worker.source.getBatch(
+                            cap - backlog,
+                            timeout=float(req.get("timeout", 0.02)))
+                        with worker._lock:
+                            for i, v in zip(batch.col("id"),
+                                            batch.col("value")):
+                                worker._unacked[str(i)] = str(v)
                     with worker._lock:
-                        for i, v in zip(batch.col("id"),
-                                        batch.col("value")):
-                            worker._unacked[str(i)] = str(v)
-                        rows = [[i, v] for i, v in worker._unacked.items()]
+                        rows = [[i, v] for i, v in itertools.islice(
+                            worker._unacked.items(), cap)]
                     self._json(200, {"rows": rows})
                 elif self.path == "/respond":
                     for ex_id, code, body in req.get("replies", ()):

@@ -108,11 +108,15 @@ def apply_bundles(csc, bundles: list[np.ndarray]) -> np.ndarray:
     return out
 
 
-def plan_and_split(mat_csc, cap: int, max_bin: int, seed: int = 0):
+def plan_and_split(mat_csc, cap: int, max_bin: int, seed: int = 0,
+                   doc_freq=None):
     """The stage-side entry: given a wide sparse CSC matrix, return
     (dense_col_ids, bundles) — the ``cap`` densest columns stay numeric
-    (round-1 behavior), the tail bundles into categorical composites."""
-    doc_freq = np.diff(mat_csc.indptr)
+    (round-1 behavior), the tail bundles into categorical composites.
+    ``doc_freq`` overrides the local counts (fleet-summed document
+    frequencies for multi-process fits, gbdt/stages._fleet_doc_freq)."""
+    if doc_freq is None:
+        doc_freq = np.diff(mat_csc.indptr)
     order = np.argsort(-doc_freq, kind="stable")
     dense = np.sort(order[:cap]).astype(np.int64)
     tail = order[cap:]

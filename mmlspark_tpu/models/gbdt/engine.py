@@ -516,11 +516,14 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 f"multi-process fits support tree_learner=data|auto (rows "
                 f"are sharded across processes), got {tree_learner!r}")
         from ...parallel import dataplane
-        cap = max(1, 200_000 // nproc)
         # sample INDICES first: masking/casting the whole shard would copy
         # multi-GB transients just to keep <= cap rows
         cand = (np.arange(n) if sample_weight is None
                 else np.flatnonzero(sample_weight > 0))
+        # each process contributes in proportion to its REAL shard size —
+        # an equal split would over-weight small shards in the pooled
+        # quantile edges and init score relative to the single-process fit
+        cap = dataplane.proportional_sample_cap(len(cand), 200_000)
         if len(cand) > cap:
             cand = np.random.default_rng(p.seed).choice(cand, cap,
                                                         replace=False)

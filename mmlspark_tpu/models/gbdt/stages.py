@@ -151,22 +151,97 @@ class _BoosterParams:
         return meshlib.create_mesh()
 
 
+def _fleet_doc_freq(mat_csc):
+    """Per-column nonzero counts, summed over every process's shard when
+    the fit is multi-process. Feature selection and EFB planning MUST key
+    off fleet-wide statistics: planning from the local shard would give
+    each process a different column->feature mapping (different d, even)
+    while fit_gbdt replicates trees assuming identical feature semantics
+    everywhere — a silently corrupt model. Callers guarantee every process
+    reaches this together (_check_fleet_features)."""
+    doc_freq = np.diff(mat_csc.indptr)
+    if jax.process_count() > 1:
+        from ...parallel import dataplane
+        doc_freq = dataplane.allreduce_sum(doc_freq.astype(np.int64))
+    return doc_freq
+
+
+def _check_fleet_features(mat):
+    """Fleet-consistency gate for a multi-process fit's feature matrix.
+    Every later branch in _prepare_fit_features must be taken by EVERY
+    process together (its collectives would otherwise pair cross-purpose
+    and hang or corrupt) — so the branch inputs themselves (sparse-ness,
+    width) are validated fleet-wide here, in ONE collective all processes
+    always reach."""
+    if jax.process_count() == 1:
+        return
+    from ...parallel import dataplane
+    info = dataplane.allgather_pyobj(
+        (bool(hasattr(mat, "tocsc")), int(mat.shape[1])))
+    kinds = {s for s, _ in info}
+    widths = {w for _, w in info}
+    if len(widths) != 1:
+        raise ValueError(
+            f"sharded GBDT fit saw different feature widths per process: "
+            f"{sorted(widths)}; hash/assemble features with a fixed "
+            f"dimension before a fleet fit")
+    if len(kinds) != 1:
+        raise ValueError(
+            "sharded GBDT fit saw sparse feature rows on some processes "
+            "and dense on others; use one representation fleet-wide")
+
+
+def _pooled_row_sample(mat_csr, seed: int, target: int = 8192):
+    """A fleet-pooled row sample of the sparse matrix, identical on every
+    process: each process contributes rows in proportion to its shard size
+    (the engine's bin-edge pooling trade, engine.fit_gbdt). EFB planning
+    needs GLOBAL conflict statistics — a plan from one shard's bitmaps
+    under-counts conflicts and packs bundles that destroy information
+    fleet-wide."""
+    import scipy.sparse as sp
+
+    from ...parallel import dataplane
+    n = mat_csr.shape[0]
+    cap = dataplane.proportional_sample_cap(n, target)
+    local = mat_csr.tocsr()
+    if n > cap:
+        rows = np.sort(np.random.default_rng(
+            seed ^ (0x9E37 * (jax.process_index() + 1))).choice(
+                n, cap, replace=False))
+        local = local[rows]
+    parts = dataplane.allgather_pyobj(local)
+    return sp.vstack(parts, format="csr")
+
+
 def _prepare_fit_features(stage, df):
     """Feature matrix for a booster fit. Narrow/dense inputs pass through;
     wide sparse inputs keep the maxDenseFeatures densest columns numeric
     and BUNDLE the tail into categorical composites (EFB-lite, efb.py) when
     the growth mode supports category-set splits — round 1 truncated the
-    tail entirely. Returns (x, selection, bundles, bundle_cat_ids)."""
+    tail entirely. Returns (x, selection, bundles, bundle_cat_ids).
+
+    Multi-process fits select columns from fleet-summed document
+    frequencies and plan bundles over a fleet-pooled row sample — every
+    process derives the IDENTICAL feature mapping from identical global
+    statistics (planning from the local shard would give each process
+    different feature semantics under the replicated trees)."""
     mat = rows_to_matrix(df.col(stage.getFeaturesCol()))
     if hasattr(mat, "tocsc"):
         mat = mat.tocsc()
+    _check_fleet_features(mat)
+    # every condition below is a pure function of params (replicated) and
+    # the fleet-validated (kind, width) — all processes branch together
     cap = stage.getMaxDenseFeatures()
     if hasattr(mat, "tocsc") and mat.shape[1] > cap \
             and stage._effective_leafwise():
         from .efb import apply_bundles, plan_and_split
-        dense, bundles = plan_and_split(mat, cap,
+        seed = stage.getOrDefault("seed")
+        doc_freq = _fleet_doc_freq(mat)
+        plan_mat = (_pooled_row_sample(mat, seed).tocsc()
+                    if jax.process_count() > 1 else mat)
+        dense, bundles = plan_and_split(plan_mat, cap,
                                         stage.getOrDefault("maxBin"),
-                                        stage.getOrDefault("seed"))
+                                        seed, doc_freq=doc_freq)
         xd = _densify(mat, dense)
         if not bundles:
             return xd, dense, None, ()
@@ -179,7 +254,9 @@ def _prepare_fit_features(stage, df):
         x = np.concatenate([xd, xb], axis=1)
         return (x, dense, bundles,
                 tuple(range(xd.shape[1], x.shape[1])))
-    sel = _select_features(mat, cap)
+    doc_freq = (_fleet_doc_freq(mat) if hasattr(mat, "tocsc")
+                and mat.shape[1] > cap else None)
+    sel = _select_features(mat, cap, doc_freq=doc_freq)
     return _densify(mat, sel), sel, None, ()
 
 
@@ -212,17 +289,19 @@ def _features_matrix(df: DataFrame, col: str, selection=None) -> np.ndarray:
     return _densify(rows_to_matrix(df.col(col)), selection)
 
 
-def _select_features(mat, cap: int):
+def _select_features(mat, cap: int, doc_freq=None):
     """Sparse high-dim inputs (hashed text, 2^18 dims) cannot densify into
     the (n, d) bin matrix the histogram kernels take. Keep the `cap`
     highest-document-frequency columns — the pragmatic cut of LightGBM's
     sparse/EFB handling: hashed-text signal lives in frequent columns, and
     an all-zero or near-empty column can't win a split anyway. Returns
-    sorted column indices, or None when d already fits."""
+    sorted column indices, or None when d already fits. ``doc_freq``
+    overrides the local counts (fleet-summed, multi-process fits)."""
     d = mat.shape[1]
     if d <= cap or not hasattr(mat, "tocsc"):
         return None  # already-dense inputs stay uncapped (no memory win)
-    doc_freq = np.diff(mat.tocsc().indptr)
+    if doc_freq is None:
+        doc_freq = np.diff(mat.tocsc().indptr)
     sel = np.sort(np.argsort(-doc_freq, kind="stable")[:cap]).astype(np.int64)
     from ...core.utils import get_logger
     get_logger("gbdt").warning(

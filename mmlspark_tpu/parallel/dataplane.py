@@ -33,8 +33,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from ..core.dataframe import (DataFrame, GroupedData, _copy_meta,
-                              _gather_with_nulls, _hashable)
+from ..core.dataframe import (DataFrame, GroupedData, _NULL_SENTINEL,
+                              _copy_meta, _gather_with_nulls, _hashable)
 from ..core.utils import get_logger, object_column
 
 log = get_logger("dataplane")
@@ -79,6 +79,17 @@ def allgather_pyobj(obj) -> list:
     sets, imputation sums, partial aggregates) across the fleet."""
     return [pickle.loads(b) for b in allgather_bytes(
         pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))]
+
+
+def proportional_sample_cap(n_local: int, target: int) -> int:
+    """How many of this process's ``n_local`` rows belong in a fleet-pooled
+    sample of ~``target`` rows: contribution proportional to real shard
+    size, so unbalanced shards are neither over- nor under-represented in
+    pooled statistics (GBDT bin edges, init scores, EFB plans). One
+    allgather; every process must call it together."""
+    sizes = allgather_pyobj(int(n_local))
+    total = max(1, sum(sizes))
+    return max(1, int(round(target * n_local / total)))
 
 
 def allreduce_sum(x):
@@ -207,7 +218,9 @@ class ShardedDataFrame(DataFrame):
             lkeys = set().union(*allgather_pyobj(lkeys))
             rk = list(zip(*[[_hashable(v) for v in right.col(k).tolist()]
                             for k in keys]))
-            matched = np.array([t in lkeys for t in rk], dtype=bool)
+            # null keys match nothing (SQL join semantics, core join rule)
+            matched = np.array([_NULL_SENTINEL not in t and t in lkeys
+                                for t in rk], dtype=bool)
             local_how = "left" if how == "outer" else "inner"
             out = super().join(right, on, how=local_how, suffix=suffix)
             if pid() == 0 and (~matched).any():

@@ -330,7 +330,8 @@ class TestRelationalOps:
         assert df.distinct().count() == 3  # x differs; k alone has 2 levels
         assert df.select("k").distinct().count() == 2
 
-    def test_nan_and_none_join_keys_match(self):
+    def test_nan_join_keys_match_but_null_keys_never_do(self):
+        # Spark's join comparator equates NaN keys...
         left = DataFrame({"k": np.array([np.nan, 1.0]),
                           "x": np.array([10., 20.])})
         right = DataFrame({"k": np.array([np.nan, 2.0]),
@@ -338,13 +339,22 @@ class TestRelationalOps:
         out = left.join(right, "k")
         assert out.count() == 1
         assert out.col("z")[0] == 7.0
+        # ...but a null key matches NOTHING (SQL: null = null is not true);
+        # null-keyed rows drop from inner joins and emit unmatched in outer
         left_o = DataFrame({"k": np.array([None, "a"], dtype=object),
                             "x": np.array([1., 2.])})
-        right_o = DataFrame({"k": np.array([None], dtype=object),
-                             "z": np.array([9.])})
-        assert left_o.join(right_o, "k").count() == 1
-        # but null and NaN are DISTINCT keys (Spark: null is absence,
-        # NaN is a float value)
+        right_o = DataFrame({"k": np.array([None, "a"], dtype=object),
+                             "z": np.array([9., 10.])})
+        inner = left_o.join(right_o, "k")
+        assert inner.count() == 1 and inner.col("k")[0] == "a"
+        outer = left_o.join(right_o, "k", how="outer")
+        assert outer.count() == 3  # a<->a, left null alone, right null alone
+        nulls = [r for r in outer.collect() if r["k"] is None]
+        assert len(nulls) == 2
+        assert sorted(str(r["x"]) + "/" + str(r["z"]) for r in nulls) \
+            == ["1.0/nan", "nan/9.0"]
+        # null and NaN stay DISTINCT keys in grouping (Spark: null is
+        # absence, NaN is a float value)
         mixed = DataFrame({"k": np.array([None, np.nan, np.nan],
                                          dtype=object),
                            "x": np.array([1., 2., 3.])})

@@ -399,3 +399,92 @@ def test_two_process_gbdt_fit(tmp_path):
     (LightGBMClassifier.scala:35-47, TrainUtils.scala:141)."""
     outs = _spawn_fleet(tmp_path, _GBDT_WORKER, timeout=360)
     assert all("GBDT_WORKER_OK" in o for o in outs)
+
+
+_SPARSE_GBDT_WORKER = r'''
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import scipy.sparse as sp
+from sklearn.metrics import roc_auc_score
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+from mmlspark_tpu.parallel import distributed as dist
+from mmlspark_tpu.parallel import dataplane as dp
+
+assert dist.initialize_from_env() is True
+pid = jax.process_index()
+
+# wide-sparse shards with DELIBERATELY different per-process column
+# densities: planning from local doc freqs would give each process a
+# different dense selection / EFB bundle plan (and a different feature
+# count d) -> corrupt replicated model. The fit must key its plan off
+# fleet-summed statistics.
+D = 256
+SIGNAL = set(range(180, 192))              # rare tail columns
+rng = np.random.default_rng(31 + pid)
+n_local = 300 + 200 * pid                  # uneven shards too
+col_bias = np.roll(np.linspace(1.0, 8.0, D), pid * 97)  # density skew
+col_bias[list(SIGNAL)] = 0.8               # keep signal out of the dense top
+def draw_rows(rg, n, bias):
+    rows, ys = [], []
+    p = bias / bias.sum()
+    for _ in range(n):
+        cols = rg.choice(D, 12, replace=False, p=p)
+        rows.append(sp.csr_matrix(
+            (np.ones(12, np.float32),
+             (np.zeros(12, np.int64), cols)), shape=(1, D)))
+        ys.append(bool(SIGNAL & set(int(c) for c in cols)))
+    return rows, np.array(ys)
+rows, y = draw_rows(rng, n_local, col_bias)
+df = DataFrame({"features": object_column(rows),
+                "label": y.astype(np.float64)})
+
+clf = (LightGBMClassifier().setNumIterations(40).setNumLeaves(15)
+       .setMaxBin(63).setMaxDenseFeatures(32))
+model = clf.fit(df)
+
+# the feature PLAN must be identical fleet-wide...
+sel = tuple(int(j) for j in model.getFeatureSelection())
+bundles = tuple(tuple(int(j) for j in b)
+                for b in (model.getFeatureBundles() or ()))
+plans = dp.allgather_pyobj((sel, bundles))
+assert all(p == plans[0] for p in plans), "feature plans diverged"
+
+# ...and so must the fitted trees
+import hashlib
+state = model.getBoosterState()
+digest = hashlib.sha256(
+    b"".join(np.ascontiguousarray(state[k]).tobytes()
+             for k in sorted(state) if isinstance(state[k], np.ndarray))
+).hexdigest()
+digests = dp.allgather_pyobj(digest)
+assert len(set(digests)) == 1, digests
+
+# the model actually learned the "contains any signal column" rule — the
+# category-set split shape EFB bundles exist to represent (common held-out
+# set, same seed everywhere)
+er = np.random.default_rng(777)
+erows, ey = draw_rows(er, 400, np.ones(D))
+edf = DataFrame({"features": object_column(erows)})
+prob = np.stack(list(model.transform(edf).col("probability")))[:, 1]
+auc = roc_auc_score(ey, prob)
+assert auc > 0.9, auc
+
+dist.process_barrier("sparse_gbdt")
+dist.shutdown()
+print("SPARSE_GBDT_WORKER_OK auc=%.4f" % auc)
+'''
+
+
+@pytest.mark.extended
+def test_two_process_wide_sparse_gbdt_plan_is_fleet_consistent(tmp_path):
+    """The TextFeaturizer->distributed-GBDT path: wide sparse shards whose
+    LOCAL document frequencies differ per process. Dense-column selection
+    and EFB bundling must come from fleet-summed statistics (process 0's
+    bundle plan adopted everywhere) or each process trains on different
+    features while believing the model is replicated."""
+    outs = _spawn_fleet(tmp_path, _SPARSE_GBDT_WORKER, timeout=360)
+    assert all("SPARSE_GBDT_WORKER_OK" in o for o in outs)

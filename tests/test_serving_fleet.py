@@ -170,3 +170,61 @@ def test_offset_log_replay_and_commit():
     finally:
         if src:
             src.close()
+
+
+def test_worker_poll_honors_max_cap():
+    """/poll must cap its response at the driver's requested ``max``: the
+    unacked backlog goes out first (oldest rows), and the source is drained
+    only for the remaining headroom — a slow driver must never see the
+    payload grow without bound (at-least-once redelivery still holds)."""
+    from mmlspark_tpu.io.http.worker import WorkerServer
+
+    w = None
+    threads = []
+    try:
+        w = WorkerServer("127.0.0.1")
+        results = {}
+
+        def client(i):
+            results[i] = _post(f"http://127.0.0.1:{w.source.port}/",
+                               f"m-{i}", timeout=20)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(5)]
+        for t in threads:
+            t.start()
+        ctl = f"http://127.0.0.1:{w.control_port}/poll"
+
+        def poll(payload):
+            req = urllib.request.Request(
+                ctl, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())["rows"]
+
+        # wait until all 5 requests are pending inside the worker
+        deadline = time.monotonic() + 10
+        seen = {}
+        while len(seen) < 5 and time.monotonic() < deadline:
+            for i, v in poll({"max": 100, "timeout": 0.05}):
+                seen[i] = v
+            time.sleep(0.02)
+        assert len(seen) == 5
+        # every poll response was capped at max=2
+        first = poll({"max": 2})
+        assert len(first) == 2
+        # unacked rows redeliver (same ids, oldest first) until acked
+        again = poll({"max": 2})
+        assert [i for i, _ in again] == [i for i, _ in first]
+        # acking frees headroom; remaining rows arrive in later polls
+        rest = poll({"max": 10, "ack": [i for i, _ in first]})
+        assert len(rest) == 3
+        ids = {i for i, _ in first} | {i for i, _ in rest}
+        assert len(ids) == 5
+        for ex_id in ids:
+            w.source.respond(str(ex_id), 200, "done")
+    finally:
+        for t in threads:
+            t.join(timeout=10)
+        if w:
+            w.close()
