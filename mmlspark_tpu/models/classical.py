@@ -6,7 +6,8 @@ TPU-native versions:
   * LogisticRegression / LinearRegression — full-batch jitted Adam on the
     (optionally L2-regularized) convex objective; one fused XLA program per
     step, features live in HBM for the whole fit;
-  * NaiveBayes — Gaussian NB, closed form (one pass of jnp reductions);
+  * NaiveBayes — multinomial (Spark ML parity, one matmul predict) or
+    Gaussian, both closed form (one pass of jnp reductions);
   * DecisionTree / RandomForest / GBT — thin settings over the XLA GBDT
     engine (RF = LightGBM-style boosting_type=rf bagged mode);
   * MultilayerPerceptron — TpuLearner with an MLP config.
@@ -50,8 +51,13 @@ class _ProbClassifierModel(Model, HasFeaturesCol):
     def _probs(self, x: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def _features(self, df: DataFrame):
+        """Feature matrix hook — models that can score a sparse matrix
+        directly (multinomial NB's one matmul) override to skip _densify."""
+        return _features_matrix(df, self.getFeaturesCol())
+
     def transform(self, df: DataFrame) -> DataFrame:
-        x = _features_matrix(df, self.getFeaturesCol())
+        x = self._features(df)
         prob = self._probs(x)
         out = (df.withColumn(self.getProbabilityCol(), _vec_col(prob))
                  .withColumn(self.getPredictionCol(),
@@ -160,41 +166,113 @@ class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol):
 # -------------------------------------------------------------- naive bayes
 
 class NaiveBayesModel(_ProbClassifierModel):
+    modelType = StringParam("multinomial|gaussian", default="multinomial")
     classLogPriors = ComplexParam("(K,) log priors", default=None)
-    means = ComplexParam("(K, d) per-class means", default=None)
-    variances = ComplexParam("(K, d) per-class variances", default=None)
+    means = ComplexParam("(K, d) per-class means (gaussian)", default=None)
+    variances = ComplexParam("(K, d) per-class variances (gaussian)",
+                             default=None)
+    featureLogProbs = ComplexParam(
+        "(K, d) per-class log feature probabilities (multinomial theta)",
+        default=None)
+
+    def _is_multinomial(self) -> bool:
+        # decide by which arrays the fit stored, not the modelType param:
+        # artifacts saved before the multinomial mode existed carry only
+        # means/variances and must keep loading as gaussian
+        return self.getFeatureLogProbs() is not None
+
+    def _features(self, df: DataFrame):
+        if self._is_multinomial():
+            mat = rows_to_matrix(df.col(self.getFeaturesCol()))
+            if hasattr(mat, "tocsr"):
+                return mat.tocsr()   # sparse scoring: one csr @ dense matmul
+            return np.asarray(mat, dtype=np.float32)
+        return super()._features(df)
 
     def _probs(self, x):
-        mu = np.asarray(self.getMeans())
-        var = np.asarray(self.getVariances())
         lp = np.asarray(self.getClassLogPriors())
-        # gaussian log-likelihood per class, vectorized (n, K)
-        ll = -0.5 * (np.log(2 * np.pi * var)[None]
-                     + (x[:, None, :] - mu[None]) ** 2 / var[None]).sum(axis=2)
-        z = ll + lp[None]
+        if self._is_multinomial():
+            # z_{ik} = log prior_k + sum_j x_ij * log theta_kj — one matmul
+            # (works unchanged for a scipy CSR x: hashed text never
+            # densifies)
+            z = np.asarray(x @ np.asarray(self.getFeatureLogProbs()).T) \
+                + lp[None]
+        else:
+            mu = np.asarray(self.getMeans())
+            var = np.asarray(self.getVariances())
+            # gaussian log-likelihood per class, vectorized (n, K)
+            ll = -0.5 * (np.log(2 * np.pi * var)[None]
+                         + (x[:, None, :] - mu[None]) ** 2
+                         / var[None]).sum(axis=2)
+            z = ll + lp[None]
         e = np.exp(z - z.max(axis=1, keepdims=True))
         return e / e.sum(axis=1, keepdims=True)
 
 
 class NaiveBayes(Estimator, HasFeaturesCol, HasLabelCol):
-    """Gaussian naive Bayes (one jnp pass of per-class moments)."""
-    smoothing = FloatParam("variance smoothing", default=1e-6, min=0.0)
+    """Naive Bayes classifier with Spark ML's multinomial model as the
+    default and a Gaussian variant for continuous features.
+
+    ``modelType='multinomial'`` matches Spark ML's NaiveBayes — event
+    counts over NONNEGATIVE features (hashed text), log theta from
+    additively-smoothed per-class feature sums, raising on negative values
+    exactly like Spark (reference: TrainClassifier.scala:45-56 wraps Spark
+    ML NaiveBayes, whose default is multinomial with smoothing 1.0).
+    Sparse inputs stay sparse end to end: the fit is K row-masked column
+    sums and scoring is one csr @ dense matmul. ``modelType='gaussian'``
+    computes closed-form per-class moments (an extension Spark ML 2.x
+    lacks)."""
+    modelType = StringParam("multinomial = Spark ML parity over nonnegative "
+                            "count-like features; gaussian = continuous "
+                            "features via per-class moments",
+                            default="multinomial",
+                            choices=("multinomial", "gaussian"))
+    smoothing = FloatParam("additive (Laplace) smoothing for multinomial — "
+                           "Spark ML's default 1.0 (values below 1e-10 "
+                           "clamp there, as sklearn does: smoothing 0 with "
+                           "a class-absent feature would make every "
+                           "posterior NaN)", default=1.0, min=0.0)
+    varianceSmoothing = FloatParam("variance floor added in gaussian mode",
+                                   default=1e-6, min=0.0)
 
     def fit(self, df: DataFrame) -> NaiveBayesModel:
-        x = _features_matrix(df, self.getFeaturesCol())
         y = np.asarray(df.col(self.getLabelCol())).astype(np.int32)
         k = int(y.max()) + 1
+        counts = np.bincount(y, minlength=k).astype(np.float64)
+        model = (NaiveBayesModel().setFeaturesCol(self.getFeaturesCol())
+                 .setModelType(self.getModelType())
+                 .setClassLogPriors(np.log(counts / counts.sum())))
+        if self.getModelType() == "multinomial":
+            mat = rows_to_matrix(df.col(self.getFeaturesCol()))
+            sparse = hasattr(mat, "tocsr")
+            neg = (mat.data.size and mat.data.min() < 0) if sparse \
+                else np.any(np.asarray(mat) < 0)
+            if neg:
+                raise ValueError(
+                    "multinomial NaiveBayes requires nonnegative features "
+                    "(Spark ML raises the same); use "
+                    "setModelType('gaussian') for real-valued features")
+            if sparse:
+                mat = mat.tocsr()
+                sums = np.stack([
+                    np.asarray(mat[y == c].sum(axis=0)).ravel()
+                    for c in range(k)])
+            else:
+                x = np.asarray(mat, dtype=np.float32)
+                sums = np.asarray(jax.ops.segment_sum(
+                    jnp.asarray(x), jnp.asarray(y), k))
+            sums = sums + max(self.getSmoothing(), 1e-10)
+            theta = np.log(sums) - np.log(sums.sum(axis=1, keepdims=True))
+            return model.setFeatureLogProbs(theta.astype(np.float32))
+        x = _features_matrix(df, self.getFeaturesCol())
         xj, yj = jnp.asarray(x), jnp.asarray(y)
-        counts = jax.ops.segment_sum(jnp.ones_like(yj, jnp.float32), yj, k)
+        cj = jnp.asarray(counts.astype(np.float32))
         sums = jax.ops.segment_sum(xj, yj, k)
         sqs = jax.ops.segment_sum(xj * xj, yj, k)
-        mu = sums / counts[:, None]
-        var = sqs / counts[:, None] - mu * mu + self.getSmoothing() \
+        mu = sums / cj[:, None]
+        var = sqs / cj[:, None] - mu * mu + self.getVarianceSmoothing() \
             + 1e-9 * jnp.var(xj, axis=0)[None]
-        priors = jnp.log(counts / counts.sum())
-        return (NaiveBayesModel().setFeaturesCol(self.getFeaturesCol())
-                .setClassLogPriors(np.asarray(priors))
-                .setMeans(np.asarray(mu))
+        return (model.setMeans(np.asarray(mu))
                 .setVariances(np.maximum(np.asarray(var), 1e-9)))
 
 

@@ -151,6 +151,19 @@ class _BoosterParams:
         return meshlib.create_mesh()
 
 
+def _fleet_fit_guard():
+    """One critical section for an entire multi-process fit (feature-plan
+    collectives + engine fit): separate lock acquisitions would let another
+    thread's collectives land between them in a different order on each
+    process and pair cross-purpose. Reentrant with the engine's own
+    acquisition. Single-process fits skip it — the tuner's thread pool
+    depends on concurrent single-device fits."""
+    import contextlib
+    if jax.process_count() > 1:
+        return meshlib.collective_fit_lock
+    return contextlib.nullcontext()
+
+
 def _fleet_doc_freq(mat_csc):
     """Per-column nonzero counts, summed over every process's shard when
     the fit is multi-process. Feature selection and EFB planning MUST key
@@ -458,21 +471,24 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
     """Binary/multiclass boosted trees (reference: LightGBMClassifier.scala:32)."""
 
     def fit(self, df: DataFrame) -> LightGBMClassificationModel:
-        x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
-        y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
-        classes = np.unique(y.astype(np.int64))
-        if not np.array_equal(classes, np.arange(len(classes))) or \
-                not np.allclose(y, y.astype(np.int64)):
-            raise ValueError(
-                f"labels must be consecutive integers 0..K-1, got classes "
-                f"{classes.tolist()}; index them first (e.g. ValueIndexer)")
-        num_class = len(classes)
-        objective = "binary" if num_class <= 2 else "multiclass"
-        cats = _categorical_slots(df, self.getFeaturesCol(),
-                                  self.getCategoricalSlotIndexes(), sel)
-        ens = _fit_ensemble(self, x, y, objective,
-                            num_class=(num_class if objective == "multiclass" else 1),
-                            categorical=tuple(cats) + bundle_cats)
+        with _fleet_fit_guard():
+            x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
+            y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
+            classes = np.unique(y.astype(np.int64))
+            if not np.array_equal(classes, np.arange(len(classes))) or \
+                    not np.allclose(y, y.astype(np.int64)):
+                raise ValueError(
+                    f"labels must be consecutive integers 0..K-1, got "
+                    f"classes {classes.tolist()}; index them first "
+                    f"(e.g. ValueIndexer)")
+            num_class = len(classes)
+            objective = "binary" if num_class <= 2 else "multiclass"
+            cats = _categorical_slots(df, self.getFeaturesCol(),
+                                      self.getCategoricalSlotIndexes(), sel)
+            ens = _fit_ensemble(
+                self, x, y, objective,
+                num_class=(num_class if objective == "multiclass" else 1),
+                categorical=tuple(cats) + bundle_cats)
         return (LightGBMClassificationModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(objective)
@@ -512,13 +528,14 @@ class LightGBMRegressor(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams):
     alpha = FloatParam("quantile level", default=0.9, min=0.0, max=1.0)
 
     def fit(self, df: DataFrame) -> LightGBMRegressionModel:
-        x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
-        y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
-        cats = _categorical_slots(df, self.getFeaturesCol(),
-                                  self.getCategoricalSlotIndexes(), sel)
-        ens = _fit_ensemble(self, x, y, self.getApplication(),
-                            alpha=self.getAlpha(),
-                            categorical=tuple(cats) + bundle_cats)
+        with _fleet_fit_guard():
+            x, sel, bundles, bundle_cats = _prepare_fit_features(self, df)
+            y = np.asarray(df.col(self.getLabelCol())).astype(np.float32)
+            cats = _categorical_slots(df, self.getFeaturesCol(),
+                                      self.getCategoricalSlotIndexes(), sel)
+            ens = _fit_ensemble(self, x, y, self.getApplication(),
+                                alpha=self.getAlpha(),
+                                categorical=tuple(cats) + bundle_cats)
         return (LightGBMRegressionModel()
                 .setFeaturesCol(self.getFeaturesCol())
                 .setObjective(self.getApplication())

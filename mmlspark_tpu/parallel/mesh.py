@@ -29,8 +29,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # across the same devices and deadlock (each device waits on a different
 # collective). Any fit that runs a multi-device collective program while
 # other fits may run on other threads (e.g. TuneHyperparameters' pool)
-# must hold this lock; single-device fits need not.
-collective_fit_lock = threading.Lock()
+# must hold this lock; single-device fits need not. Reentrant so a stage
+# can span feature-planning collectives AND the engine fit (which acquires
+# it again) in one critical section — two separate acquisitions would let
+# another thread's collectives interleave between them with a different
+# order on each process.
+collective_fit_lock = threading.RLock()
 
 
 def create_mesh(data: Optional[int] = None, model: int = 1,

@@ -144,7 +144,8 @@ _t(LogisticRegression, lambda: TestObject(
     LogisticRegression().setMaxIter(10), TAB))
 _t(LinearRegression, lambda: TestObject(
     LinearRegression().setLabelCol("rlabel").setMaxIter(10), TAB))
-_t(NaiveBayes, lambda: TestObject(NaiveBayes(), TAB))
+_t(NaiveBayes, lambda: TestObject(
+    NaiveBayes().setModelType("gaussian"), TAB))
 _t(DecisionTreeClassifier, lambda: TestObject(
     DecisionTreeClassifier().setMaxBin(15), TAB))
 _t(DecisionTreeRegressor, lambda: TestObject(
@@ -199,14 +200,15 @@ _t(ComputePerInstanceStatistics, lambda: TestObject(
     ComputePerInstanceStatistics().setLabelCol("label")
     .setScoresCol("prediction"), _stats_df()))
 _t(TuneHyperparameters, lambda: TestObject(
-    TuneHyperparameters().setModels((NaiveBayes(),))
+    TuneHyperparameters().setModels((NaiveBayes()
+                                     .setModelType("gaussian"),))
     .setEvaluationMetric("accuracy").setNumFolds(2).setNumRuns(1)
     .setParallelism(1), TAB.select("features", "label")))
 
 
 def _find_best():
     df = TAB.select("features", "label")
-    m1 = NaiveBayes().fit(df)
+    m1 = NaiveBayes().setModelType("gaussian").fit(df)
     return TestObject(FindBestModel().setModels((m1,))
                       .setEvaluationMetric("accuracy"), df)
 
