@@ -102,10 +102,28 @@ def initialize_from_env() -> bool:
     addr = os.environ.get(ENV_COORDINATOR)
     if not addr:
         return False
+    configure_xla_cache()
     initialize(coordinator_address=addr,
                num_processes=int(os.environ[ENV_NUM_PROCESSES]),
                process_id=int(os.environ[ENV_PROCESS_ID]))
     return True
+
+
+def configure_xla_cache() -> None:
+    """Enable the persistent XLA compilation cache (HLO-hash keyed, so
+    never stale). Fleet workers and CI runs recompile the same programs on
+    every launch; the cache turns that into a disk read — worth minutes on
+    small hosts. MMLTPU_XLA_CACHE="" opts out; the single source of the
+    dir/threshold policy (tests/conftest.py calls this too)."""
+    cache = os.environ.get("MMLTPU_XLA_CACHE", "/tmp/mmlspark_tpu_xla_cache")
+    if not cache:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+    except Exception:  # cache is an optimization, never a requirement
+        pass
 
 
 def shutdown() -> None:

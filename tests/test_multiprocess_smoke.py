@@ -1,0 +1,175 @@
+"""DEFAULT-TIER multi-process smokes.
+
+The framework's differentiating paths — real 2-process collective fits,
+worker-OS-process serving, crash-then-resume — live in the extended tier
+(minutes of fleet spawns). These three slimmed smokes gate one cheap
+representative of each family on EVERY default `pytest tests/ -q` run, so
+a regression in process rendezvous, the serving worker protocol, or
+checkpoint resume can't hide until someone sets MMLTPU_TESTS=extended.
+(Reference analog: TestBase.scala keeps a fast tag of every suite in the
+per-commit tier.)
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_DATAPLANE_SMOKE = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+import hashlib
+import numpy as np
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+from mmlspark_tpu.parallel import distributed as dist
+from mmlspark_tpu.parallel import dataplane as dp
+from mmlspark_tpu.parallel.dataplane import ShardedDataFrame
+
+assert dist.initialize_from_env() is True
+pid = jax.process_index()
+
+# sharded relational op: fleet-wide groupBy matches the union
+rng = np.random.default_rng(5 + pid)
+n = 60 + 20 * pid
+ks = np.array(["a", "b"], dtype=object)[rng.integers(0, 2, n)]
+xs = rng.normal(size=n)
+sdf = ShardedDataFrame.fromLocal(DataFrame({"k": ks, "x": xs}))
+got = sdf.groupBy("k").agg({"x": "sum"}).sort("k")
+gsum = {k: 0.0 for k in ("a", "b")}
+for kk, xx in zip(*map(np.concatenate,
+                       zip(*dp.allgather_pyobj((ks, xs))))):
+    gsum[kk] += xx
+np.testing.assert_allclose(
+    np.asarray(got.col("sum(x)"), np.float64),
+    [gsum["a"], gsum["b"]], rtol=1e-9)
+
+# tiny collective estimator fit: every process ends with the same model
+x = rng.normal(size=(n, 4)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.float64)
+df = DataFrame({"features": object_column([r for r in x]), "label": y})
+m = (LightGBMClassifier().setNumIterations(3).setNumLeaves(7)
+     .setMaxBin(31)).fit(df)
+state = m.getBoosterState()
+digest = hashlib.sha256(
+    b"".join(np.ascontiguousarray(state[k]).tobytes()
+             for k in sorted(state)
+             if isinstance(state[k], np.ndarray))).hexdigest()
+assert len(set(dp.allgather_pyobj(digest))) == 1
+dist.process_barrier("smoke")
+dist.shutdown()
+print("SMOKE_DATAPLANE_OK")
+'''
+
+
+def test_smoke_two_process_collective_fit(tmp_path):
+    """ONE real 2-process path per default run: rendezvous, a sharded
+    groupBy merge, and a 3-iteration collective GBDT fit with replicated
+    digests."""
+    worker = tmp_path / "smoke_worker.py"
+    worker.write_text(_DATAPLANE_SMOKE)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   MMLTPU_NUM_PROCESSES="2",
+                   MMLTPU_PROCESS_ID=str(pid),
+                   MMLTPU_INIT_TIMEOUT="60")
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        out, err = p.communicate(timeout=150)
+        assert p.returncode == 0, (out[-1500:], err[-1500:])
+        assert "SMOKE_DATAPLANE_OK" in out
+
+
+def test_smoke_serving_worker_process():
+    """A real worker OS process serves one client request through the
+    poll/respond control protocol (the fleet loop's core contract)."""
+    from mmlspark_tpu.io.http.fleet import _Worker
+
+    w = _Worker("127.0.0.1", 0, 0)
+    try:
+        got = {}
+        t = threading.Thread(target=lambda: got.update(r=urllib.request.urlopen(
+            urllib.request.Request(w.url, data=b"ping"), timeout=30)))
+        t.start()
+        row = None
+        deadline = time.monotonic() + 20
+        while row is None and time.monotonic() < deadline:
+            rows = w.poll(4, 0.05)
+            if rows:
+                row = rows[0]
+        assert row is not None and row[1] == "ping"
+        w.respond([[row[0], 200, "pong"]])
+        t.join(timeout=20)
+        assert got["r"].status == 200 and got["r"].read() == b"pong"
+    finally:
+        w.kill()
+
+
+def test_smoke_checkpoint_crash_resume(tmp_path):
+    """A training process killed right after its first checkpoint leaves a
+    resumable state: the relaunch picks the checkpoint up instead of
+    restarting from scratch (single-process slim of the extended 2-process
+    crash test)."""
+    ck = tmp_path / "ck"
+    src = (
+        "import os, sys, threading, time\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from mmlspark_tpu import DataFrame\n"
+        "from mmlspark_tpu.core.utils import object_column\n"
+        "from mmlspark_tpu.models import TpuLearner\n"
+        f"ck = {str(ck)!r}\n"
+        "die = len(sys.argv) > 1 and sys.argv[1] == 'die'\n"
+        "epochs = 4 if die else 6   # resume must always have work left\n"
+        "if die:\n"
+        "    def _die():\n"
+        "        while not os.path.exists(\n"
+        "                os.path.join(ck, 'ckpt_00000.msgpack')):\n"
+        "            time.sleep(0.02)\n"
+        "        os._exit(9)\n"
+        "    threading.Thread(target=_die, daemon=True).start()\n"
+        "rng = np.random.default_rng(0)\n"
+        "x = rng.normal(size=(32, 4)).astype(np.float32)\n"
+        "y = (x[:, 0] > 0).astype(np.int64)\n"
+        "df = DataFrame({'features': object_column([r for r in x]),\n"
+        "                'label': y})\n"
+        "learner = (TpuLearner()\n"
+        "           .setModelConfig({'type': 'mlp', 'hidden': [4],\n"
+        "                            'num_classes': 2})\n"
+        "           .setEpochs(epochs).setBatchSize(16)\n"
+        "           .setLearningRate(0.05).setCheckpointDir(ck))\n"
+        "resumed = learner._latest_checkpoint()\n"
+        "model = learner.fit(df)\n"
+        "assert np.isfinite(model._final_loss)\n"
+        "print('SMOKE_RESUME_OK', resumed)\n")
+    wf = tmp_path / "resume_worker.py"
+    wf.write_text(src)
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    p1 = subprocess.run([sys.executable, str(wf), "die"], env=env,
+                        capture_output=True, text=True, timeout=120)
+    assert p1.returncode == 9, (p1.stdout[-800:], p1.stderr[-800:])
+    assert os.path.exists(ck / "ckpt_00000.msgpack")
+    p2 = subprocess.run([sys.executable, str(wf)], env=env,
+                        capture_output=True, text=True, timeout=120)
+    assert p2.returncode == 0, (p2.stdout[-800:], p2.stderr[-800:])
+    line = [l for l in p2.stdout.splitlines() if "SMOKE_RESUME_OK" in l][-1]
+    assert line.split()[-1] != "None", line   # resumed from run 1's epoch

@@ -244,3 +244,57 @@ class TestCsv:
         np.savetxt(p, mat, delimiter=",", fmt="%.1f")
         out = read_csv_matrix(str(p), threads=4)
         np.testing.assert_allclose(out, mat)
+
+
+class TestLoaderOverlap:
+    """The loader's REASON to exist is overlap: C++ decode threads fill the
+    prefetch queue while the consumer computes (on TPU, while the chip
+    runs). Throughput numbers on the tunnel box are transfer-confounded
+    (BASELINE.md), so this asserts the overlap itself, hardware-free: a
+    consumer that sleeps s per batch (device compute uses no host CPU) must
+    finish in well under decode_time + sleep_time."""
+
+    def _mk_corpus(self, tmp_path, n=48, hw=384):
+        import cv2
+        rng = np.random.default_rng(0)
+        paths = []
+        for i in range(n):
+            img = rng.integers(0, 255, (hw, hw, 3), dtype=np.uint8)
+            p = str(tmp_path / f"img_{i:03d}.jpg")
+            assert cv2.imwrite(p, img)
+            paths.append(p)
+        return paths
+
+    def test_decode_overlaps_consumer_compute(self, tmp_path):
+        import time
+
+        from mmlspark_tpu.io.loader import image_batches
+
+        paths = self._mk_corpus(tmp_path)
+        batch = 8
+        n_batches = len(paths) // batch
+
+        def run(sleep_per_batch: float) -> float:
+            t0 = time.perf_counter()
+            seen = 0
+            for buf, ok, count in image_batches(paths, batch, 128, 128,
+                                                threads=2, prefetch=4):
+                assert ok.all()
+                seen += count
+                if sleep_per_batch:
+                    time.sleep(sleep_per_batch)
+            assert seen == len(paths)
+            return time.perf_counter() - t0
+
+        run(0.0)                      # warm the page cache / lib load
+        t_decode = run(0.0)           # pure decode wall-clock
+        s = max(t_decode / n_batches, 0.02)   # compute ~= decode per batch
+        serial_sum = t_decode + s * n_batches
+        t_overlap = run(s)
+        # perfect overlap ~= max(decode, sleep) + one batch; zero overlap
+        # = serial_sum. The 0.8 bound means at least ~20% of the serial
+        # time was hidden — impossible unless decode ran DURING the sleeps.
+        assert t_overlap < 0.8 * serial_sum, (
+            f"no decode/compute overlap: overlapped {t_overlap:.3f}s vs "
+            f"serial {serial_sum:.3f}s (decode {t_decode:.3f}s, "
+            f"sleep {s * n_batches:.3f}s)")
