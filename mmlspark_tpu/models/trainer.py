@@ -124,21 +124,67 @@ def _scan_batch(bs: int, mesh, micro: int = 1) -> int:
     return -(-bs // mult) * mult
 
 
+def _host_tree(tree):
+    """Pytree of device arrays -> host numpy. Handles multiprocess
+    TP-sharded leaves: the trainer constrains model axes to be
+    process-local, so each process's addressable shards cover the full
+    array (replicated leaves read the local copy directly)."""
+    def conv(a):
+        if not isinstance(a, jax.Array) \
+                or meshlib.effective_process_count() == 1 \
+                or a.is_fully_replicated:
+            return np.asarray(a)
+        out = np.empty(a.shape, a.dtype)
+        for sh in a.addressable_shards:
+            out[sh.index] = np.asarray(sh.data)
+        return out
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def _replace_like(host_tree, placed_tree):
+    """Put a host-numpy tree back onto the shardings of an already-placed
+    tree (multi-process checkpoint restore: device_put cannot target
+    non-addressable devices, so rebuild each global array from the local
+    slice of the identical host value every process holds)."""
+    def conv(h, p):
+        if not isinstance(p, jax.Array):
+            return h
+        host = np.asarray(h)
+        return jax.make_array_from_callback(
+            host.shape, p.sharding, lambda idx, hh=host: hh[idx])
+    return jax.tree_util.tree_map(conv, host_tree, placed_tree)
+
+
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
-    """Place params on the mesh (TP/EP sharding rules or replication) and
-    init the optimizer AFTER placement, under jit, so optax's zeros_like
-    buffers inherit the param shardings instead of being replicated."""
+    """Place params AND optimizer state on the mesh with explicit
+    shardings. The opt state is initialized on host and placed under the
+    same rules as the params (optax state trees embed the param tree, so
+    the path-substring rules match the mirrored buffers) — letting jit
+    infer the init's output shardings instead leaves them compiler-chosen,
+    which on a multi-process mesh can land buffers on one device per
+    process and poison every later step with inconsistent shardings."""
     from jax.sharding import PartitionSpec as P
     rules = []
     if ep > 1:
         rules += [("expert_w", P("expert",)), ("expert_b", P("expert",))]
     if tp > 1:
         rules += [("Dense", P(None, "model")), ("kernel", P())]
+    if meshlib.effective_process_count() == 1:
+        # single process: jit-inferred init shardings are correct AND free
+        # (no host round-trip of the whole model)
+        if rules:
+            params = meshlib.shard_params_tp(params, mesh, rules)
+        else:
+            params = meshlib.put_replicated(params, mesh)
+        return params, jax.jit(tx.init)(params)
+    opt = tx.init(jax.tree_util.tree_map(np.asarray, params))
     if rules:
         params = meshlib.shard_params_tp(params, mesh, rules)
+        opt = meshlib.shard_params_tp(opt, mesh, rules)
     else:
         params = meshlib.put_replicated(params, mesh)
-    return params, jax.jit(tx.init)(params)
+        opt = meshlib.put_replicated(opt, mesh)
+    return params, opt
 
 
 def _make_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float):
@@ -308,9 +354,8 @@ class TpuLearner(Estimator):
 
     def _save_checkpoint(self, epoch: int, params, opt_state):
         os.makedirs(self.getCheckpointDir(), exist_ok=True)
-        state = {"params": jax.tree_util.tree_map(np.asarray, params),
-                 "opt": serialization.to_state_dict(
-                     jax.tree_util.tree_map(np.asarray, opt_state))}
+        state = {"params": _host_tree(params),
+                 "opt": serialization.to_state_dict(_host_tree(opt_state))}
         # write-then-rename: a crash mid-write must never leave a truncated
         # file that _latest_checkpoint would pick and brick the resume
         path = self._ckpt_path(epoch)
@@ -395,7 +440,7 @@ class TpuLearner(Estimator):
             if n_dev % pp != 0:
                 raise ValueError(f"pipelineParallel ({pp}) must divide the "
                                  f"device count ({n_dev})")
-            if jax.process_count() > 1:
+            if meshlib.effective_process_count() > 1:
                 raise ValueError("pipelineParallel is single-host (see the "
                                  "multi-host scope note below)")
             mesh = meshlib.make_mesh({"data": n_dev // pp, "pipe": pp})
@@ -415,12 +460,21 @@ class TpuLearner(Estimator):
         # over `model`; EP rules shard stacked expert weights over `expert`);
         # batch sharded over `data`. XLA derives the gradient all-reduce +
         # any TP/EP collectives from these shardings alone.
-        nproc = jax.process_count()
-        if nproc > 1 and (tp > 1 or sp > 1 or ep > 1):
+        nproc = meshlib.effective_process_count()
+        if nproc > 1 and (sp > 1 or ep > 1):
             raise ValueError(
-                "multi-host training currently supports data parallelism "
-                "only (the reference's scope, SURVEY.md §2.7); run tp/sp/ep "
-                "within one host or shard the model axes over local devices")
+                "multi-host training composes dp (across hosts) with tp "
+                "(across each host's chips); sequence/expert parallelism "
+                "are single-host — run sp/ep within one host")
+        if nproc > 1 and tp > 1:
+            n_local = jax.local_device_count()
+            if tp > n_local or n_local % tp != 0:
+                raise ValueError(
+                    f"tensorParallel ({tp}) must divide the LOCAL device "
+                    f"count ({n_local}) on a multi-host mesh: the model "
+                    f"axis must ride ICI within a host while dp crosses "
+                    f"hosts (checkpointing and model export also need "
+                    f"process-locally-complete params)")
         params, opt_state = _place_params(params, mesh, tx, tp=tp, ep=ep)
 
         # only the transformer family reads num_experts (modules.py builder);
@@ -458,7 +512,13 @@ class TpuLearner(Estimator):
             # dataset too big for HBM residency: per-step host feed
             train_step = _make_train_step(module, tx, loss_fn, is_moe,
                                           moe_aux, step_body=pp_body)
-        rng_np = np.random.default_rng(self.getSeed() + jax.process_index())
+        # per-process batch orders only matter when processes feed distinct
+        # dp shards; in local-fit mode (fleet tuner trials/refits) every
+        # process must draw the IDENTICAL order or the replicated-model
+        # guarantee breaks
+        rng_np = np.random.default_rng(
+            self.getSeed() + (0 if meshlib.in_local_fit()
+                              else jax.process_index()))
         start_epoch = 0
         resume = self._latest_checkpoint()
         if nproc > 1 and self.getCheckpointDir():
@@ -478,7 +538,13 @@ class TpuLearner(Estimator):
                         "fresh on all processes", seen.tolist())
                 resume = None
         if resume is not None:
+            placed = (params, opt_state)
             params, opt_state = self._restore_checkpoint(resume, params, opt_state)
+            if nproc > 1:
+                # restored host arrays must go back onto the global mesh
+                # shardings (replicated for dp, model-axis for tp)
+                params = _replace_like(params, placed[0])
+                opt_state = _replace_like(opt_state, placed[1])
             start_epoch = resume + 1
             log.info("resumed from checkpoint epoch %d", resume)
 
@@ -500,7 +566,7 @@ class TpuLearner(Estimator):
         model = (TpuModel()
                  .setInputCol(self.getFeaturesCol())
                  .setModelConfig(cfg)
-                 .setModelParams(jax.tree_util.tree_map(np.asarray, params))
+                 .setModelParams(_host_tree(params))
                  .setInputShape(tuple(self.getInputShape())))
         model._final_loss = last_loss
         return model
@@ -522,7 +588,7 @@ class TpuLearner(Estimator):
         cfg = dict(self.getModelConfig())
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
                 or self.getPipelineParallel() > 1
-                or jax.process_count() > 1):
+                or meshlib.effective_process_count() > 1):
             raise ValueError(
                 "fitStream is single-host data(+tensor)-parallel; use "
                 "fit() for sequence/expert/pipeline parallelism or "

@@ -36,6 +36,45 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # order on each process.
 collective_fit_lock = threading.RLock()
 
+# ---- local-fit mode -------------------------------------------------------
+# Embarrassingly-parallel search on a fleet (TuneHyperparameters) assigns
+# whole trials to processes; each process then fits ITS trials with no
+# cross-process collectives at all. Inside this mode every fit behaves as a
+# single-process single-device program: effective_process_count() is 1 and
+# create_mesh()/make_mesh() default to one local device. A module-level
+# counter (not a contextvar) because the tuner's worker THREADS must see
+# the flag set by the coordinating thread.
+_local_fit_count = 0
+_local_fit_guard = threading.Lock()
+
+
+class local_fit_mode:
+    """Context manager: fits inside run process-locally (no collectives)."""
+
+    def __enter__(self):
+        global _local_fit_count
+        with _local_fit_guard:
+            _local_fit_count += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _local_fit_count
+        with _local_fit_guard:
+            _local_fit_count -= 1
+        return False
+
+
+def in_local_fit() -> bool:
+    return _local_fit_count > 0
+
+
+def effective_process_count() -> int:
+    """jax.process_count(), except 1 inside local-fit mode — the switch
+    that steers every fleet-collective code path (pooled GBDT statistics,
+    multi-host batch assembly, trainer rendezvous) to its single-process
+    form."""
+    return 1 if in_local_fit() else jax.process_count()
+
 
 def create_mesh(data: Optional[int] = None, model: int = 1,
                 devices: Optional[Sequence] = None,
@@ -47,7 +86,10 @@ def create_mesh(data: Optional[int] = None, model: int = 1,
     the core TPU-first contract (vs. the reference's separate single-node and
     MPI code paths, CommandBuilders.scala:90-100 vs :149-267).
     """
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        devices = ([jax.local_devices()[0]] if in_local_fit()
+                   else jax.devices())
+    devices = list(devices)
     n = len(devices)
     if data is None:
         data = n // model
@@ -65,7 +107,10 @@ def make_mesh(axes: dict[str, int],
     """Build an N-D mesh from {axis_name: size}. Axis order = dict order
     (outermost first — put ``data`` outermost so DP collectives cross the
     slowest links and tp/sp/ep ride contiguous ICI neighbors)."""
-    devices = list(devices if devices is not None else jax.devices())
+    if devices is None:
+        devices = ([jax.local_devices()[0]] if in_local_fit()
+                   else jax.devices())
+    devices = list(devices)
     sizes = list(axes.values())
     if any(s < 1 for s in sizes):
         raise ValueError(f"mesh axes must be >= 1, got {axes}")
@@ -128,7 +173,7 @@ def pad_batch_to_local_devices(arr: np.ndarray, mesh: Mesh,
     every process must end up with the SAME padded length — callers feed
     equal-length slices (models.trainer synchronizes the per-step row count)."""
     return _pad_rows_to_multiple(arr, mesh.shape[batch_axis]
-                                 // jax.process_count())
+                                 // effective_process_count())
 
 
 def local_rows(global_array, n: Optional[int] = None) -> np.ndarray:
@@ -147,7 +192,7 @@ def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
     array is assembled from every process's shard (the reference has no
     analog — its data stays in Spark partitions and is shipped per-worker
     over scp/JNI, CommandBuilders.scala:200-228)."""
-    if jax.process_count() == 1:
+    if effective_process_count() == 1:
         if mesh.size == 1:  # trivial mesh: stay off the SPMD path
             import jax.numpy as jnp
             return jnp.asarray(arr)
@@ -163,7 +208,7 @@ def put_replicated(tree, mesh: Mesh):
     if mesh.size == 1:
         import jax.numpy as jnp
         return jax.tree_util.tree_map(jnp.asarray, tree)
-    if jax.process_count() == 1:
+    if effective_process_count() == 1:
         return jax.device_put(tree, replicated(mesh))
     sh = replicated(mesh)
     return jax.tree_util.tree_map(
@@ -192,6 +237,7 @@ def shard_params_tp(params, mesh: Mesh, rules: Sequence[tuple[str, P]] = (),
                 return False
         return True
 
+    multiproc = effective_process_count() > 1
     for path, leaf in leaves:
         pstr = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
         spec = default if default is not None else P()
@@ -200,5 +246,15 @@ def shard_params_tp(params, mesh: Mesh, rules: Sequence[tuple[str, P]] = (),
                     and _divisible(leaf, candidate)):
                 spec = candidate
                 break
-        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+        sh = NamedSharding(mesh, spec)
+        if multiproc:
+            # process-spanning mesh: every process holds the identical full
+            # value (same-seed init), so each addressable shard is a slice
+            # of the local copy — device_put cannot target non-addressable
+            # devices
+            host = np.asarray(leaf)
+            out.append(jax.make_array_from_callback(
+                host.shape, sh, lambda idx, h=host: h[idx]))
+        else:
+            out.append(jax.device_put(leaf, sh))
     return jax.tree_util.tree_unflatten(treedef, out)
