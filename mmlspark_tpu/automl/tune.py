@@ -180,30 +180,78 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                 for fi in range(self.getNumFolds())]
         results = np.zeros(len(jobs))
         import jax
+
+        from ..parallel import dataplane
+        from ..parallel import mesh as meshlib
         width = self.getParallelism()
-        if jax.process_count() > 1 and width > 1:
-            # multi-process fleets must issue collective fits in the SAME
-            # order everywhere; a thread pool completes in nondeterministic
-            # order per process, which would pair one process's fit-A
-            # collectives with another's fit-B. Width 1 = submission order.
-            from ..core.utils import get_logger
-            get_logger("tune").warning(
-                "multi-process fleet: forcing tuner parallelism 1 so "
-                "collective fits stay ordered across processes")
-            width = 1
-        with ThreadPoolExecutor(width) as pool:
-            futs = {pool.submit(eval_fold, candidates[ci][0],
-                                candidates[ci][1], fi): j
-                    for j, (ci, fi) in enumerate(jobs)}
-            for fut, j in futs.items():
-                results[j] = fut.result()
+        nproc = jax.process_count()
+        if nproc > 1:
+            # FLEET-PARALLEL SEARCH: trials are embarrassingly parallel, so
+            # assign each (candidate, fold) job to one process round-robin;
+            # inside local_fit_mode the fits run process-locally with zero
+            # cross-process collectives (the reference's thread-pool trick,
+            # TuneHyperparameters.scala:78-94, scaled across the fleet).
+            # Every process needs the full tuning frame for exact CV — the
+            # tuning set is driver-sized by construction (the same
+            # assumption the reference's in-memory folds make).
+            if dataplane.is_sharded(df):
+                gathered = dataplane._gather_frames(df.localFrame())
+                folds = _kfold_indices(gathered.count(), self.getNumFolds(),
+                                       self.getSeed())
+                df = gathered
+                mask_cache.clear()
+            else:
+                # a PLAIN frame on a fleet is ambiguous: the SPMD
+                # convention reads it as this-process's shard, but local
+                # trials need the full data. Detect by content: identical
+                # frames everywhere = replicated (use as-is); differing
+                # frames = shards (gather them).
+                import hashlib
+                import pickle as _pickle
+                digest = hashlib.sha256(_pickle.dumps(
+                    {k: np.asarray(v).tobytes() if v.dtype.kind != "O"
+                     else _pickle.dumps(v.tolist())
+                     for k, v in df._cols.items()})).hexdigest()
+                if len(set(dataplane.allgather_pyobj(digest))) > 1:
+                    gathered = dataplane._gather_frames(df)
+                    folds = _kfold_indices(gathered.count(),
+                                           self.getNumFolds(),
+                                           self.getSeed())
+                    df = gathered
+                    mask_cache.clear()
+            mine = [j for j in range(len(jobs))
+                    if j % nproc == jax.process_index()]
+            with meshlib.local_fit_mode(), ThreadPoolExecutor(width) as pool:
+                futs = {pool.submit(eval_fold, candidates[ci][0],
+                                    candidates[ci][1], fi): j
+                        for j, (ci, fi) in ((j, jobs[j]) for j in mine)}
+                for fut, j in futs.items():
+                    results[j] = fut.result()
+            # merge: each job was computed by exactly one process
+            results = dataplane.allreduce_sum(results)
+        else:
+            with ThreadPoolExecutor(width) as pool:
+                futs = {pool.submit(eval_fold, candidates[ci][0],
+                                    candidates[ci][1], fi): j
+                        for j, (ci, fi) in enumerate(jobs)}
+                for fut, j in futs.items():
+                    results[j] = fut.result()
 
         per_candidate = results.reshape(len(candidates), self.getNumFolds())
         means = per_candidate.mean(axis=1)
         best_i = int(np.argmax(means) if maximize else np.argmin(means))
         best_est, best_setting = candidates[best_i]
-        best_model = best_est.copy(
-            dict(best_setting, labelCol=label)).fit(df)
+        if nproc > 1:
+            # every process holds the SAME full tuning frame here; a
+            # process-local deterministic refit gives the identical model
+            # everywhere without treating the replicated frame as a shard
+            # (the collective path would see nproc duplicated copies)
+            with meshlib.local_fit_mode():
+                best_model = best_est.copy(
+                    dict(best_setting, labelCol=label)).fit(df)
+        else:
+            best_model = best_est.copy(
+                dict(best_setting, labelCol=label)).fit(df)
         return (TuneHyperparametersModel()
                 .setBestModel(best_model)
                 .setBestMetric(float(means[best_i]))

@@ -503,7 +503,8 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         hist_impl = ("pallas" if jax.default_backend() == "tpu"
                      and mesh is None else "segment")
     real = slice(None) if sample_weight is None else sample_weight > 0
-    nproc = jax.process_count()
+    from ...parallel import mesh as _meshlib
+    nproc = _meshlib.effective_process_count()
     if nproc > 1:
         # MULTI-PROCESS fit: `x` is THIS process's row shard (the Spark-
         # partition analog; the reference's per-partition LightGBM workers,

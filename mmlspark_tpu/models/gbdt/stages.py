@@ -138,7 +138,11 @@ class _BoosterParams:
         the user left ``parallelism`` at its default, small fits fall back
         to the single-device program (also keeps thread-pooled tuning over
         small folds collective-free); an explicit setting is honored."""
-        if jax.process_count() > 1:
+        if meshlib.in_local_fit():
+            # trial-to-process tuning: this fit must stay process-local
+            # and collective-free — the serial program
+            return None
+        if meshlib.effective_process_count() > 1:
             # multi-process fleets ALWAYS run the collective program — the
             # small-fit heuristic would diverge on per-process shard sizes
             # (SPMD demands every process make the same choice)
@@ -159,7 +163,7 @@ def _fleet_fit_guard():
     acquisition. Single-process fits skip it — the tuner's thread pool
     depends on concurrent single-device fits."""
     import contextlib
-    if jax.process_count() > 1:
+    if meshlib.effective_process_count() > 1:
         return meshlib.collective_fit_lock
     return contextlib.nullcontext()
 
@@ -173,7 +177,7 @@ def _fleet_doc_freq(mat_csc):
     everywhere — a silently corrupt model. Callers guarantee every process
     reaches this together (_check_fleet_features)."""
     doc_freq = np.diff(mat_csc.indptr)
-    if jax.process_count() > 1:
+    if meshlib.effective_process_count() > 1:
         from ...parallel import dataplane
         doc_freq = dataplane.allreduce_sum(doc_freq.astype(np.int64))
     return doc_freq
@@ -186,7 +190,7 @@ def _check_fleet_features(mat):
     and hang or corrupt) — so the branch inputs themselves (sparse-ness,
     width) are validated fleet-wide here, in ONE collective all processes
     always reach."""
-    if jax.process_count() == 1:
+    if meshlib.effective_process_count() == 1:
         return
     from ...parallel import dataplane
     info = dataplane.allgather_pyobj(
@@ -251,7 +255,7 @@ def _prepare_fit_features(stage, df):
         seed = stage.getOrDefault("seed")
         doc_freq = _fleet_doc_freq(mat)
         plan_mat = (_pooled_row_sample(mat, seed).tocsc()
-                    if jax.process_count() > 1 else mat)
+                    if meshlib.effective_process_count() > 1 else mat)
         dense, bundles = plan_and_split(plan_mat, cap,
                                         stage.getOrDefault("maxBin"),
                                         seed, doc_freq=doc_freq)
@@ -357,7 +361,7 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
                   categorical=()):
     p = params_holder._engine_params(objective, num_class, alpha, categorical)
     mesh = params_holder._mesh(x.shape[0])
-    nproc = jax.process_count()
+    nproc = meshlib.effective_process_count()
     if nproc > 1 and p.tree_learner not in ("data", "auto"):
         raise ValueError(
             "multi-process GBDT fits shard rows across processes and need "

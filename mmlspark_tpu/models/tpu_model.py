@@ -245,7 +245,8 @@ class TpuModel(Transformer):
             x = x.astype(ml_dtypes.bfloat16)
         mesh = self._cached_mesh()
         apply_fn = self._apply_fn()
-        nproc = jax.process_count()
+        from ..parallel import mesh as _meshlib
+        nproc = _meshlib.effective_process_count()
         params = self._device_params(mesh)
         if nproc > 1:
             # multi-host: this df is the process-local shard; SPMD demands

@@ -42,12 +42,16 @@ log = get_logger("dataplane")
 
 def nprocs() -> int:
     import jax
-    return jax.process_count()
+    from . import mesh as _meshlib
+    return _meshlib.effective_process_count()
 
 
 def pid() -> int:
     import jax
-    return jax.process_index()
+    from . import mesh as _meshlib
+    # local-fit mode presents a single-process world: pid must be 0 when
+    # nprocs() reports 1, or shard_paths-style arithmetic drops data
+    return 0 if _meshlib.in_local_fit() else jax.process_index()
 
 
 def shard_paths(paths: Sequence[str]) -> list[str]:
