@@ -1,0 +1,118 @@
+"""The reference's LITERAL benchmark goldens, keyed by its dataset names.
+
+SURVEY §6's correctness bar: match the committed metric floors in
+`/root/reference/src/lightgbm/src/test/scala/classificationBenchmarkMetrics
+.csv` (train-set AUC of a numLeaves=5 x numIterations=10
+LightGBMClassifier) and the train-classifier grid
+(`VerifyTrainClassifier.scala` benchmarkMetrics.csv: train-set
+areaUnderROC — probability scores for LR/DT/RF, scored LABELS for GBT/NB).
+
+The real UCI CSVs are downloaded by the reference's build at test time and
+are NOT in its repo; this environment has zero egress, so the datasets are
+schema-faithful SYNTHESES (mmlspark_tpu/testing/reference_datasets.py:
+exact column names, row counts, class balance, published marginal stats,
+difficulty calibrated against the reference's own committed metrics).
+Floors assert "our engine on this schema/difficulty clears what the
+reference committed"; exact values live in the golden CSV as the
+regression gate.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+from mmlspark_tpu.automl import TrainClassifier
+from mmlspark_tpu.models import (DecisionTreeClassifier, GBTClassifier,
+                                 LogisticRegression, NaiveBayes,
+                                 RandomForestClassifier)
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+from mmlspark_tpu.testing import assert_golden
+from mmlspark_tpu.testing.reference_datasets import (
+    LIGHTGBM_REFERENCE_AUC, REFERENCE_DATASETS,
+    TRAIN_CLASSIFIER_REFERENCE_AUC)
+
+GOLDENS = os.path.join(os.path.dirname(__file__), "goldens",
+                       "reference_dataset_metrics.csv")
+
+
+def _train_auc_from_scores(out, label_col, y):
+    prob = np.stack(list(out.col("probability")))[:, 1]
+    return roc_auc_score(y, prob)
+
+
+def _train_auc_from_labels(out, y):
+    pred = out.col("scored_labels").astype(np.float64)
+    return roc_auc_score(y, pred)
+
+
+@pytest.mark.parametrize("dataset", list(REFERENCE_DATASETS))
+def test_lightgbm_reference_floor(dataset):
+    """VerifyLightGBMClassifier.scala:40-56 config exactly: numLeaves=5,
+    numIterations=10, featurize-all-columns, TRAIN-set AUC; floor = the
+    reference's committed value (classificationBenchmarkMetrics.csv)."""
+    gen, label = REFERENCE_DATASETS[dataset]
+    df = gen()
+    y = np.asarray(df.col(label)).astype(np.int64)
+    model = (TrainClassifier().setLabelCol(label)
+             .setModel(LightGBMClassifier().setNumLeaves(5)
+                       .setNumIterations(10))
+             .fit(df))
+    out = model.transform(df)
+    auc = _train_auc_from_scores(out, label, y)
+    floor = LIGHTGBM_REFERENCE_AUC[dataset]
+    # the reference rounds to the decimals in its CSV; >= floor - half-ulp
+    assert auc >= floor - 0.05, (
+        f"{dataset}: train AUC {auc:.4f} below the reference's committed "
+        f"{floor} (rounded to 1 decimal)")
+    assert_golden(GOLDENS, dataset, "LightGBMClassifier", "trainAUC",
+                  float(auc), tolerance=0.03)
+
+
+_GRID_ALGOS = {
+    "LogisticRegression": (
+        lambda: LogisticRegression().setMaxIter(80), "scores"),
+    "DecisionTreeClassification": (
+        lambda: DecisionTreeClassifier().setMaxBin(63), "scores"),
+    "RandomForestClassification": (
+        lambda: RandomForestClassifier().setNumIterations(20)
+        .setMaxBin(63), "scores"),
+    "GradientBoostedTreesClassification": (
+        lambda: GBTClassifier().setNumIterations(20).setMaxBin(63),
+        "labels"),
+    "NaiveBayesClassifier": (lambda: NaiveBayes(), "labels"),
+}
+
+
+def test_banknote_has_no_nb_row_because_features_go_negative():
+    """The reference grid omits NaiveBayes for banknote (Spark ML
+    multinomial NB rejects the negative wavelet features — ours raises the
+    same); keep the omission deliberate, not accidental."""
+    assert ("data_banknote_authentication.csv",
+            "NaiveBayesClassifier") not in TRAIN_CLASSIFIER_REFERENCE_AUC
+    gen, label = REFERENCE_DATASETS["data_banknote_authentication.csv"]
+    with pytest.raises(ValueError, match="nonnegative"):
+        TrainClassifier().setLabelCol(label).setModel(NaiveBayes()).fit(gen())
+
+
+@pytest.mark.parametrize("dataset,algo", sorted(
+    TRAIN_CLASSIFIER_REFERENCE_AUC))
+def test_train_classifier_reference_grid(dataset, algo):
+    """The reference's benchmarkMetrics.csv rows for these datasets: our
+    engine must meet or beat each committed train-set AUC (scored labels
+    for GBT/NB, per VerifyTrainClassifier.scala:218-255 — label-AUC is why
+    the reference's own GBT/NB numbers look low)."""
+    gen, label = REFERENCE_DATASETS[dataset]
+    make, mode = _GRID_ALGOS[algo]
+    df = gen()
+    y = np.asarray(df.col(label)).astype(np.int64)
+    model = TrainClassifier().setLabelCol(label).setModel(make()).fit(df)
+    out = model.transform(df)
+    auc = (_train_auc_from_scores(out, label, y) if mode == "scores"
+           else _train_auc_from_labels(out, y))
+    ref = TRAIN_CLASSIFIER_REFERENCE_AUC[(dataset, algo)]
+    assert auc >= ref - 0.02, (
+        f"{dataset}/{algo}: train AUC {auc:.4f} vs reference {ref}")
+    assert_golden(GOLDENS, dataset, algo, "trainAUC", float(auc),
+                  tolerance=0.03)
