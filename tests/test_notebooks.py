@@ -14,13 +14,34 @@ NOTEBOOKS = sorted(glob.glob(os.path.join(REPO, "notebooks", "*.ipynb")))
 
 
 def test_notebooks_exist():
-    assert len(NOTEBOOKS) >= 4  # 103/104/105/302 analogs
+    assert len(NOTEBOOKS) >= 15  # >= 12 of the reference's 16 + extras
+
+
+#: cheap notebooks executed on EVERY default run (one representative per
+#: family: tabular automl, text, images); the rest are extended tier
+_DEFAULT = {"101_adult_census_income_training.ipynb",
+            "201_amazon_reviews_text_featurizer.ipynb",
+            "302_pipeline_image_transformations.ipynb"}
+
+
+@pytest.mark.parametrize(
+    "path", [p for p in NOTEBOOKS if os.path.basename(p) in _DEFAULT],
+    ids=[os.path.basename(p) for p in NOTEBOOKS
+         if os.path.basename(p) in _DEFAULT])
+def test_notebook_executes_default_tier(path):
+    _execute_notebook(path)
 
 
 @pytest.mark.extended
-@pytest.mark.parametrize("path", NOTEBOOKS,
-                         ids=[os.path.basename(p) for p in NOTEBOOKS])
+@pytest.mark.parametrize(
+    "path", [p for p in NOTEBOOKS if os.path.basename(p) not in _DEFAULT],
+    ids=[os.path.basename(p) for p in NOTEBOOKS
+         if os.path.basename(p) not in _DEFAULT])
 def test_notebook_executes(path):
+    _execute_notebook(path)
+
+
+def _execute_notebook(path):
     nbclient = pytest.importorskip("nbclient")
     nbformat = pytest.importorskip("nbformat")
     nb = nbformat.read(path, as_version=4)

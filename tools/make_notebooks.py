@@ -236,12 +236,440 @@ assert vec.shape == (32 * 32 * 3,)
 print("302 OK")"""))
 
 
+N101 = nb(
+    "101 - Adult Census Income Training",
+    md("Analog of `notebooks/samples/101`: census-shaped mixed "
+       "numeric/categorical columns; `TrainClassifier` auto-featurizes and "
+       "fits, `ComputeModelStatistics` evaluates (source flow: "
+       "examples/e101_automl_classification.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+n = 400
+hours = rng.uniform(10, 60, n)
+education = np.array(["hs", "college", "masters"], dtype=object)[
+    rng.integers(0, 3, n)]
+age = rng.uniform(18, 70, n)
+signal = 0.05 * hours + 0.8 * (education == "masters") + 0.02 * age
+label = (signal + rng.normal(0, 0.3, n) > 2.7).astype(np.int64)
+df = DataFrame({"age": age, "hours_per_week": hours,
+                "education": education, "label": label})
+train, test = df.randomSplit([0.75, 0.25], seed=1)
+train.count(), test.count()"""),
+    code("""\
+from mmlspark_tpu.automl import ComputeModelStatistics, TrainClassifier
+from mmlspark_tpu.models import LogisticRegression
+model = TrainClassifier().setModel(LogisticRegression()).fit(train)
+scored = model.transform(test)
+row = ComputeModelStatistics().transform(scored).first()
+print({k: round(float(v), 3) for k, v in row.items()
+       if k in ("accuracy", "AUC")})
+assert row["accuracy"] > 0.7
+print("101 OK")"""))
+
+
+N102 = nb(
+    "102 - Regression Example with Flight Delay",
+    md("Analog of `notebooks/samples/102`: flight-delay-shaped regression "
+       "with `TrainRegressor`, candidate comparison via `FindBestModel`, "
+       "and per-row diagnostics (source: "
+       "examples/e102_regression_model_selection.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+n = 300
+carrier = np.array(["AA", "UA", "DL"], dtype=object)[rng.integers(0, 3, n)]
+distance = rng.uniform(100, 3000, n)
+dep_hour = rng.integers(5, 23, n).astype(np.int64)
+delay = (0.01 * distance + 3.0 * (carrier == "UA") + 0.5 * dep_hour
+         + rng.normal(0, 2.0, n))
+df = DataFrame({"carrier": carrier, "distance": distance,
+                "dep_hour": dep_hour, "label": delay})
+train, test = df.randomSplit([0.8, 0.2], seed=1)"""),
+    code("""\
+from mmlspark_tpu.automl import (ComputePerInstanceStatistics,
+                                 FindBestModel, TrainRegressor)
+from mmlspark_tpu.models import (GBTRegressor, LinearRegression,
+                                 RandomForestRegressor)
+models = [TrainRegressor().setLabelCol("label").setModel(m).fit(train)
+          for m in (LinearRegression(),
+                    GBTRegressor().setNumIterations(25),
+                    RandomForestRegressor().setNumIterations(20))]
+best = (FindBestModel().setModels(tuple(models))
+        .setEvaluationMetric("rmse").fit(test))
+print("best metric:", round(best.getBestModelMetrics(), 2))
+out = best.transform(test)
+per = (ComputePerInstanceStatistics().setLabelCol("label")
+       .setEvaluationMetric("regression").transform(out))
+rmse = float(np.sqrt(np.mean(np.asarray(per.col("L2_loss")))))
+assert rmse < 0.6 * float(np.std(np.asarray(test.col("label"))))
+print("102 OK")"""))
+
+
+N106 = nb(
+    "106 - Quantile Regression with LightGBM",
+    md("Analog of `notebooks/samples/106`: `LightGBMRegressor` with "
+       "`application=quantile` on heteroscedastic data, plus a "
+       "`LightGBMClassifier` fit — the reference's socket-collective "
+       "boosting becomes XLA histogram kernels (source: "
+       "examples/e106_gbdt_quantile.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import LightGBMClassifier, LightGBMRegressor
+rng = np.random.default_rng(0)
+n = 500
+x = rng.normal(size=(n, 6)).astype(np.float32)
+feats = object_column([row for row in x])
+y_reg = (2.0 * x[:, 0] - x[:, 1]
+         + rng.normal(0, 0.5 + 0.5 * (x[:, 2] > 0), n))
+reg_df = DataFrame({"features": feats, "label": y_reg.astype(np.float64)})
+qlo = (LightGBMRegressor().setApplication("quantile").setAlpha(0.1)
+       .setNumIterations(30).setNumLeaves(15).fit(reg_df))
+qhi = (LightGBMRegressor().setApplication("quantile").setAlpha(0.9)
+       .setNumIterations(30).setNumLeaves(15).fit(reg_df))
+lo = np.asarray(qlo.transform(reg_df).col("prediction"))
+hi = np.asarray(qhi.transform(reg_df).col("prediction"))
+cover = float(((y_reg >= lo) & (y_reg <= hi)).mean())
+print("10-90 interval coverage:", round(cover, 3))
+assert 0.6 < cover <= 1.0"""),
+    code("""\
+y_cls = (x[:, 0] + 0.5 * x[:, 3] > 0).astype(np.float64)
+cls_df = DataFrame({"features": feats, "label": y_cls})
+clf = (LightGBMClassifier().setNumIterations(30).setNumLeaves(15)
+       .fit(cls_df))
+pred = np.asarray(clf.transform(cls_df).col("prediction"))
+acc = float((pred == y_cls).mean())
+print("classifier accuracy:", round(acc, 3))
+assert acc > 0.9
+print("106 OK")"""))
+
+
+N201 = nb(
+    "201 - Amazon Book Reviews - TextFeaturizer",
+    md("Analog of `notebooks/samples/201`: review text through "
+       "`TextFeaturizer` (tokenize, stopwords, n-grams, hashing TF, IDF) "
+       "into a linear classifier."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+positive = ["great", "wonderful", "loved", "excellent", "gripping"]
+negative = ["boring", "awful", "hated", "dull", "tedious"]
+filler = ["book", "story", "plot", "read", "author", "the", "a"]
+n = 400
+texts, labels = [], []
+for _ in range(n):
+    lab = int(rng.random() < 0.5)
+    words = list(rng.choice(positive if lab else negative, 3)) \
+        + list(rng.choice(filler, 5))
+    rng.shuffle(words)
+    texts.append(" ".join(words))
+    labels.append(lab)
+df = DataFrame({"text": np.array(texts, dtype=object),
+                "label": np.array(labels, dtype=np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)"""),
+    code("""\
+from mmlspark_tpu.models import LogisticRegression
+from mmlspark_tpu.ops import TextFeaturizer
+tf = (TextFeaturizer().setInputCol("text").setOutputCol("features")
+      .setNumFeatures(512).setUseStopWordsRemover(True)).fit(train)
+clf = LogisticRegression().setMaxIter(80).fit(tf.transform(train))
+pred = clf.transform(tf.transform(test))
+acc = float((np.asarray(pred.col("prediction"))
+             == np.asarray(test.col("label"))).mean())
+print("accuracy:", round(acc, 3))
+assert acc > 0.85
+print("201 OK")"""))
+
+
+N202 = nb(
+    "202 - Amazon Book Reviews - Word2Vec",
+    md("Analog of `notebooks/samples/202`: Word2Vec embeddings (batched "
+       "skip-gram negative sampling on device) averaged into document "
+       "vectors, then a classifier (source: examples/e202_word2vec.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+positive = ["great", "wonderful", "loved", "excellent", "gripping"]
+negative = ["boring", "awful", "hated", "dull", "tedious"]
+filler = ["book", "story", "plot", "read", "author", "chapter"]
+n = 400
+texts, labels = [], []
+for _ in range(n):
+    lab = int(rng.random() < 0.5)
+    words = list(rng.choice(positive if lab else negative, 4)) \
+        + list(rng.choice(filler, 6))
+    rng.shuffle(words)
+    texts.append(" ".join(words))
+    labels.append(lab)
+df = DataFrame({"text": np.array(texts, dtype=object),
+                "label": np.array(labels, dtype=np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)"""),
+    code("""\
+from mmlspark_tpu.models import LogisticRegression
+from mmlspark_tpu.ops import Word2Vec
+w2v = (Word2Vec().setInputCol("text").setOutputCol("features")
+       .setVectorSize(32).setMinCount(2).setWindowSize(4)
+       .setMaxIter(3).setSeed(2)).fit(train)
+syn = w2v.findSynonyms("great", 3)
+print("synonyms of 'great':", list(syn.col("word")))
+clf = LogisticRegression().setMaxIter(80).fit(w2v.transform(train))
+pred = clf.transform(w2v.transform(test))
+acc = float((np.asarray(pred.col("prediction"))
+             == np.asarray(test.col("label"))).mean())
+print("accuracy:", round(acc, 3))
+assert acc > 0.8
+print("202 OK")"""))
+
+
+N203 = nb(
+    "203 - Breast Cancer - Tune Hyperparameters",
+    md("Analog of `notebooks/samples/203`: randomized k-fold search over "
+       "several model families at once with `TuneHyperparameters` (source: "
+       "examples/e203_tune_hyperparameters.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+rng = np.random.default_rng(0)
+n = 300
+y = rng.integers(0, 2, n)
+base = rng.normal(size=(n, 6))
+x = base + y[:, None] * np.array([1.2, 0.8, 0.0, 0.5, 1.0, 0.2])
+feats = np.empty(n, dtype=object)
+for i in range(n):
+    feats[i] = x[i].astype(np.float32)
+df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+train, test = df.randomSplit([0.75, 0.25], seed=1)"""),
+    code("""\
+from mmlspark_tpu.automl import TuneHyperparameters
+from mmlspark_tpu.models import (LightGBMClassifier, LogisticRegression,
+                                 RandomForestClassifier)
+tuned = (TuneHyperparameters()
+         .setModels((LogisticRegression(),
+                     RandomForestClassifier().setNumIterations(15),
+                     LightGBMClassifier().setNumIterations(15)))
+         .setEvaluationMetric("accuracy")
+         .setNumFolds(3).setNumRuns(6).setParallelism(2).setSeed(0)
+         .fit(train))
+print("best CV metric:", round(tuned.getBestMetric(), 3))
+print("best setting:", {k: (round(v, 4) if isinstance(v, float) else v)
+                        for k, v in list(tuned.getBestSetting().items())[:4]})
+pred = tuned.transform(test)
+acc = float((np.asarray(pred.col("prediction"))
+             == np.asarray(test.col("label"))).mean())
+print("held-out accuracy:", round(acc, 3))
+assert acc > 0.8
+print("203 OK")"""))
+
+
+N301 = nb(
+    "301 - CIFAR10 CNN Evaluation",
+    md("Analog of `notebooks/samples/301`: images flow through "
+       "`ImageTransformer` -> `UnrollImage` -> `TpuModel` batch inference "
+       "— the reference's per-row JNI calls into CNTK become one fused XLA "
+       "program per shape bucket (source: examples/e301_image_inference.py)."),
+    code("""\
+import jax
+from mmlspark_tpu import DataFrame, Pipeline
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuModel, build_model
+from mmlspark_tpu.ops import ImageTransformer, UnrollImage
+rng = np.random.default_rng(0)
+n = 32
+rows = object_column([make_image_row(
+    f"img{i}", 40, 40, 3,
+    rng.integers(0, 255, (40, 40, 3), dtype=np.uint8)) for i in range(n)])
+df = DataFrame({"image": rows})
+cfg = {"type": "convnet", "channels": [8, 8], "dense": 32,
+       "num_classes": 10}
+module = build_model(cfg)
+params = module.init(jax.random.PRNGKey(0), np.zeros((1, 32, 32, 3),
+                                                     np.float32))
+net = (TpuModel().setInputCol("features").setModelConfig(cfg)
+       .setModelParams(params).setInputShape((3, 32, 32)))"""),
+    code("""\
+pipe = Pipeline().setStages((
+    ImageTransformer().setInputCol("image").setOutputCol("proc")
+        .resize(32, 32),
+    UnrollImage().setInputCol("proc").setOutputCol("features"),
+    net))
+out = pipe.fit(df).transform(df)
+scores = np.stack(list(out.col("scores")))
+print("scores:", scores.shape)
+assert scores.shape == (n, 10)
+print("301 OK")"""))
+
+
+_ZOO_BOOT = """\
+import os
+import mmlspark_tpu
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(
+    mmlspark_tpu.__file__)))
+ZOO = os.path.join(REPO, "zoo")
+from mmlspark_tpu.models.downloader import ModelDownloader
+print("zoo models:", [(s.name, s.dataset)
+                      for s in ModelDownloader(ZOO).localModels()])"""
+
+
+N303 = nb(
+    "303 - Transfer Learning by DNN Featurization",
+    md("Analog of `notebooks/samples/303`: `ModelDownloader` pulls a "
+       "pretrained net from the model repo (served over HTTP with sha256 "
+       "verification), `ImageFeaturizer` truncates it below the head, and "
+       "a cheap classifier trains on the embeddings — beating the same "
+       "architecture with random weights (source: "
+       "examples/e303_transfer_learning.py)."),
+    code(_ZOO_BOOT),
+    code("""\
+import functools, http.server, tempfile, threading
+handler = functools.partial(http.server.SimpleHTTPRequestHandler,
+                            directory=ZOO)
+server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+threading.Thread(target=server.serve_forever, daemon=True).start()
+url = f"http://127.0.0.1:{server.server_address[1]}/"
+local = tempfile.mkdtemp(prefix="zoo_local_")
+downloader = ModelDownloader(local_path=local, server_url=url)
+schema = downloader.downloadByName("ResNet20", "shapes10")  # sha256-gated
+print("downloaded:", schema.uri.split("/")[-1],
+      "layers:", schema.layerNames[-2:])"""),
+    code("""\
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import (ImageFeaturizer, LogisticRegression,
+                                 TpuModel, build_model)
+from mmlspark_tpu.testing.datagen import make_shapes10
+import jax
+xt, yt = make_shapes10(56, seed=100, num_classes=2, class_offset=6)
+xe, ye = make_shapes10(80, seed=101, num_classes=2, class_offset=6)
+def frame(xa, ya):
+    rows = object_column([make_image_row(f"i{i}", 32, 32, 3, xa[i])
+                          for i in range(len(xa))])
+    return DataFrame({"image": rows, "label": ya})
+def transfer_accuracy(backbone):
+    feat = (ImageFeaturizer().setInputCol("image")
+            .setOutputCol("features").setModel(backbone)
+            .setCutOutputLayers(1))
+    clf = LogisticRegression().setMaxIter(80).fit(
+        feat.transform(frame(xt, yt)))
+    pred = clf.transform(feat.transform(frame(xe, ye)))
+    return float((np.asarray(pred.col("prediction")) == ye).mean())
+pretrained = TpuModel().setModelSchema(schema)
+acc_pre = transfer_accuracy(pretrained)
+cfg = pretrained.getModelConfig()
+rand_params = build_model(cfg).init(jax.random.PRNGKey(1),
+                                    np.zeros((1, 32, 32, 3), np.float32))
+acc_rand = transfer_accuracy(
+    TpuModel().setModelConfig(cfg).setModelParams(rand_params))
+print(f"pretrained {acc_pre:.3f} vs random-init {acc_rand:.3f}")
+assert acc_pre > acc_rand
+server.shutdown()
+print("303 OK")"""))
+
+
+N305 = nb(
+    "305 - Flowers ImageFeaturizer",
+    md("Analog of `notebooks/samples/305`: `ImageSetAugmenter` multiplies "
+       "the training set with flips before DNN featurization + classifier "
+       "training (source: examples/e305_flowers_featurizer.py)."),
+    code(_ZOO_BOOT),
+    code("""\
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.schema import make_image_row
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import ImageFeaturizer, LogisticRegression
+from mmlspark_tpu.ops import ImageSetAugmenter
+from mmlspark_tpu.testing.datagen import make_shapes10
+x, labels = make_shapes10(64, seed=5, num_classes=2, class_offset=0)
+rows = object_column([make_image_row(f"f{i}", 32, 32, 3, x[i])
+                      for i in range(len(x))])
+df = DataFrame({"image": rows, "label": labels})
+train, test = df.randomSplit([0.7, 0.3], seed=1)
+aug = (ImageSetAugmenter().setInputCol("image").setOutputCol("image")
+       .setFlipLeftRight(True).setFlipUpDown(False))
+augmented = aug.transform(train)
+print(f"augmentation: {train.count()} -> {augmented.count()} rows")
+assert augmented.count() == 2 * train.count()"""),
+    code("""\
+schema = ModelDownloader(ZOO).downloadByName("ResNet20", "shapes10")
+featurizer = (ImageFeaturizer().setInputCol("image")
+              .setOutputCol("features").setModelSchema(schema)
+              .setCutOutputLayers(1))
+clf = LogisticRegression().setMaxIter(60).fit(
+    featurizer.transform(augmented))
+pred = clf.transform(featurizer.transform(test))
+acc = float((np.asarray(pred.col("prediction"))
+             == np.asarray(test.col("label"))).mean())
+print("accuracy:", round(acc, 3))
+assert acc > 0.7
+print("305 OK")"""))
+
+
+N304 = nb(
+    "304 - Medical Entity Extraction",
+    md("Analog of `notebooks/samples/304`: token-level sequence tagging "
+       "with a bidirectional recurrent tagger trained by `TpuLearner` "
+       "(the reference evaluates a pretrained CNTK BiLSTM; source: "
+       "examples/e304_sequence_tagging.py)."),
+    code("""\
+import importlib
+e304 = importlib.import_module("examples.e304_sequence_tagging")
+print("304 OK (module ran end-to-end)")"""))
+
+
+N401 = nb(
+    "401 - Distributed Training",
+    md("Analog of the reference's GPU notebook (`gpu/401`): the SAME "
+       "pipeline code a laptop runs scales to a fleet by launching worker "
+       "processes that each ingest only their shard — here demonstrated "
+       "single-process with the 8-device virtual mesh doing data-parallel "
+       "training; the multi-process path is exercised in "
+       "tests/test_dataplane.py (source: "
+       "examples/e401_distributed_training.py)."),
+    code("""\
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.automl import Featurize
+from mmlspark_tpu.models import TpuLearner
+rng = np.random.default_rng(0)
+n = 4096
+y = rng.integers(0, 2, n)
+xs = rng.normal(size=(n, 16)) + y[:, None] * 1.5
+cols = {f"x{i}": xs[:, i] for i in range(16)}
+df = DataFrame({**cols, "label": y.astype(np.int64)})
+fz = (Featurize().setInputCols(tuple(cols))
+      .setOutputCol("features").fit(df))
+feat = fz.transform(df)"""),
+    code("""\
+import jax
+model = (TpuLearner()
+         .setModelConfig({"type": "mlp", "hidden": [32],
+                          "num_classes": 2})
+         .setEpochs(3).setBatchSize(512).setLearningRate(0.05)
+         .fit(feat))   # batch sharded over all 8 devices (dp)
+out = model.transform(feat)
+acc = float((np.stack(list(out.col("scores"))).argmax(1) == y).mean())
+print("devices:", len(jax.devices()), "accuracy:", round(acc, 3))
+assert acc > 0.9
+print("401 OK")"""))
+
+
 def main() -> int:
     os.makedirs(OUT, exist_ok=True)
-    books = {"103_before_and_after.ipynb": N103,
+    books = {"101_adult_census_income_training.ipynb": N101,
+             "102_regression_flight_delay.ipynb": N102,
+             "103_before_and_after.ipynb": N103,
              "104_price_prediction_auto_imports.ipynb": N104,
              "105_regression_with_dataconversion.ipynb": N105,
-             "302_pipeline_image_transformations.ipynb": N302}
+             "106_quantile_regression_lightgbm.ipynb": N106,
+             "201_amazon_reviews_text_featurizer.ipynb": N201,
+             "202_amazon_reviews_word2vec.ipynb": N202,
+             "203_tune_hyperparameters.ipynb": N203,
+             "301_cifar10_cnn_evaluation.ipynb": N301,
+             "302_pipeline_image_transformations.ipynb": N302,
+             "303_transfer_learning_dnn_featurization.ipynb": N303,
+             "304_medical_entity_extraction.ipynb": N304,
+             "305_flowers_image_featurizer.ipynb": N305,
+             "401_distributed_training.ipynb": N401}
     for name, book in books.items():
         path = os.path.join(OUT, name)
         nbf.write(book, path)
