@@ -210,8 +210,7 @@ class NaiveBayesModel(_ProbClassifierModel):
 
 
 class NaiveBayes(Estimator, HasFeaturesCol, HasLabelCol):
-    """Naive Bayes classifier with Spark ML's multinomial model as the
-    default and a Gaussian variant for continuous features.
+    """Naive Bayes: Spark-ML-parity multinomial default plus Gaussian.
 
     ``modelType='multinomial'`` matches Spark ML's NaiveBayes — event
     counts over NONNEGATIVE features (hashed text), log theta from
