@@ -1,0 +1,268 @@
+"""PySpark adapter: the reference's front door, over the Arrow bridge.
+
+MMLSpark is reached from Spark — codegen'd PySpark wrappers around every
+stage (reference: PySparkWrapper.scala:33-160) and `spark.readImages`
+implicits (Readers.scala:14-45). This module is that surface for the
+TPU-native framework: any registered stage becomes a Spark-side stage
+object (duck-typed ``fit``/``transform`` driven exactly like the
+reference's wrappers) whose data crosses in COLUMNS through Arrow, never
+Python rows. Compose multiple stages with ``mmlspark_tpu.Pipeline`` on
+the native side (wrap the fitted pipeline once); ``pyspark.ml.Pipeline``
+itself validates for its own Params subclasses and is not supported.
+
+  * ``transform`` runs on the EXECUTORS via ``DataFrame.mapInArrow``: each
+    Spark partition's record batches convert zero-copy-ish into the
+    native :class:`mmlspark_tpu.DataFrame`, the wrapped stage transforms
+    them, and the result flows back as Arrow (the mapPartitions shape the
+    reference uses, CNTKModel.scala:255-261 — with the JVM<->Python wall
+    crossed columnar instead of per-row).
+  * ``fit`` collects the (driver-sized, as in the reference's own
+    estimators) dataset to the driver as Arrow, fits the TPU-native
+    estimator there, and returns the fitted model re-wrapped for Spark.
+  * ``readImages(spark, path)`` mirrors the reference's reader implicit.
+
+pyspark is NOT a dependency of the framework — everything here imports it
+lazily and raises a clear error when absent. The wrappers hold the
+wrapped stage in ``.inner`` and forward every ``set*``/``get*`` chain, so
+codegen'd param surfaces need no second binding layer:
+
+    from mmlspark_tpu.spark import wrap
+    from mmlspark_tpu.automl import TrainClassifier
+    model = wrap(TrainClassifier().setLabelCol("income")).fit(spark_df)
+    scored = model.transform(spark_df)        # executes via mapInArrow
+
+Run the end-to-end demo with
+``spark-submit --master 'local[*]' examples/spark_submit_101.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+#: rows sampled on the driver to infer a transform's output schema (the
+#: stage runs once on this slice; Arrow needs the schema before executors
+#: stream batches)
+_SCHEMA_SAMPLE_ROWS = 32
+
+
+def _pyspark():
+    try:
+        import pyspark  # noqa: F401
+        import pyspark.ml
+        import pyspark.sql
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "mmlspark_tpu.spark needs pyspark on the PYTHONPATH (it is an "
+            "optional integration, not a dependency — `pip install "
+            "pyspark` in the Spark-side environment, or run under "
+            "spark-submit)") from e
+
+
+# ---------------------------------------------------------------- conversion
+
+def _pdf_to_native(pdf):
+    """pandas (from Spark/Arrow) -> native DataFrame. Arrow list columns
+    arrive as object columns of np/list values; vector-consuming stages
+    expect float32 ndarray cells."""
+    from ..core.dataframe import DataFrame
+    cols = {}
+    for c in pdf.columns:
+        v = pdf[c].to_numpy()
+        if v.dtype.kind == "O" and len(v) and isinstance(
+                v[0], (list, tuple, np.ndarray)):
+            out = np.empty(len(v), dtype=object)
+            for i, item in enumerate(v):
+                out[i] = np.asarray(item, dtype=np.float32)
+            v = out
+        cols[c] = v
+    return DataFrame(cols)
+
+
+def _native_to_arrow(df):
+    """Native DataFrame -> pyarrow Table (object columns of ndarrays
+    become Arrow lists; scalars pass through)."""
+    import pyarrow as pa
+    arrays, names = [], []
+    for name in df.columns:
+        v = df.col(name)
+        names.append(name)
+        if v.dtype.kind == "O":
+            first = next((x for x in v if x is not None), None)
+            if isinstance(first, np.ndarray):
+                arrays.append(pa.array(
+                    [None if x is None else np.asarray(
+                        x, np.float32).tolist() for x in v],
+                    type=pa.list_(pa.float32())))
+                continue
+            if isinstance(first, dict):
+                # struct cells (image rows) become Arrow STRUCT arrays;
+                # pyspark's from_arrow_schema maps them to Spark structs
+                arrays.append(pa.array(v.tolist()))
+                continue
+            arrays.append(pa.array([None if x is None else str(x)
+                                    for x in v]))
+            continue
+        arrays.append(pa.array(v))
+    return pa.table(dict(zip(names, arrays)))
+
+
+def _spark_df_to_native(sdf, limit: Optional[int] = None):
+    """Spark DataFrame -> native DataFrame via the driver (Arrow path when
+    available, pandas otherwise)."""
+    if limit is not None:
+        sdf = sdf.limit(limit)
+    to_arrow = getattr(sdf, "toArrow", None)
+    if callable(to_arrow):           # Spark 4 / shim fast path
+        return _pdf_to_native(to_arrow().to_pandas())
+    return _pdf_to_native(sdf.toPandas())
+
+
+def _arrow_schema_to_spark(schema):
+    """pyarrow schema -> Spark StructType (via pyspark's own converter
+    when present; minimal manual mapping otherwise)."""
+    try:
+        from pyspark.sql.pandas.types import from_arrow_schema
+        return from_arrow_schema(schema)
+    except Exception:
+        import pyarrow as pa
+        from pyspark.sql import types as T
+        simple = {pa.int64(): T.LongType(), pa.int32(): T.IntegerType(),
+                  pa.float64(): T.DoubleType(), pa.float32(): T.FloatType(),
+                  pa.bool_(): T.BooleanType(), pa.string(): T.StringType(),
+                  pa.binary(): T.BinaryType()}
+        fields = []
+        for f in schema:
+            if isinstance(f.type, pa.ListType):
+                t = T.ArrayType(simple.get(f.type.value_type,
+                                           T.DoubleType()))
+            elif isinstance(f.type, pa.StructType):
+                raise NotImplementedError(
+                    f"column {f.name!r} is an Arrow struct and this "
+                    f"pyspark lacks from_arrow_schema; flatten the struct "
+                    f"(e.g. UnrollImage) before crossing to Spark")
+            else:
+                t = simple.get(f.type, T.StringType())
+            fields.append(T.StructField(f.name, t, True))
+        return T.StructType(fields)
+
+
+# ------------------------------------------------------------------ wrappers
+
+def _forward_params(self, name):
+    """get*/set* forwarding so Spark-side code keeps the exact param-chain
+    surface the codegen documents (set* chains return the WRAPPER)."""
+    if name == "inner":                       # guard before __init__ runs
+        raise AttributeError(name)
+    attr = getattr(self.inner, name)
+    if callable(attr) and name.startswith("set"):
+        def chain(*a, **k):
+            attr(*a, **k)
+            return self
+        return chain
+    return attr
+
+
+class SparkTransformer:
+    """A TPU-native Transformer driven from Spark.
+
+    Executor execution: ``mapInArrow`` streams each partition's record
+    batches through the wrapped stage. The output schema is inferred on
+    the driver by transforming a small sample (Arrow requires it up
+    front)."""
+
+    def __init__(self, inner):
+        _pyspark()
+        self.inner = inner
+        self.uid = f"mmltpu_{type(inner).__name__}_{id(inner):x}"
+
+    __getattr__ = _forward_params
+
+    def _output_schema(self, sdf):
+        sample = _spark_df_to_native(sdf, limit=_SCHEMA_SAMPLE_ROWS)
+        if sample.count() == 0:
+            raise ValueError(
+                "cannot infer the transform's output schema from an EMPTY "
+                "DataFrame (Arrow needs the schema before executors "
+                "stream batches); give transform() at least one row")
+        out = self.inner.transform(sample)
+        return _native_to_arrow(out).schema
+
+    def transform(self, sdf):
+        import pyarrow as pa
+        schema = self._output_schema(sdf)
+        inner = self.inner
+
+        def run(batches):
+            for batch in batches:
+                native = _pdf_to_native(
+                    pa.Table.from_batches([batch]).to_pandas())
+                if native.count() == 0:
+                    continue
+                out = _native_to_arrow(inner.transform(native))
+                yield from out.cast(schema).to_batches()
+
+        return sdf.mapInArrow(run, _arrow_schema_to_spark(schema))
+
+    def save(self, path):
+        self.inner.save(path)
+
+
+class SparkEstimator:
+    """A TPU-native Estimator driven from Spark: collects the
+    (driver-sized) training set as Arrow, fits natively — on the TPU when
+    one is attached to the driver — and wraps the fitted model."""
+
+    def __init__(self, inner):
+        _pyspark()
+        self.inner = inner
+        self.uid = f"mmltpu_{type(inner).__name__}_{id(inner):x}"
+
+    __getattr__ = _forward_params
+
+    def fit(self, sdf):
+        native = _spark_df_to_native(sdf)
+        return SparkTransformer(self.inner.fit(native))
+
+    def save(self, path):
+        self.inner.save(path)
+
+
+def wrap(stage):
+    """The one entry point: wrap any registered TPU-native stage for
+    Spark. Estimators wrap as :class:`SparkEstimator`, everything else as
+    :class:`SparkTransformer` (the reference's codegen emitted one wrapper
+    class per stage; the Param DSL lets one adapter serve all)."""
+    from ..core.pipeline import Estimator
+    if isinstance(stage, Estimator):
+        return SparkEstimator(stage)
+    return SparkTransformer(stage)
+
+
+# ------------------------------------------------------------------ readers
+
+def readImages(spark, path: str, recursive: bool = True,
+               sampleRatio: float = 1.0, seed: int = 0):
+    """``spark.readImages`` implicit analog (Readers.scala:14-45): decode
+    images through the native C++ loader on the driver, hand Spark a
+    DataFrame of (path, height, width, channels, data:binary)."""
+    _pyspark()
+    import pandas as pd
+
+    from ..io import readImages as native_read
+    df = native_read(path, recursive=recursive,
+                     sample_ratio=sampleRatio, seed=seed)
+    rows = df.col("image")
+    pdf = pd.DataFrame({
+        "path": [r["path"] for r in rows],
+        "height": [int(r["height"]) for r in rows],
+        "width": [int(r["width"]) for r in rows],
+        "channels": [int(r["type"]) for r in rows],
+        "data": [bytes(r["bytes"]) for r in rows],
+    })
+    return spark.createDataFrame(pdf)
+
+
+__all__ = ["wrap", "SparkTransformer", "SparkEstimator", "readImages"]

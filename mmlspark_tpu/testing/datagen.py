@@ -182,3 +182,37 @@ def make_shapes10(n: int, size: int = 32, num_classes: int = 10,
         img = img + rng.normal(0, 18, img.shape)
         x[i] = np.clip(img, 0, 255).astype(np.uint8)
     return x, y.astype(np.int64)
+
+
+def digits_rgb32(classes=tuple(range(8))):
+    """REAL image data: sklearn's bundled UCI handwritten-digits corpus
+    (1,797 scanned 8x8 digits) as 32x32x3 uint8 + labels, restricted to
+    ``classes`` (relabeled 0..len-1). The zoo's digits8 models pretrain on
+    classes 0-7; 8/9 stay held out so transfer examples (e303) have a
+    genuinely unseen real downstream task. The only real-image corpus a
+    zero-egress environment ships."""
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    keep = np.isin(d.target, classes)
+    imgs = d.images[keep]                     # (n, 8, 8) float 0..16
+    remap = {c: i for i, c in enumerate(classes)}
+    y = np.array([remap[int(t)] for t in d.target[keep]], np.int64)
+    x = np.kron(imgs, np.ones((4, 4)))        # 8x8 -> 32x32 nearest
+    x = np.clip(x * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    return np.repeat(x[..., None], 3, axis=-1), y
+
+
+def census_pandas(n: int = 400, seed: int = 0):
+    """The notebook-101 census-shaped frame as pandas (shared by the
+    example/notebook/spark-adapter copies of the 101 story: mixed
+    numeric/categorical columns with a learnable income signal)."""
+    import pandas as pd
+    rng = np.random.default_rng(seed)
+    hours = rng.uniform(10, 60, n)
+    education = np.array(["hs", "college", "masters"], dtype=object)[
+        rng.integers(0, 3, n)]
+    age = rng.uniform(18, 70, n)
+    signal = 0.05 * hours + 0.8 * (education == "masters") + 0.02 * age
+    label = (signal + rng.normal(0, 0.3, n) > 2.7).astype(np.int64)
+    return pd.DataFrame({"age": age, "hours_per_week": hours,
+                         "education": education, "income": label})
