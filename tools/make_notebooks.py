@@ -517,7 +517,10 @@ N303 = nb(
        "pretrained net from the model repo (served over HTTP with sha256 "
        "verification), `ImageFeaturizer` truncates it below the head, and "
        "a cheap classifier trains on the embeddings — beating the same "
-       "architecture with random weights (source: "
+       "architecture with random weights. The teacher is ResNet-20 "
+       "trained on REAL data (sklearn's UCI handwritten-digit scans, "
+       "classes 0-7 only); the downstream task is digits 8 vs 9, which "
+       "the teacher never saw, from 56 labels (source: "
        "examples/e303_transfer_learning.py)."),
     code(_ZOO_BOOT),
     code("""\
@@ -529,7 +532,7 @@ threading.Thread(target=server.serve_forever, daemon=True).start()
 url = f"http://127.0.0.1:{server.server_address[1]}/"
 local = tempfile.mkdtemp(prefix="zoo_local_")
 downloader = ModelDownloader(local_path=local, server_url=url)
-schema = downloader.downloadByName("ResNet20", "shapes10")  # sha256-gated
+schema = downloader.downloadByName("ResNet20", "digits8")   # sha256-gated
 print("downloaded:", schema.uri.split("/")[-1],
       "layers:", schema.layerNames[-2:])"""),
     code("""\
@@ -538,10 +541,12 @@ from mmlspark_tpu.core.schema import make_image_row
 from mmlspark_tpu.core.utils import object_column
 from mmlspark_tpu.models import (ImageFeaturizer, LogisticRegression,
                                  TpuModel, build_model)
-from mmlspark_tpu.testing.datagen import make_shapes10
+from mmlspark_tpu.testing.datagen import digits_rgb32
 import jax
-xt, yt = make_shapes10(56, seed=100, num_classes=2, class_offset=6)
-xe, ye = make_shapes10(80, seed=101, num_classes=2, class_offset=6)
+x89, y89 = digits_rgb32(classes=(8, 9))   # REAL digits the teacher never saw
+order = np.random.default_rng(42).permutation(len(x89))
+xt, yt = x89[order[:56]], y89[order[:56]]
+xe, ye = x89[order[56:]], y89[order[56:]]
 def frame(xa, ya):
     rows = object_column([make_image_row(f"i{i}", 32, 32, 3, xa[i])
                           for i in range(len(xa))])
