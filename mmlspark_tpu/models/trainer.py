@@ -97,13 +97,33 @@ def _stream_batch(b, cfg: dict, loss_name: str):
 
 # fit() keeps the epoch data device-resident (one upload, indexed batches)
 # up to this many bytes; past it, the per-step host-feed path takes over.
-# Half of a v5e chip's 16 GiB HBM leaves room for params + activations.
-_DEVICE_DATA_CAP = 8 << 30
+# Derived from the device's reported HBM when available (half the limit
+# leaves room for params + activations); the fallback is half of a v5e
+# chip's 16 GiB. Overridable per-fit via TpuLearner.deviceDataCap.
+_DEVICE_DATA_CAP_FALLBACK = 8 << 30
+_device_data_cap_cache: Optional[int] = None
+
+
+def _device_data_cap() -> int:
+    global _device_data_cap_cache
+    if _device_data_cap_cache is None:
+        cap = _DEVICE_DATA_CAP_FALLBACK
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+            if limit > 0:
+                cap = limit // 2
+        except Exception:
+            pass  # backends without memory_stats (CPU, tunnel plugins)
+        _device_data_cap_cache = cap
+    return _device_data_cap_cache
+
 
 # below this size the scan path re-uploads a freshly permuted epoch every
 # epoch (true reshuffle; the transfer is cheaper than one train step);
 # above it, shuffling is upload-permutation + per-epoch rotation/window
-# order (see _make_scan_epoch_fn)
+# order (see _make_scan_epoch_fn). Overridable via
+# TpuLearner.epochReshuffleCap.
 _EPOCH_RESHUFFLE_CAP = 32 << 20
 
 
@@ -338,6 +358,16 @@ class TpuLearner(Estimator):
         "device-resident epoch windows, donated state); 0 = whole epoch. "
         "Amortizes host dispatch latency — the single-host fit() fast "
         "path", default=0, min=0)
+    deviceDataCap = IntParam(
+        "bytes of epoch data kept device-resident before the per-step "
+        "host-feed path takes over; 0 = derive from the chip's reported "
+        "HBM (half of bytes_limit; 8 GiB fallback where the backend "
+        "reports none)", default=0, min=0)
+    epochReshuffleCap = IntParam(
+        "datasets up to this many bytes re-upload a true fresh "
+        "permutation every epoch on the scan path; larger ones rotate + "
+        "window-permute a once-permuted upload; 0 = the 32 MiB default",
+        default=0, min=0)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -503,7 +533,8 @@ class TpuLearner(Estimator):
                    _make_pp_step_body(cfg, mesh, tx, loss_fn, n_micro=pp))
         train_step = None
         scan_fn = None
-        if nproc == 1 and x.nbytes + y.nbytes <= _DEVICE_DATA_CAP:
+        data_cap = self.getDeviceDataCap() or _device_data_cap()
+        if nproc == 1 and x.nbytes + y.nbytes <= data_cap:
             scan_fn = _make_scan_epoch_fn(
                 module, tx, loss_fn, is_moe, moe_aux, mesh,
                 _scan_batch(bs_global, mesh, pp), step_body=pp_body)
@@ -739,7 +770,9 @@ class TpuLearner(Estimator):
         # is cheaper than one train step at this size); big ones permute
         # once at upload and vary per epoch by rotation + window order.
         reshuffle = (self.getShuffle()
-                     and x.nbytes + y.nbytes <= _EPOCH_RESHUFFLE_CAP)
+                     and x.nbytes + y.nbytes
+                     <= (self.getEpochReshuffleCap()
+                         or _EPOCH_RESHUFFLE_CAP))
         if self.getShuffle() and not reshuffle:
             perm0 = order_rng.permutation(n)
             x, y = x[perm0], y[perm0]
