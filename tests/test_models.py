@@ -707,7 +707,8 @@ class TestDeviceDataCaps:
             learner = (TpuLearner()
                        .setModelConfig({"type": "mlp", "hidden": [8],
                                         "num_classes": 2})
-                       .setEpochs(3).setBatchSize(32).setSeed(0))
+                       .setEpochs(10).setBatchSize(32)
+                       .setLearningRate(0.1).setSeed(0))
             for k, v in kw.items():
                 getattr(learner, f"set{k[0].upper()}{k[1:]}")(v)
             return learner.fit(df)
