@@ -30,8 +30,9 @@ from mmlspark_tpu.models import (DecisionTreeClassifier, GBTClassifier,
 from mmlspark_tpu.models.gbdt import LightGBMClassifier
 from mmlspark_tpu.testing import assert_golden
 from mmlspark_tpu.testing.reference_datasets import (
-    LIGHTGBM_REFERENCE_AUC, REFERENCE_DATASETS,
-    TRAIN_CLASSIFIER_REFERENCE_AUC)
+    LIGHTGBM_REFERENCE_AUC, LIGHTGBM_REFERENCE_RMSE, MULTICLASS_DATASETS,
+    REFERENCE_DATASETS, REGRESSION_DATASETS,
+    TRAIN_CLASSIFIER_MULTICLASS_ACC, TRAIN_CLASSIFIER_REFERENCE_AUC)
 
 GOLDENS = os.path.join(os.path.dirname(__file__), "goldens",
                        "reference_dataset_metrics.csv")
@@ -115,4 +116,67 @@ def test_train_classifier_reference_grid(dataset, algo):
     assert auc >= ref - 0.02, (
         f"{dataset}/{algo}: train AUC {auc:.4f} vs reference {ref}")
     assert_golden(GOLDENS, dataset, algo, "trainAUC", float(auc),
+                  tolerance=0.03)
+
+
+@pytest.mark.parametrize("dataset", sorted(REGRESSION_DATASETS))
+def test_lightgbm_regression_reference_ceiling(dataset):
+    """VerifyLightGBMRegressor.scala:32-66 config exactly: numLeaves=5,
+    numIterations=10, TRAIN-set RMSE; ceiling = the reference's committed
+    value + half of its rounding window (it rounds to `decimals`:
+    energyefficiency 0, airfoil 1, Buzz -3, machine -2, Concrete 0)."""
+    from mmlspark_tpu.automl import TrainRegressor
+    from mmlspark_tpu.models.gbdt import LightGBMRegressor
+
+    gen, label = REGRESSION_DATASETS[dataset]
+    df = gen()
+    y = np.asarray(df.col(label), np.float64)
+    model = (TrainRegressor().setLabelCol(label)
+             .setModel(LightGBMRegressor().setNumLeaves(5)
+                       .setNumIterations(10))
+             .fit(df))
+    pred = np.asarray(model.transform(df).col("prediction"), np.float64)
+    rmse = float(np.sqrt(np.mean((pred - y) ** 2)))
+    ceiling, decimals = LIGHTGBM_REFERENCE_RMSE[dataset]
+    tol = 0.5 * 10 ** (-decimals)
+    assert rmse <= ceiling + tol, (
+        f"{dataset}: train RMSE {rmse:.2f} above the reference's "
+        f"committed {ceiling} (+{tol} rounding window)")
+    # RMSE scales vary 4 orders of magnitude across these datasets —
+    # the golden tolerance must be RELATIVE (1%), and stay inside the
+    # ceiling's slack so the two assertions can't disagree
+    assert_golden(GOLDENS, dataset, "LightGBMRegressor", "trainRMSE",
+                  rmse, tolerance=max(0.01, 0.01 * rmse))
+
+
+_MC_ALGOS = {
+    "LogisticRegression": lambda: LogisticRegression().setMaxIter(80),
+    "DecisionTreeClassification": (
+        lambda: DecisionTreeClassifier().setMaxBin(63)),
+    "RandomForestClassification": (
+        lambda: RandomForestClassifier().setNumIterations(20)
+        .setMaxBin(63)),
+    "NaiveBayesClassifier": lambda: NaiveBayes(),
+}
+
+
+@pytest.mark.parametrize("dataset,algo", sorted(
+    TRAIN_CLASSIFIER_MULTICLASS_ACC))
+def test_train_classifier_multiclass_reference_grid(dataset, algo):
+    """The reference grid's multiclass rows (train-set accuracy via
+    MulticlassMetrics, VerifyTrainClassifier.scala:404-424): abalone's
+    ~28 near-continuous ring classes keep every number low; BreastTissue
+    is 6 overlapping impedance classes; CarEvaluation is a deterministic
+    expert rule with 70/22/4/4 skew."""
+    gen, label = MULTICLASS_DATASETS[dataset]
+    df = gen()
+    model = (TrainClassifier().setLabelCol(label)
+             .setModel(_MC_ALGOS[algo]()).fit(df))
+    pred = model.transform(df).col("scored_labels")
+    truth = df.col(label)
+    acc = float(np.mean([str(a) == str(b) for a, b in zip(pred, truth)]))
+    ref = TRAIN_CLASSIFIER_MULTICLASS_ACC[(dataset, algo)]
+    assert acc >= ref - 0.02, (
+        f"{dataset}/{algo}: train accuracy {acc:.3f} vs reference {ref}")
+    assert_golden(GOLDENS, dataset, algo, "trainAccuracy", acc,
                   tolerance=0.03)
