@@ -28,10 +28,13 @@ def main():
     from mmlspark_tpu.models.trainer import (_make_scan_epoch_fn, make_loss)
     from mmlspark_tpu.parallel import mesh as meshlib
 
-    batch = 8192          # r1 sweep: 1024->110k, 4096->119k, 8192->123k
-    k_steps = 15          # optimizer steps (windows) per epoch dispatch
-    n_dispatch = 4        # timed dispatches (K*n = 60 steps)
-    n_rows = k_steps * batch  # device-resident epoch (uint8: 360 MiB)
+    batch = 12288         # r1 sweep: 1024->110k, 4096->119k, 8192->123k;
+    # r3 sweep on the quiet chip: 8192->134k, 12288->136.6k (best),
+    # 14336->134k, 16384->119k (HBM pressure)
+    k_steps = 20          # optimizer steps (windows) per epoch dispatch
+    n_dispatch = 3        # timed dispatches (K*n = 60 steps)
+    n_rows = k_steps * batch  # device-resident epoch (uint8: ~720 MiB
+    # + one margin batch; 16384-batch sweeps already hit HBM pressure)
 
     module = build_model({"type": "resnet", "num_classes": 10})
     mesh = meshlib.create_mesh()

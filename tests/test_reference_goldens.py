@@ -149,15 +149,10 @@ def test_lightgbm_regression_reference_ceiling(dataset):
                   rmse, tolerance=max(0.01, 0.01 * rmse))
 
 
-_MC_ALGOS = {
-    "LogisticRegression": lambda: LogisticRegression().setMaxIter(80),
-    "DecisionTreeClassification": (
-        lambda: DecisionTreeClassifier().setMaxBin(63)),
-    "RandomForestClassification": (
-        lambda: RandomForestClassifier().setNumIterations(20)
-        .setMaxBin(63)),
-    "NaiveBayesClassifier": lambda: NaiveBayes(),
-}
+# the multiclass grid runs the SAME configs as the binary grid (minus
+# GBT, which the reference rejects for multiclass) — derive, don't copy
+_MC_ALGOS = {k: make for k, (make, _) in _GRID_ALGOS.items()
+             if k != "GradientBoostedTreesClassification"}
 
 
 @pytest.mark.parametrize("dataset,algo", sorted(

@@ -169,3 +169,36 @@ def test_read_images_implicit(spark, tmp_path):
                                 "data"}
     assert (out["height"] == 12).all() and (out["width"] == 10).all()
     assert all(len(b) == 12 * 10 * 3 for b in out["data"])
+
+
+def test_wrapped_native_pipeline(spark):
+    """Multi-stage composition crosses Spark once: build the pipeline
+    NATIVE-side (TextFeaturizer -> LogisticRegression via Pipeline), wrap
+    the one estimator, and the fitted whole transforms via mapInArrow."""
+    import pandas as pd
+
+    from mmlspark_tpu import Pipeline
+    from mmlspark_tpu.models import LogisticRegression
+    from mmlspark_tpu.ops import TextFeaturizer
+    from mmlspark_tpu.spark import wrap
+
+    rng = np.random.default_rng(3)
+    pos = ["great", "lovely", "wonderful"]
+    neg = ["awful", "dire", "boring"]
+    rows = []
+    for _ in range(240):
+        lab = int(rng.random() < 0.5)
+        words = list(rng.choice(pos if lab else neg, 2)) + ["book", "the"]
+        rng.shuffle(words)
+        rows.append((" ".join(words), lab))
+    sdf = spark.createDataFrame(pd.DataFrame(rows, columns=["text",
+                                                            "label"]))
+    pipe = Pipeline().setStages((
+        TextFeaturizer().setInputCol("text").setOutputCol("features")
+        .setNumFeatures(128),
+        LogisticRegression().setMaxIter(60)))
+    model = wrap(pipe).fit(sdf)
+    out = model.transform(sdf).toPandas()
+    acc = float((out["label"].astype(float)
+                 == out["prediction"].astype(float)).mean())
+    assert acc > 0.9, acc
