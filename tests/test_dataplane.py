@@ -333,9 +333,12 @@ def test_two_process_ingest_featurize_fit_e2e(tmp_path):
     print(f"peak 1-proc {peak1} vs per-proc in fleet {peak2} "
           f"(ratio {peak2 / peak1:.2f})")
     # sharding the ingest must shed the data-proportional memory; the
-    # margin absorbs allocator/GC variance seen in full-suite runs (the
-    # data-proportional part alone would put the ratio near 0.5)
-    assert peak2 < 0.85 * peak1, (peak2, peak1)
+    # margin absorbs allocator/GC variance seen in full-suite runs — the
+    # data-proportional part alone would put the ratio near 0.5, and the
+    # non-proportional overhead (jax + XLA-cache state) varies a few
+    # percent run to run, which made 0.85 flake roughly once per full
+    # extended sweep
+    assert peak2 < 0.9 * peak1, (peak2, peak1)
 
 
 _GBDT_WORKER = r'''
