@@ -249,11 +249,13 @@ print("E2E_WORKER_OK peak=%d rows=%d" % (peak, global_rows))
 
 def _spawn_fleet(tmp_path, script: str, nprocs: int = 2, env_extra=None,
                  devices_per_proc: int = 2, timeout: int = 240,
-                 retries: int = 1):
+                 retries: int = 2):
     """Run the worker fleet once; on a TIMEOUT, kill and retry with a fresh
     coordinator port (the jax/gloo rendezvous very occasionally hangs on a
     just-released port — an environment flake, not framework behavior;
-    genuine worker FAILURES never retry)."""
+    genuine worker FAILURES never retry). MMLTPU_INIT_TIMEOUT bounds the
+    rendezvous itself to 90 s so ONE hung attempt cannot eat the whole
+    retry budget."""
     worker = tmp_path / "worker.py"
     worker.write_text(script)
     for attempt in range(retries + 1):
@@ -269,23 +271,42 @@ def _spawn_fleet(tmp_path, script: str, nprocs: int = 2, env_extra=None,
                        MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
                        MMLTPU_NUM_PROCESSES=str(nprocs),
                        MMLTPU_PROCESS_ID=str(pid),
+                       MMLTPU_INIT_TIMEOUT="90",
                        **(env_extra or {}))
             env.pop("JAX_PLATFORMS", None)
             procs.append(subprocess.Popen(
                 [sys.executable, str(worker)], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-        outs = []
+        results = []
+        timed_out = False
         try:
             for p in procs:
-                out, err = p.communicate(timeout=timeout)
+                try:
+                    results.append(p.communicate(timeout=timeout))
+                except subprocess.TimeoutExpired:
+                    timed_out = True
+                    break
+        finally:
+            for p in procs:      # reap EVERY worker on every exit path
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        if not timed_out and all(p.returncode == 0 for p in procs):
+            return [out for out, _ in results]
+        # the bounded rendezvous turns the known hang-on-reused-port flake
+        # into a DEADLINE_EXCEEDED hard exit — retryable, like the timeout
+        deadline = any("DEADLINE_EXCEEDED" in (err or "")
+                       or "RENDEZVOUS_TIMEOUT" in (out or "")
+                       for out, err in results)
+        if not timed_out and not deadline:
+            # a genuine worker failure: surface the first bad worker
+            for p, (out, err) in zip(procs, results):
                 assert p.returncode == 0, (out[-2000:], err[-2000:])
-                outs.append(out)
-            return outs
-        except subprocess.TimeoutExpired:
-            for p in procs:
-                p.kill()
-            if attempt == retries:
-                raise
+        if attempt == retries:
+            raise AssertionError(
+                f"fleet failed after {retries + 1} attempts "
+                f"(timeout={timed_out}, rendezvous_deadline={deadline}): "
+                + "; ".join((err or "")[-400:] for _, err in results))
     raise AssertionError("unreachable")
 
 
