@@ -175,6 +175,27 @@ def _replace_like(host_tree, placed_tree):
     return jax.tree_util.tree_map(conv, host_tree, placed_tree)
 
 
+def _require_inner_block_local(axes: dict):
+    """Multi-host locality rule shared by fit()/fitStream(): the inner
+    parallel block (product of the non-data axes) must divide the LOCAL
+    device count. make_mesh puts ``data`` outermost, so inner axes span
+    contiguous device ranges — this keeps every seq/expert/model/pipe
+    collective on within-host ICI while only the dp all-reduce crosses
+    hosts, and keeps checkpointing and model export reading
+    process-locally-complete params (_host_tree)."""
+    inner = int(np.prod([max(1, v) for v in axes.values()]))
+    if inner <= 1:
+        return
+    n_local = jax.local_device_count()
+    if inner > n_local or n_local % inner != 0:
+        desc = "*".join(f"{nm}={v}" for nm, v in axes.items() if v > 1)
+        raise ValueError(
+            f"the inner parallel block ({desc} = {inner}) must divide the "
+            f"LOCAL device count ({n_local}) on a multi-host mesh: "
+            f"seq/expert/model/pipe axes must ride ICI within a host "
+            f"while dp crosses hosts")
+
+
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
     """Place params AND optimizer state on the mesh with explicit
     shardings. The opt state is initialized on host and placed under the
@@ -387,9 +408,13 @@ class TpuLearner(Estimator):
         state = {"params": _host_tree(params),
                  "opt": serialization.to_state_dict(_host_tree(opt_state))}
         # write-then-rename: a crash mid-write must never leave a truncated
-        # file that _latest_checkpoint would pick and brick the resume
+        # file that _latest_checkpoint would pick and brick the resume.
+        # The tmp name is per-process: on SHARED storage every process
+        # writes the (identical, replicated) state, and a common tmp would
+        # let one process truncate another's half-written file before its
+        # atomic rename publishes it
         path = self._ckpt_path(epoch)
-        tmp = path + ".tmp"
+        tmp = f"{path}.tmp.{jax.process_index()}"
         with open(tmp, "wb") as f:
             f.write(serialization.msgpack_serialize(state))
         os.replace(tmp, path)
@@ -400,6 +425,43 @@ class TpuLearner(Estimator):
         params = serialization.from_state_dict(params_tmpl, state["params"])
         opt = serialization.from_state_dict(opt_tmpl, state["opt"])
         return params, opt
+
+    def _consensus_resume(self, resume, nproc: int):
+        """Multi-host: resume only when EVERY process sees the same
+        checkpoint epoch (shared filesystem); otherwise processes would
+        run different epoch counts -> mismatched collectives -> deadlock.
+        Shared by fit() and fitStream()."""
+        if nproc <= 1 or not self.getCheckpointDir():
+            return resume
+        from jax.experimental import multihost_utils
+        seen = multihost_utils.process_allgather(
+            np.asarray(-1 if resume is None else resume))
+        if seen.min() == seen.max() and seen.min() >= 0:
+            return int(seen.min())
+        if seen.max() >= 0:
+            log.warning(
+                "checkpoint epochs differ across processes (%s) — "
+                "checkpointDir is not shared storage; starting fresh on "
+                "all processes", seen.tolist())
+        return None
+
+    def _resume_training_state(self, params, opt_state, nproc: int):
+        """Consensus-pick the resume epoch and restore (params, opt_state)
+        onto their existing mesh shardings. Returns (params, opt_state,
+        start_epoch). Shared by fit() and fitStream()."""
+        resume = self._consensus_resume(self._latest_checkpoint(), nproc)
+        if resume is None:
+            return params, opt_state, 0
+        placed = (params, opt_state)
+        params, opt_state = self._restore_checkpoint(resume, params,
+                                                     opt_state)
+        if nproc > 1:
+            # restored host arrays must go back onto the global mesh
+            # shardings (replicated for dp, model/expert axes for tp/ep)
+            params = _replace_like(params, placed[0])
+            opt_state = _replace_like(opt_state, placed[1])
+        log.info("resumed from checkpoint epoch %d", resume)
+        return params, opt_state, resume + 1
 
     # ---- training ----
     def fit(self, df: DataFrame) -> TpuModel:
@@ -471,8 +533,7 @@ class TpuLearner(Estimator):
                 raise ValueError(f"pipelineParallel ({pp}) must divide the "
                                  f"device count ({n_dev})")
             if meshlib.effective_process_count() > 1:
-                raise ValueError("pipelineParallel is single-host (see the "
-                                 "multi-host scope note below)")
+                _require_inner_block_local({"pipelineParallel": pp})
             mesh = meshlib.make_mesh({"data": n_dev // pp, "pipe": pp})
         else:
             mesh = meshlib.create_mesh(model=tp)
@@ -481,7 +542,16 @@ class TpuLearner(Estimator):
         # init batch must satisfy the shard_map divisibility of the sp
         # attention (batch % data-axis == 0); data-axis size always works
         init_b = dict(mesh.shape).get("data", 1) if sp > 1 else 2
-        params = module.init(rng, jnp.asarray(x[:init_b]))
+        if attn_fn is not None and meshlib.effective_process_count() > 1:
+            # the sp attention is a shard_map over a process-spanning mesh —
+            # flax's EAGER init cannot execute that collectively. The
+            # attention callable holds no params (projections are separate
+            # Dense modules), so a plain-attention twin inits the identical
+            # tree; the shard_map module only ever runs inside the jitted
+            # step, where global arrays make it legal.
+            params = build_model(cfg).init(rng, jnp.asarray(x[:init_b]))
+        else:
+            params = module.init(rng, jnp.asarray(x[:init_b]))
         tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
                             self.getMomentum(), self.getWeightDecay())
         loss_fn = make_loss(self.getLoss(), per_example=True)
@@ -491,20 +561,18 @@ class TpuLearner(Estimator):
         # batch sharded over `data`. XLA derives the gradient all-reduce +
         # any TP/EP collectives from these shardings alone.
         nproc = meshlib.effective_process_count()
-        if nproc > 1 and (sp > 1 or ep > 1):
-            raise ValueError(
-                "multi-host training composes dp (across hosts) with tp "
-                "(across each host's chips); sequence/expert parallelism "
-                "are single-host — run sp/ep within one host")
-        if nproc > 1 and tp > 1:
-            n_local = jax.local_device_count()
-            if tp > n_local or n_local % tp != 0:
-                raise ValueError(
-                    f"tensorParallel ({tp}) must divide the LOCAL device "
-                    f"count ({n_local}) on a multi-host mesh: the model "
-                    f"axis must ride ICI within a host while dp crosses "
-                    f"hosts (checkpointing and model export also need "
-                    f"process-locally-complete params)")
+        if nproc > 1:
+            # multi-host composes dp (across hosts) with the inner axes
+            # (tp/sp/ep — across each host's chips). The inner-axis block
+            # must be process-local: make_mesh puts `data` outermost, so
+            # inner axes span contiguous device ranges — requiring the
+            # block to divide the LOCAL device count keeps every seq/expert/
+            # model collective on within-host ICI while only the dp
+            # all-reduce crosses hosts, and keeps checkpointing and model
+            # export reading process-locally-complete params (_host_tree).
+            _require_inner_block_local({"sequenceParallel": sp,
+                                        "expertParallel": ep,
+                                        "tensorParallel": tp})
         params, opt_state = _place_params(params, mesh, tx, tp=tp, ep=ep)
 
         # only the transformer family reads num_experts (modules.py builder);
@@ -550,34 +618,8 @@ class TpuLearner(Estimator):
         rng_np = np.random.default_rng(
             self.getSeed() + (0 if meshlib.in_local_fit()
                               else jax.process_index()))
-        start_epoch = 0
-        resume = self._latest_checkpoint()
-        if nproc > 1 and self.getCheckpointDir():
-            # resume only when EVERY process sees the same checkpoint epoch
-            # (shared filesystem); otherwise processes would run different
-            # epoch counts -> mismatched collectives -> deadlock
-            from jax.experimental import multihost_utils
-            seen = multihost_utils.process_allgather(
-                np.asarray(-1 if resume is None else resume))
-            if seen.min() == seen.max() and seen.min() >= 0:
-                resume = int(seen.min())
-            else:
-                if seen.max() >= 0:
-                    log.warning(
-                        "checkpoint epochs differ across processes (%s) — "
-                        "checkpointDir is not shared storage; starting "
-                        "fresh on all processes", seen.tolist())
-                resume = None
-        if resume is not None:
-            placed = (params, opt_state)
-            params, opt_state = self._restore_checkpoint(resume, params, opt_state)
-            if nproc > 1:
-                # restored host arrays must go back onto the global mesh
-                # shardings (replicated for dp, model-axis for tp)
-                params = _replace_like(params, placed[0])
-                opt_state = _replace_like(opt_state, placed[1])
-            start_epoch = resume + 1
-            log.info("resumed from checkpoint epoch %d", resume)
+        params, opt_state, start_epoch = self._resume_training_state(
+            params, opt_state, nproc)
 
         # concurrent fits from a thread pool (TuneHyperparameters) must not
         # interleave collective programs across the same devices — same
@@ -612,26 +654,52 @@ class TpuLearner(Estimator):
         the stream feeds the jitted step directly, one device batch in
         flight.
 
-        Single-host, data(+tensor)-parallel. Ragged generator batches
-        bucket to powers of two (weight-masked), so batch-size drift never
-        recompiles. Checkpoint/resume and divergence halt work as in fit().
+        Data(+tensor)-parallel, single- or multi-host. Ragged generator
+        batches bucket to powers of two (weight-masked), so batch-size
+        drift never recompiles. Checkpoint/resume and divergence halt work
+        as in fit().
+
+        Multi-host: every process streams its OWN batches_fn() (its local
+        shard of the corpus — the Spark-partition analog). SPMD needs
+        identical dispatch shapes and counts everywhere, so each step the
+        fleet agrees host-side on (any-stream-has-data, bucket size);
+        exhausted streams contribute zero-weight dummy batches until the
+        longest stream drains — unequal shard sizes never deadlock.
         """
         cfg = dict(self.getModelConfig())
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
-                or self.getPipelineParallel() > 1
-                or meshlib.effective_process_count() > 1):
+                or self.getPipelineParallel() > 1):
             raise ValueError(
-                "fitStream is single-host data(+tensor)-parallel; use "
-                "fit() for sequence/expert/pipeline parallelism or "
-                "multi-host")
+                "fitStream is data(+tensor)-parallel; use fit() for "
+                "sequence/expert/pipeline parallelism")
         tp = self.getTensorParallel()
+        nproc = meshlib.effective_process_count()
+        if nproc > 1:
+            _require_inner_block_local({"tensorParallel": tp})
         mesh = meshlib.create_mesh(model=tp)
         first_iter = iter(batches_fn())
-        try:
-            first = next(first_iter)
-        except StopIteration:
+        first = next(first_iter, None)
+        if first is not None:
+            x0, y0 = _stream_batch(first, cfg, self.getLoss())
+        if nproc > 1:
+            # a process whose shard is EMPTY from the start (no files at
+            # all) must still join every collective: agree the batch
+            # signature host-side so it can init identical params and feed
+            # zero-weight dummies while the non-empty streams drain
+            from ..parallel import dataplane
+            sig = (None if first is None else
+                   ((x0.shape[1:], x0.dtype.str), (y0.dtype.str,)))
+            sigs = [s for s in dataplane.allgather_pyobj(sig)
+                    if s is not None]
+            if first is None and sigs:
+                (xsh, xdt), (ydt,) = sigs[0]
+                x0 = np.zeros((1,) + tuple(xsh), np.dtype(xdt))
+                y0 = np.zeros((1,), np.dtype(ydt))
+            if not sigs:
+                raise ValueError("batches_fn() yielded no batches on any "
+                                 "process")
+        elif first is None:
             raise ValueError("batches_fn() yielded no batches")
-        x0, y0 = _stream_batch(first, cfg, self.getLoss())
 
         module = build_model(cfg)
         params = module.init(jax.random.PRNGKey(self.getSeed()),
@@ -646,15 +714,11 @@ class TpuLearner(Estimator):
             self.getMoeAuxWeight() if is_moe else 0.0)
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
-        start_epoch = 0
-        resume = self._latest_checkpoint()
-        if resume is not None:
-            params, opt_state = self._restore_checkpoint(resume, params,
-                                                         opt_state)
-            start_epoch = resume + 1
-            log.info("resumed from checkpoint epoch %d", resume)
+        params, opt_state, start_epoch = self._resume_training_state(
+            params, opt_state, nproc)
 
         from .tpu_model import _next_pow2
+        from jax.experimental import multihost_utils
         axis = mesh.shape["data"]
         import contextlib
         guard = (meshlib.collective_fit_lock if mesh.size > 1
@@ -667,14 +731,40 @@ class TpuLearner(Estimator):
                 batches = ([first] if epoch == start_epoch else [])
                 first = None  # only replayed once
                 import itertools
+                stream = itertools.chain(batches, it)
+                # per-step row quota: the whole data axis single-host, this
+                # process's slice of it multi-host
+                share = max(1, axis // nproc)
                 n_batches = 0
-                for b in itertools.chain(batches, it):
-                    xb, yb = _stream_batch(b, cfg, self.getLoss())
-                    n = len(xb)
-                    # pow2 bucket, rounded up to a data-axis multiple (a
-                    # 6-device axis doesn't divide pow2 buckets)
-                    target = -(-max(_next_pow2(n), axis) // axis) * axis
-                    if n < target:
+                steps_run = 0
+                while True:
+                    b = next(stream, None)
+                    if b is None:
+                        xb = yb = None
+                        n = local_target = 0
+                    else:
+                        xb, yb = _stream_batch(b, cfg, self.getLoss())
+                        n = len(xb)
+                        # pow2 bucket, rounded up to a share multiple (a
+                        # 6-device axis doesn't divide pow2 buckets)
+                        local_target = (-(-max(_next_pow2(n), share)
+                                          // share) * share)
+                    if nproc > 1:
+                        # host-side lockstep: the fleet agrees on the bucket
+                        # size each step; a drained stream reports 0 and
+                        # keeps feeding zero-weight dummies until the
+                        # longest stream finishes — no deadlock on unequal
+                        # shards
+                        target = int(multihost_utils.process_allgather(
+                            np.asarray([local_target])).max())
+                    else:
+                        target = local_target
+                    if target == 0:
+                        break
+                    if xb is None:
+                        xb = np.zeros((target,) + x0.shape[1:], x0.dtype)
+                        yb = np.zeros(target, y0.dtype)
+                    elif n < target:
                         fx = np.zeros((target - n,) + xb.shape[1:], xb.dtype)
                         xb = np.concatenate([xb, fx])
                         yb = np.concatenate(
@@ -683,11 +773,13 @@ class TpuLearner(Estimator):
                     wb[:n] = 1.0
                     params, opt_state, loss = train_step(
                         params, opt_state,
-                        meshlib.shard_batch(xb, mesh),
-                        meshlib.shard_batch(yb, mesh),
-                        meshlib.shard_batch(wb, mesh))
-                    n_batches += 1
-                if n_batches == 0:
+                        meshlib.put_global_batch(xb, mesh),
+                        meshlib.put_global_batch(yb, mesh),
+                        meshlib.put_global_batch(wb, mesh))
+                    steps_run += 1
+                    if n:
+                        n_batches += 1
+                if steps_run == 0:
                     raise ValueError(f"batches_fn() yielded no batches in "
                                      f"epoch {epoch}")
                 last_loss = float(loss)
@@ -725,8 +817,14 @@ class TpuLearner(Estimator):
                 xb, nb = pad(x[idx], mesh)
                 yb, _ = pad(y[idx], mesh)
                 if micro > 1:
-                    # pipeline steps also need microbatch divisibility
-                    tgt = _scan_batch(len(xb), mesh, micro)
+                    # pipeline steps also need microbatch divisibility —
+                    # per PROCESS: each feeds its 1/nproc slice of the
+                    # global batch, so rounding local rows to the GLOBAL
+                    # data*micro multiple would inflate the assembled batch
+                    # nproc-fold (the dp axis size is nproc-divisible by
+                    # the inner-block locality rule, so this is integral)
+                    mult = (mesh.shape["data"] // nproc) * micro
+                    tgt = -(-len(xb) // mult) * mult
                     xb = _wrap_rows(xb, tgt)
                     yb = _wrap_rows(yb, tgt)
                 wb = np.zeros(len(xb), dtype=np.float32)

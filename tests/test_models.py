@@ -617,7 +617,7 @@ class TestFitStream:
 
     def test_sp_rejected(self):
         learner = self._learner().setSequenceParallel(2)
-        with pytest.raises(ValueError, match="single-host"):
+        with pytest.raises(ValueError, match="use fit"):
             learner.fitStream(self._stream_fn())
 
     def test_stream_batch_keeps_uint8_wire(self):

@@ -687,21 +687,23 @@ from mmlspark_tpu import DataFrame
 from mmlspark_tpu.core.utils import object_column
 from mmlspark_tpu.models import TpuLearner
 
-assert dist.initialize_from_env() is True
+dist.initialize_from_env()
 pid = jax.process_index()
+nproc = jax.process_count()
 
 # block-cyclic shard split: process p holds global rows r where
-# (r // bs_local) % 2 == p, so the per-step ASSEMBLED global batch has
+# (r // bs_local) % nproc == p, so the per-step ASSEMBLED global batch has
 # exactly the same row multiset as the single-process fit over the full
 # data (gradients are weighted means -> order within a batch is
 # irrelevant) — the digest must therefore match the solo run bit-for-bit
-# (same logical mesh, same XLA program)
+# (same logical mesh, same XLA program). Solo (nproc=1) degrades to every
+# row local, so the same source serves both runs.
 rng = np.random.default_rng(7)
 n, d, B = 64, 8, 16
-bs_local = B // 2
+bs_local = B // nproc
 x = rng.normal(size=(n, d)).astype(np.float32)
 y = (x[:, 0] + 0.3 * x[:, 1] > 0).astype(np.int64)
-mine = (np.arange(n) // bs_local) % 2 == pid
+mine = (np.arange(n) // bs_local) % nproc == pid
 df = DataFrame({'features': object_column([r for r in x[mine]]),
                 'label': y[mine]})
 
@@ -731,6 +733,82 @@ def test_trainer_two_process_tensor_parallel(tmp_path):
     model digest must equal the SINGLE-process fit over the same global
     data on the same logical 2x2 mesh — the strongest possible equivalence
     claim for the lifted multi-host tp restriction."""
+    fleet, solo = _run_digest_fleet(tmp_path, "tp", _TP_WORKER,
+                                    "TP_WORKER_OK", nprocs=2, devs=2)
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+# ------------------------------------------- multi-process sp / ep / pp
+
+# One worker template for every inner-axis strategy: a token transformer
+# trained on a block-cyclic row split (same global batch multiset per step
+# as the solo fit — see the dp/tp workers above), with deviceDataCap=1
+# forcing the per-step dispatch path on BOTH the fleet and the solo run so
+# the XLA programs are identical and the digests can match bit-for-bit.
+# {KNOB} becomes e.g. "setSequenceParallel(2)"; {CFG_EXTRA} merges extra
+# model-config keys (MoE experts for ep).
+_INNER_AXIS_WORKER = r'''
+import hashlib
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from mmlspark_tpu.parallel import distributed as dist
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuLearner
+
+dist.initialize_from_env()
+pid = jax.process_index()
+nproc = jax.process_count()
+
+rng = np.random.default_rng(11)
+n, T, B = 32, 8, 8
+bs_local = B // nproc
+toks = rng.integers(0, 17, size=(n, T)).astype(np.float32)
+y = (toks[:, 0] > 8).astype(np.int64)
+mine = (np.arange(n) // bs_local) % nproc == pid
+df = DataFrame({'features': object_column([r for r in toks[mine]]),
+                'label': y[mine]})
+
+cfg = {'type': 'transformer', 'vocab_size': 17, 'd_model': 8,
+       'heads': 2, 'layers': 2, 'num_classes': 2, 'max_len': 8}
+cfg.update({CFG_EXTRA})
+model = (TpuLearner()
+         .setModelConfig(cfg)
+         .{KNOB}
+         .setEpochs(2).setBatchSize(B).setLearningRate(0.05)
+         .setShuffle(False).setDeviceDataCap(1)
+         .fit(df))
+leaves = jax.tree_util.tree_leaves(model.getModelParams())
+digest = hashlib.sha256(
+    b''.join(np.ascontiguousarray(l).tobytes() for l in leaves)).hexdigest()
+from mmlspark_tpu.parallel import dataplane as dp
+digests = dp.allgather_pyobj(digest)
+assert len(set(digests)) == 1, digests
+out = model.transform(df)
+assert len(out.col('scores')) == int(mine.sum())
+dist.shutdown()
+print('INNER_WORKER_OK', digest)
+'''
+
+
+def _run_inner_axis_fleet(tmp_path, tag, knob, cfg_extra="",
+                          nprocs=2, devs=2):
+    """Launch `nprocs` real OS processes x `devs` virtual CPU devices each,
+    plus a solo run over the same logical mesh; return (fleet_digests, solo)."""
+    src = (_INNER_AXIS_WORKER.replace("{KNOB}", knob)
+           .replace("{CFG_EXTRA}", "{" + cfg_extra + "}"))
+    return _run_digest_fleet(tmp_path, tag, src, "INNER_WORKER_OK",
+                             nprocs=nprocs, devs=devs)
+
+
+def _run_digest_fleet(tmp_path, tag, src, ok_tag, nprocs=2, devs=2,
+                      solo=True):
+    """Generic fleet runner: launch `nprocs` OS processes x `devs` virtual
+    CPU devices on the worker source, collect the digest each prints after
+    `ok_tag`, and (optionally) run the same source solo on an
+    nprocs*devs-device mesh. Returns (fleet_digests, solo_digest|None)."""
     import socket
     import subprocess
     import sys
@@ -738,55 +816,178 @@ def test_trainer_two_process_tensor_parallel(tmp_path):
 
     repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
 
-    def run_fleet(nprocs, devs):
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        worker = tmp_path / f"tp_worker_{nprocs}.py"
-        worker.write_text(_TP_WORKER)
-        procs = []
-        for pid in range(nprocs):
-            env = dict(_os.environ, PYTHONPATH=repo,
-                       XLA_FLAGS=f"--xla_force_host_platform_device_count={devs}",
-                       MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
-                       MMLTPU_NUM_PROCESSES=str(nprocs),
-                       MMLTPU_PROCESS_ID=str(pid))
-            env.pop("JAX_PLATFORMS", None)
-            procs.append(subprocess.Popen(
-                [sys.executable, str(worker)], env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-        digests = []
-        try:
-            for p in procs:
-                out, err = p.communicate(timeout=240)
-                assert p.returncode == 0, (out[-1500:], err[-1500:])
-                line = [l for l in out.splitlines()
-                        if "TP_WORKER_OK" in l][-1]
-                digests.append(line.split()[-1])
-        finally:
-            for p in procs:   # never leave a blocked survivor behind
-                if p.poll() is None:
-                    p.kill()
-        return digests
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    worker = tmp_path / f"{tag}_worker.py"
+    worker.write_text(src)
+    procs = []
+    for pid in range(nprocs):
+        env = dict(_os.environ, PYTHONPATH=repo,
+                   XLA_FLAGS=f"--xla_force_host_platform_device_count={devs}",
+                   MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   MMLTPU_NUM_PROCESSES=str(nprocs),
+                   MMLTPU_PROCESS_ID=str(pid))
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    digests = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, (out[-1500:], err[-1500:])
+            digests.append([l for l in out.splitlines()
+                            if ok_tag in l][-1].split()[-1])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
-    fleet = run_fleet(2, 2)
-    assert len(set(fleet)) == 1, fleet
-
-    # solo run: same global data, same logical 2x2 mesh (1 proc x 4 devs);
-    # no coordinator -> initialize_from_env returns False, every row local
-    solo_worker = tmp_path / "tp_solo.py"
-    solo_worker.write_text(
-        _TP_WORKER
-        .replace("assert dist.initialize_from_env() is True",
-                 "dist.initialize_from_env()")
-        .replace("% 2 == pid", "% 2 < 2"))
+    if not solo:
+        return digests, None
+    solo_worker = tmp_path / f"{tag}_solo.py"
+    solo_worker.write_text(src)
     env = dict(_os.environ, PYTHONPATH=repo,
-               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={nprocs * devs}")
     env.pop("JAX_PLATFORMS", None)
     env.pop("MMLTPU_COORDINATOR", None)
     p = subprocess.run([sys.executable, str(solo_worker)], env=env,
-                       capture_output=True, text=True, timeout=240)
+                       capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-1500:])
-    solo = [l for l in p.stdout.splitlines()
-            if "TP_WORKER_OK" in l][-1].split()[-1]
+    solo_digest = [l for l in p.stdout.splitlines()
+                   if ok_tag in l][-1].split()[-1]
+    return digests, solo_digest
+
+
+@pytest.mark.extended
+def test_trainer_two_process_sequence_parallel(tmp_path):
+    """Multi-host dp x sp (ring): 2 processes x 2 local devices, the seq
+    axis riding each host's chips while dp crosses hosts. Fleet digests
+    must agree with each other AND with the single-process fit over the
+    same global data on the same logical (data=2, seq=2) mesh."""
+    fleet, solo = _run_inner_axis_fleet(
+        tmp_path, "sp_ring", "setSequenceParallel(2)")
+    assert len(set(fleet)) == 1, fleet
     assert solo == fleet[0], (solo, fleet)
+
+
+@pytest.mark.extended
+def test_trainer_two_process_sequence_parallel_ulysses(tmp_path):
+    """Same claim for the all-to-all (Ulysses) sp form: both lax.all_to_all
+    collectives execute on a process-spanning mesh."""
+    fleet, solo = _run_inner_axis_fleet(
+        tmp_path, "sp_uly",
+        "setSequenceParallel(2).setSpMode('ulysses')")
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+@pytest.mark.extended
+def test_trainer_two_process_expert_parallel(tmp_path):
+    """Multi-host dp x ep: stacked expert weights sharded over each host's
+    chips (process-local expert axis), dp across hosts; MoE dispatch
+    all-to-alls are XLA-inferred from the shardings. Digest-equal to the
+    solo fit on the same logical (data=2, expert=2) mesh."""
+    fleet, solo = _run_inner_axis_fleet(
+        tmp_path, "ep", "setExpertParallel(2)",
+        cfg_extra="'num_experts': 2")
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+@pytest.mark.extended
+@pytest.mark.parametrize("devs", [2, 4])
+def test_trainer_two_process_pipeline_parallel(tmp_path, devs):
+    """Multi-host dp x pp: the 2-stage GPipe ring rides each host's local
+    devices (stage hops never cross hosts), dp across hosts. devs=4 makes
+    the dp axis (4) larger than the process count — the geometry where
+    per-process microbatch rounding must target the LOCAL share of the
+    global data*micro multiple, not the global one."""
+    fleet, solo = _run_inner_axis_fleet(
+        tmp_path, f"pp{devs}", "setPipelineParallel(2)", devs=devs)
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+# ------------------------------------------------- multi-host fitStream
+
+_STREAM_WORKER = r'''
+import hashlib
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+from mmlspark_tpu.parallel import distributed as dist
+from mmlspark_tpu.models import TpuLearner
+
+dist.initialize_from_env()
+pid = jax.process_index()
+nproc = jax.process_count()
+
+rng = np.random.default_rng(5)
+xs = rng.normal(size=(24, 6)).astype(np.float32)
+ys = (xs[:, 0] > 0).astype(np.int64)
+
+SHORTFALL = {SHORTFALL}   # batches process 1's stream is short of process 0's
+def batches_fn():
+    if nproc == 1:
+        for s in range(3):
+            yield xs[s * 8:(s + 1) * 8], ys[s * 8:(s + 1) * 8]
+    else:
+        # process p streams its half of each global batch, so global step
+        # s assembles exactly the solo run's batch s; a positive SHORTFALL
+        # makes process 1's stream shorter (3 = empty from the start) and
+        # rides the zero-weight dummy path while process 0 drains
+        for s in range(3 - pid * SHORTFALL):
+            lo = s * 8 + pid * 4
+            yield xs[lo:lo + 4], ys[lo:lo + 4]
+
+model = (TpuLearner()
+         .setModelConfig({'type': 'mlp', 'hidden': [8], 'num_classes': 2})
+         .setEpochs(2).setLearningRate(0.05)
+         .fitStream(batches_fn))
+leaves = jax.tree_util.tree_leaves(model.getModelParams())
+digest = hashlib.sha256(
+    b''.join(np.ascontiguousarray(l).tobytes() for l in leaves)).hexdigest()
+from mmlspark_tpu.parallel import dataplane as dp
+digests = dp.allgather_pyobj(digest)
+assert len(set(digests)) == 1, digests
+dist.shutdown()
+print('STREAM_WORKER_OK', digest)
+'''
+
+
+@pytest.mark.extended
+def test_fitstream_two_process_data_parallel(tmp_path):
+    """Multi-host fitStream: each process streams its own generator (its
+    corpus shard); per-step host lockstep agrees the bucket size. With
+    equal streams feeding the halves of each solo batch, the fleet digest
+    must equal the solo fitStream bit-for-bit."""
+    fleet, solo = _run_digest_fleet(
+        tmp_path, "stream", _STREAM_WORKER.replace("{SHORTFALL}", "0"),
+        "STREAM_WORKER_OK", nprocs=2, devs=1)
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+@pytest.mark.extended
+def test_fitstream_two_process_unequal_streams(tmp_path):
+    """Unequal shard sizes must not deadlock: the shorter stream feeds
+    zero-weight dummy batches until the longer one drains, and every
+    process still ends with the identical model."""
+    fleet, _ = _run_digest_fleet(
+        tmp_path, "stream_uneq", _STREAM_WORKER.replace("{SHORTFALL}", "1"),
+        "STREAM_WORKER_OK", nprocs=2, devs=1, solo=False)
+    assert len(set(fleet)) == 1, fleet
+
+
+@pytest.mark.extended
+def test_fitstream_two_process_one_empty_stream(tmp_path):
+    """The limiting case of unequal shards: one process's generator yields
+    NOTHING. It must still agree the batch signature host-side, init
+    identical params, and feed zero-weight dummies — not raise before the
+    lockstep starts and strand the fleet in a collective."""
+    fleet, _ = _run_digest_fleet(
+        tmp_path, "stream_empty", _STREAM_WORKER.replace("{SHORTFALL}", "3"),
+        "STREAM_WORKER_OK", nprocs=2, devs=1, solo=False)
+    assert len(set(fleet)) == 1, fleet
