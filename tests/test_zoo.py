@@ -70,5 +70,39 @@ def test_zoo_ships_multiple_models_including_real_data():
         assert s.name in readme
     # accuracies are committed in the README table
     import re
-    accs = [float(m) for m in re.findall(r"\| (0\.\d{4}) \|", readme)]
+    accs = [float(m) for m in re.findall(r"\| ([01]\.\d{4}) \|", readme)]
+    assert len(accs) == len(schemas), (accs, len(schemas))
     assert len(accs) >= 2 and all(a > 0.9 for a in accs), accs
+
+
+def test_bottleneck_zoo_model_truncates():
+    """The zoo must ship a trained BOTTLENECK backbone (the ResNet-50 block
+    family the reference's ImageFeaturizer truncates,
+    ImageFeaturizer.scala:117-142), and cutting layers off its top must
+    yield stage-width features — trained-weight truncation, not just the
+    basic-block nets."""
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.schema import make_image_row
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import ImageFeaturizer, TpuModel
+
+    repo = LocalRepo(ZOO)
+    cands = [s for s in repo.listSchemas() if s.name == "ResNet26b"]
+    assert cands, "zoo lacks the bottleneck backbone"
+    s = cands[0]
+    backbone = TpuModel().setModelSchema(s)
+    rng = np.random.default_rng(0)
+    rows = object_column([
+        make_image_row(f"r{i}", 32, 32, 3,
+                       rng.integers(0, 256, (32, 32, 3)).astype(np.uint8))
+        for i in range(4)])
+    df = DataFrame({"image": rows})
+    feat = (ImageFeaturizer().setInputCol("image").setOutputCol("features")
+            .setModel(backbone).setCutOutputLayers(1))   # pooled features
+    out = feat.transform(df)
+    vecs = np.stack(list(out.col("features")))
+    # pooled bottleneck features = last stage's expanded width (512)
+    assert vecs.shape == (4, 512), vecs.shape
+    assert np.isfinite(vecs).all()
+    # distinct inputs -> distinct embeddings (trained, non-degenerate net)
+    assert np.std(vecs, axis=0).mean() > 0
