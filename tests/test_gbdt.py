@@ -583,3 +583,63 @@ class TestEFB:
         assert sum(len(b) for b in bundles) == d     # nothing dropped
         assert len(bundles) < d / 2                  # real packing happened
         assert all(len(b) <= 254 for b in bundles)
+
+
+class TestFeatureImportances:
+    """Split-count importances (beyond-parity: the reference's 2.0.120-era
+    wrapper exposes none; LightGBM importance_type='split' semantics)."""
+
+    def _dense_df(self, n=400, d=6, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float64)   # only feature 0 informative
+        return _df_from_matrix(x, y), x, y
+
+    def test_leafwise_counts_match_state_and_rank_signal(self):
+        df, x, y = self._dense_df()
+        model = (LightGBMClassifier().setNumIterations(10)
+                 .setParallelism("serial").fit(df))
+        imp = model.featureImportances()
+        assert imp.shape == (x.shape[1],)
+        assert imp[0] == imp.max() > 0, imp
+        state = model.getBoosterState()
+        assert imp.sum() == int((np.asarray(state["split_leaf"]) >= 0).sum())
+
+    def test_depthwise_and_regressor(self):
+        df, x, y = self._dense_df()
+        reg_y = 3.0 * x[:, 1] + 0.05 * np.random.default_rng(1).normal(
+            size=len(x))
+        rdf = _df_from_matrix(x, reg_y.astype(np.float64))
+        model = (LightGBMRegressor().setGrowthPolicy("depthwise")
+                 .setNumIterations(10).setParallelism("serial").fit(rdf))
+        imp = model.featureImportances()
+        assert imp.shape == (x.shape[1],)
+        assert imp[1] == imp.max() > 0, imp
+        # depthwise real splits = nodes whose threshold routes both ways
+        state = model.getBoosterState()
+        nb = np.asarray(state["bin_edges"]).shape[1] + 1
+        assert imp.sum() == int((np.asarray(state["threshold"]) < nb).sum())
+        # widened vector: trailing never-split slots are zero
+        wide = model.featureImportances(n_features=10)
+        assert wide.shape == (10,) and not wide[x.shape[1]:].any()
+
+    @pytest.mark.extended
+    def test_wide_sparse_efb_credits_tail_signal(self):
+        """Importances on an EFB fit map back to ORIGINAL column ids: the
+        rare tail-signal columns (bundled into categorical composites)
+        must collect split credit."""
+        helper = TestEFB()
+        mat, y = helper._wide_sparse()
+        df = helper._df(mat, y)
+        clf = (LightGBMClassifier().setMaxDenseFeatures(64)
+               .setNumIterations(20).setNumLeaves(16)
+               .setParallelism("serial"))
+        model = clf.fit(df)
+        assert model.getFeatureBundles()
+        imp = model.featureImportances()
+        assert imp.shape[0] <= mat.shape[1]
+        sig_total = imp[64:].sum()      # tail = everything past the dense cap
+        assert sig_total > 0, "bundled tail columns collected no credit"
+        # the model separates the classes via tail features, so tail credit
+        # should not be a rounding error next to dense-noise credit
+        assert sig_total >= imp[:64].sum() * 0.1, imp[:64].sum()
