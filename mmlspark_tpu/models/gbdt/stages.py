@@ -459,7 +459,7 @@ def _split_importances(state: dict, selection, bundles,
         real = np.asarray(state["threshold"]) < edges.shape[1] + 1
     dense_split = real & (feat < n_dense)
     counts = np.bincount(feat[dense_split],
-                         minlength=n_dense)[:n_dense].astype(np.int64)
+                         minlength=n_dense).astype(np.int64)
 
     sel = None if selection is None else np.asarray(selection)
     needed = d_internal if sel is None else int(max(
