@@ -37,6 +37,17 @@ class TrainedClassifierModel(Model, HasLabelCol):
     scoredLabelsCol = StringParam("decoded predicted label column",
                                   default="scored_labels")
 
+    def featureImportances(self, n_features=None) -> np.ndarray:
+        """Split-count importances from a tree-backed inner model
+        (DT/RF/GBT/LightGBM), per ASSEMBLED feature slot — interpret slots
+        via the featurize model's column layout."""
+        inner = self.getInnerModel()
+        if not hasattr(inner, "featureImportances"):
+            raise AttributeError(
+                f"{type(inner).__name__} exposes no featureImportances "
+                f"(tree-backed models only)")
+        return inner.featureImportances(n_features)
+
     def transform(self, df: DataFrame) -> DataFrame:
         feat = self.getFeaturizeModel().transform(df)
         out = self.getInnerModel().transform(feat)
@@ -104,6 +115,8 @@ class TrainClassifier(Estimator, HasLabelCol):
 class TrainedRegressorModel(Model, HasLabelCol):
     featurizeModel = ComplexParam("fitted FeaturizeModel", default=None)
     innerModel = ComplexParam("fitted regressor", default=None)
+
+    featureImportances = TrainedClassifierModel.featureImportances
 
     def transform(self, df: DataFrame) -> DataFrame:
         feat = self.getFeaturizeModel().transform(df)

@@ -151,6 +151,20 @@ class TestTrainClassifier:
         out = model.transform(df)
         acc = (out.col("scored_labels").astype(np.float64) == y).mean()
         assert acc > 0.9
+        # tree-backed AutoML models pass importances through; the vector
+        # lives in ASSEMBLED feature space (4 numeric slots here)
+        imp = model.featureImportances()
+        assert imp.shape == (4,) and imp.sum() > 0
+
+    def test_feature_importances_requires_trees(self):
+        from mmlspark_tpu.models.classical import LogisticRegression
+        x, y = load_iris(return_X_y=True)
+        df = DataFrame({f"f{i}": x[:, i].astype(np.float32) for i in range(4)}
+                       | {"label": y.astype(np.int64)})
+        model = (TrainClassifier().setLabelCol("label")
+                 .setModel(LogisticRegression()).fit(df))
+        with pytest.raises(AttributeError, match="tree-backed"):
+            model.featureImportances()
 
 
 R_ALGOS = {
@@ -184,6 +198,23 @@ class TestTrainRegressor:
         assert_golden(R_GOLDENS, "diabetes", algo, "rmse", rmse,
                       tolerance=3.0)
         assert rmse < 0.9 * float(np.std(y)), f"{algo}: rmse {rmse}"
+
+    def test_regressor_feature_importances_passthrough(self):
+        rng = np.random.default_rng(0)
+        n = 300
+        df = DataFrame({"a": rng.normal(size=n).astype(np.float32),
+                        "b": rng.normal(size=n).astype(np.float32),
+                        "c": rng.normal(size=n).astype(np.float32)})
+        df = df.withColumn("label", (3.0 * df.col("b")).astype(np.float64))
+        model = (TrainRegressor().setLabelCol("label")
+                 .setModel(GBTRegressor().setNumIterations(15)
+                           .setMaxBin(31)).fit(df))
+        imp = model.featureImportances()
+        assert imp.shape == (3,) and imp.argmax() == 1, imp
+        lin = (TrainRegressor().setLabelCol("label")
+               .setModel(LinearRegression().setMaxIter(50)).fit(df))
+        with pytest.raises(AttributeError, match="tree-backed"):
+            lin.featureImportances()
 
     def test_linear_target(self):
         rng = np.random.default_rng(0)
