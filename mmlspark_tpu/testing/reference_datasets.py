@@ -105,10 +105,108 @@ def transfusion(seed: int = 0) -> DataFrame:
     })
 
 
+def breast_cancer_wisconsin(seed: int = 0) -> DataFrame:
+    """Original Wisconsin Breast Cancer schema: 9 ordinal cytology scores
+    (1-10), 699 samples, 65.5% benign; labels keep UCI's 2=benign /
+    4=malignant coding so the TrainClassifier label-reindex policy is
+    exercised. Real data is nearly separable (reference grid: LR train AUC
+    1.0, RF 1.0, NB 0.96)."""
+    rng = np.random.default_rng(seed + 3)
+    n = 699
+    y = (rng.random(n) < 0.345).astype(np.int64)   # 1 = malignant
+    s = y.astype(np.float64)
+
+    # real WBC features are strongly CORRELATED within a row (a malignant
+    # sample scores high across the board — inter-feature r ~ 0.7-0.9),
+    # and all-low malignant profiles essentially don't occur; a shared
+    # latent severity (weight 0.92, malignant tail truncated) carries that
+    # joint structure. Independent marginals alone leave multinomial NB at
+    # ~0.82 label-AUC where the real data's committed floor is 0.96.
+    lat = rng.normal(0.0, 1.0, n)
+    lat = np.where(y == 1, np.maximum(lat, -0.4), lat)
+
+    def score(mu_b, mu_m, sd_b, sd_m):
+        # published WBC class-conditional stats: benign scores cluster
+        # tightly at 1-3 (small sd), malignant spread 4-10 (large sd)
+        sd = sd_b + (sd_m - sd_b) * s
+        noise = 0.92 * lat + 0.39 * rng.normal(0.0, 1.0, n)
+        return np.clip(mu_b + (mu_m - mu_b) * s + sd * noise,
+                       1, 10).round()
+    cols = {
+        "Clump Thickness": score(2.9, 7.2, 1.5, 2.4),
+        "Uniformity of Cell Size": score(1.3, 6.6, 0.9, 2.7),
+        "Uniformity of Cell Shape": score(1.4, 6.6, 1.0, 2.6),
+        "Marginal Adhesion": score(1.4, 5.6, 1.0, 3.2),
+        "Single Epithelial Cell Size": score(2.1, 5.3, 0.9, 2.4),
+        "Bare Nuclei": score(1.3, 7.6, 1.2, 3.1),
+        "Bland Chromatin": score(2.1, 6.0, 1.1, 2.3),
+        "Normal Nucleoli": score(1.3, 5.9, 1.1, 3.4),
+        "Mitoses": score(1.1, 2.6, 0.5, 2.6),
+        "Class": (2 + 2 * y).astype(np.int64),      # 2 = benign, 4 = malignant
+    }
+    return DataFrame(cols)
+
+
+def telescope_data(seed: int = 0) -> DataFrame:
+    """MAGIC Gamma Telescope schema: 19,020 Cherenkov shower images as 10
+    continuous moments, 64.8% gamma ('g') vs hadron ('h') — string labels
+    exercise the ValueIndexer path. Moderate overlap (reference grid: RF
+    train AUC 0.89, GBT scored-label 0.82, LR 0.5)."""
+    rng = np.random.default_rng(seed + 4)
+    n = 19020
+    y = (rng.random(n) < 0.352).astype(np.int64)   # 1 = hadron
+    s = y.astype(np.float64)
+    length = np.exp(rng.normal(3.5 + 0.85 * s, 0.7))
+    width = np.exp(rng.normal(2.5 + 0.8 * s, 0.6))
+    size_ = rng.normal(2.78 + 0.32 * s, 0.44)
+    conc = np.clip(rng.normal(0.42 - 0.16 * s, 0.16), 0.01, 0.93)
+    # gammas point at the source: fAlpha concentrates near 0; hadrons are
+    # isotropic (≈uniform) — the single most discriminative moment
+    alpha = np.where(y == 0, rng.gamma(1.1, 9.0, n), rng.uniform(0, 90, n))
+    return DataFrame({
+        "fLength": length, "fWidth": width, "fSize": size_,
+        "fConc": conc, "fConc1": conc * rng.uniform(0.45, 0.75, n),
+        "fAsym": rng.normal(-4.3 + 22 * s, 59),
+        "fM3Long": rng.normal(8.5 + 16 * s, 51),
+        "fM3Trans": rng.normal(0.25, 20.7, n),
+        "fAlpha": np.clip(alpha, 0, 90),
+        "fDist": rng.normal(190 + 22 * s, 74.7),
+        "class": np.where(y == 1, "h", "g").astype(object),
+    })
+
+
+def fertility_diagnosis(seed: int = 0) -> DataFrame:
+    """UCI Fertility schema: 100 samples, 9 normalized features, 88% 'N'
+    (normal) — tiny and imbalanced, the reference's low floors (DT 0.65,
+    RF 0.68, LR 0.5) reflect how little signal there is."""
+    rng = np.random.default_rng(seed + 5)
+    n = 100
+    y = (rng.random(n) < 0.12).astype(np.int64)    # 1 = altered ('O')
+    s = y.astype(np.float64)
+    return DataFrame({
+        "Season": rng.choice([-1.0, -0.33, 0.33, 1.0], n),
+        "Age": np.clip(rng.normal(0.67 - 0.03 * s, 0.12), 0.5, 1.0),
+        "Childish diseases": rng.choice([0.0, 1.0], n, p=[0.87, 0.13]),
+        "Accident or serious trauma": rng.choice([0.0, 1.0], n,
+                                                 p=[0.56, 0.44]),
+        "Surgical intervention": rng.choice([0.0, 1.0], n, p=[0.49, 0.51]),
+        "High fevers in the last year": rng.choice([-1.0, 0.0, 1.0], n),
+        "Frequency of alcohol consumption": np.clip(
+            rng.normal(0.83 - 0.05 * s, 0.17), 0.2, 1.0),
+        "Smoking habit": rng.choice([-1.0, 0.0, 1.0], n),
+        "Number of hours spent sitting per day": np.clip(
+            rng.normal(0.41 + 0.06 * s, 0.19), 0.06, 1.0),
+        "Output": np.where(y == 1, "O", "N").astype(object),
+    })
+
+
 REFERENCE_DATASETS = {
     "PimaIndian.csv": (pima_indian, "Diabetes mellitus"),
     "data_banknote_authentication.csv": (banknote, "class"),
     "transfusion.csv": (transfusion, "Donated"),
+    "breast-cancer-wisconsin.csv": (breast_cancer_wisconsin, "Class"),
+    "TelescopeData.csv": (telescope_data, "class"),
+    "fertility_Diagnosis.train.csv": (fertility_diagnosis, "Output"),
 }
 
 #: the reference's committed floors: train-set AUC of LightGBMClassifier
@@ -141,6 +239,35 @@ TRAIN_CLASSIFIER_REFERENCE_AUC = {
     ("transfusion.csv", "GradientBoostedTreesClassification"): 0.64,
     ("transfusion.csv", "RandomForestClassification"): 0.77,
     ("transfusion.csv", "NaiveBayesClassifier"): 0.71,
+    # reference MLP rows for the same datasets (scored-label AUC, like
+    # GBT/NB — hence the low committed values)
+    ("PimaIndian.csv", "MultilayerPerceptronClassifier"): 0.5,
+    ("data_banknote_authentication.csv",
+     "MultilayerPerceptronClassifier"): 0.7,
+    ("transfusion.csv", "MultilayerPerceptronClassifier"): 0.5,
+    # round-3 widening: three more reference datasets with public UCI
+    # schemas (benchmarkMetrics.csv rows 30-35, 49-59, 64-69)
+    ("breast-cancer-wisconsin.csv", "LogisticRegression"): 1.0,
+    ("breast-cancer-wisconsin.csv", "DecisionTreeClassification"): 0.94,
+    ("breast-cancer-wisconsin.csv",
+     "GradientBoostedTreesClassification"): 0.93,
+    ("breast-cancer-wisconsin.csv", "RandomForestClassification"): 1.0,
+    ("breast-cancer-wisconsin.csv",
+     "MultilayerPerceptronClassifier"): 0.5,
+    ("breast-cancer-wisconsin.csv", "NaiveBayesClassifier"): 0.96,
+    ("TelescopeData.csv", "LogisticRegression"): 0.5,
+    ("TelescopeData.csv", "DecisionTreeClassification"): 0.62,
+    ("TelescopeData.csv", "GradientBoostedTreesClassification"): 0.82,
+    ("TelescopeData.csv", "RandomForestClassification"): 0.89,
+    ("TelescopeData.csv", "MultilayerPerceptronClassifier"): 0.56,
+    ("fertility_Diagnosis.train.csv", "LogisticRegression"): 0.5,
+    ("fertility_Diagnosis.train.csv", "DecisionTreeClassification"): 0.65,
+    ("fertility_Diagnosis.train.csv",
+     "GradientBoostedTreesClassification"): 0.58,
+    ("fertility_Diagnosis.train.csv",
+     "RandomForestClassification"): 0.68,
+    ("fertility_Diagnosis.train.csv",
+     "MultilayerPerceptronClassifier"): 0.5,
 }
 
 
