@@ -73,6 +73,13 @@ class TpuModel(Transformer):
         "wire dtype for float inputs: bfloat16 halves host->HBM traffic "
         "(inputs are cast on device anyway; ~3 decimal digits kept)",
         default="float32", choices=("float32", "bfloat16"))
+    tensorParallel = IntParam(
+        "size of the model (TP) mesh axis for inference: wide Dense "
+        "kernels shard over it (same placement rules as training), so a "
+        "model whose params exceed one chip's HBM can still serve; batch "
+        "stays sharded over the remaining data axis. Multi-host: must "
+        "divide the local device count (model axis rides ICI)", default=1,
+        min=1)
 
     def setModelLocation(self, path: str) -> "TpuModel":
         """Load a saved model — the CNTKModel.setModelLocation parity point,
@@ -108,19 +115,35 @@ class TpuModel(Transformer):
                 and cfg.get("num_experts", 0) > 0)
 
     def _cached_mesh(self):
-        """One mesh per device topology (a new Mesh object per call would
-        also defeat the device-params cache below)."""
-        devs = tuple(id(d) for d in jax.devices())
+        """One mesh per (device topology, tp) — a new Mesh object per call
+        would also defeat the device-params cache below."""
+        tp = self.getTensorParallel()
+        devs = (tuple(id(d) for d in jax.devices()), tp,
+                meshlib.in_local_fit())
         if getattr(self, "_mesh_key", None) != devs:
-            self._mesh_cache = meshlib.create_mesh()
+            if tp > 1:
+                if meshlib.in_local_fit():
+                    # local-fit trials pin every program to ONE device
+                    raise ValueError(
+                        "tensorParallel serving is unavailable inside "
+                        "local-fit mode (fleet tuner trials run "
+                        "single-device)")
+                if meshlib.effective_process_count() > 1:
+                    meshlib.require_inner_block_local(
+                        {"tensorParallel": tp})
+            # create_mesh raises when tp does not divide the device count
+            self._mesh_cache = meshlib.create_mesh(model=tp)
             self._mesh_key = devs
         return self._mesh_cache
 
     def _device_params(self, mesh):
-        """Device-resident replicated params, uploaded ONCE per (params,
-        mesh) — the serving loop calls transform per request batch, and
-        re-shipping the whole tree host->HBM each time (~100 MB for a
-        ResNet-50) would dominate request latency.
+        """Device-resident params, uploaded ONCE per (params, mesh) — the
+        serving loop calls transform per request batch, and re-shipping the
+        whole tree host->HBM each time (~100 MB for a ResNet-50) would
+        dominate request latency. Replicated by default; with
+        ``tensorParallel > 1`` wide Dense kernels shard over the model
+        axis (the training-side placement rules), so per-chip residency is
+        ~1/tp of the sharded mass.
 
         Cache validity is object identity via STRONG references (`is`, not
         id()): holding the uploaded tree alive means a new tree can never
@@ -130,29 +153,43 @@ class TpuModel(Transformer):
         host = self.getModelParams()
         if (getattr(self, "_dev_params_src", None) is not host
                 or getattr(self, "_dev_params_mesh", None) is not mesh):
-            self._dev_params = meshlib.put_replicated(host, mesh)
+            if self.getTensorParallel() > 1:
+                self._dev_params = meshlib.shard_params_tp(
+                    host, mesh, list(meshlib.TP_PARAM_RULES))
+            else:
+                self._dev_params = meshlib.put_replicated(host, mesh)
             self._dev_params_src = host
             self._dev_params_mesh = mesh
         return self._dev_params
 
-    # one jitted program per (config, output_layer); reused across transforms
+    # one jitted program per (config, output_layer, tp); reused across
+    # transforms
     def _apply_fn(self):
         key = getattr(self, "_apply_cache_key", None)
+        tp = self.getTensorParallel()
         cur = (tuple(sorted((k, str(v)) for k, v in self.getModelConfig().items())),
-               self.getOutputLayer())
+               self.getOutputLayer(), tp)
         if key != cur or not hasattr(self, "_apply_jit"):
             from .modules import build_model
             module = build_model(self.getModelConfig())
             ol = self.getOutputLayer() or None
+            kw = {}
+            if tp > 1:
+                # the last Dense's columns land model-axis-sharded under
+                # the TP rules; pin the OUTPUT to data-only sharding so
+                # host reads (np.asarray / local_rows) see whole rows
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                kw["out_shardings"] = NamedSharding(self._cached_mesh(),
+                                                    P("data"))
             if self._is_moe():
                 # MoE routing must know which rows are mesh padding: they
                 # may not claim expert capacity (same contract as training)
                 self._apply_jit = jax.jit(
                     lambda p, x, m: module.apply(p, x, output_layer=ol,
-                                                 row_mask=m))
+                                                 row_mask=m), **kw)
             else:
                 self._apply_jit = jax.jit(
-                    lambda p, x: module.apply(p, x, output_layer=ol))
+                    lambda p, x: module.apply(p, x, output_layer=ol), **kw)
             self._apply_cache_key = cur
         return self._apply_jit
 
@@ -248,12 +285,20 @@ class TpuModel(Transformer):
         from ..parallel import mesh as _meshlib
         nproc = _meshlib.effective_process_count()
         params = self._device_params(mesh)
+        # tp inference is a COLLECTIVE program (sharded-matmul all-gathers
+        # + the output reshard); interleaving it with another thread's
+        # collective fit deadlocks (parallel/mesh.py invariant) — same
+        # guard the trainers take. tp=1 programs have no collectives.
+        import contextlib
+        guard = (meshlib.collective_fit_lock if self.getTensorParallel() > 1
+                 else contextlib.nullcontext())
         if nproc > 1:
             # multi-host: this df is the process-local shard; SPMD demands
             # identical shapes/call counts everywhere, so the whole shard
             # goes in ONE globally-assembled batch (padded to the max local
             # length) and each process reads back its own rows
-            y = self._transform_multihost(x, mesh, apply_fn, params)
+            with guard:
+                y = self._transform_multihost(x, mesh, apply_fn, params)
             if y.ndim == 1:
                 return df.withColumn(self.getOutputCol(), y)
             from ..core.utils import object_column
@@ -268,32 +313,34 @@ class TpuModel(Transformer):
         # window keeps the next chunk queued (JAX async dispatch overlaps
         # host transfer with compute) while fetching finished ones, so HBM
         # residency stays ~window*miniBatchSize instead of the whole dataset
-        for lo in range(0, len(x), bs):
-            chunk = x[lo:lo + bs]
-            n_real = len(chunk)
-            # bucket partial chunks to the next power of two: serving feeds
-            # ragged request batches, and every distinct shape is a fresh
-            # XLA compile (seconds) — bucketing bounds the shape set to
-            # log2(miniBatchSize) and the padding rows are sliced off below
-            target = min(_next_pow2(n_real), bs)
-            if n_real < target:
-                filler = np.zeros((target - n_real,) + chunk.shape[1:],
-                                  chunk.dtype)
-                chunk = np.concatenate([chunk, filler])
-            padded, n = meshlib.pad_batch_to_devices(chunk, mesh)
-            n = n_real
-            xb = meshlib.shard_batch(padded, mesh)
-            if self._is_moe():
-                wb = np.zeros(len(padded), dtype=np.float32)
-                wb[:n] = 1.0
-                yd = apply_fn(params, xb, meshlib.shard_batch(wb, mesh))
-            else:
-                yd = apply_fn(params, xb)
-            pending.append((yd, n))
-            if len(pending) > window:
-                done, m = pending.pop(0)
-                outs.append(np.asarray(done)[:m])
-        outs.extend(np.asarray(yd)[:n] for yd, n in pending)
+        with guard:
+            for lo in range(0, len(x), bs):
+                chunk = x[lo:lo + bs]
+                n_real = len(chunk)
+                # bucket partial chunks to the next power of two: serving
+                # feeds ragged request batches, and every distinct shape is
+                # a fresh XLA compile (seconds) — bucketing bounds the
+                # shape set to log2(miniBatchSize) and the padding rows are
+                # sliced off below
+                target = min(_next_pow2(n_real), bs)
+                if n_real < target:
+                    filler = np.zeros((target - n_real,) + chunk.shape[1:],
+                                      chunk.dtype)
+                    chunk = np.concatenate([chunk, filler])
+                padded, n = meshlib.pad_batch_to_devices(chunk, mesh)
+                n = n_real
+                xb = meshlib.shard_batch(padded, mesh)
+                if self._is_moe():
+                    wb = np.zeros(len(padded), dtype=np.float32)
+                    wb[:n] = 1.0
+                    yd = apply_fn(params, xb, meshlib.shard_batch(wb, mesh))
+                else:
+                    yd = apply_fn(params, xb)
+                pending.append((yd, n))
+                if len(pending) > window:
+                    done, m = pending.pop(0)
+                    outs.append(np.asarray(done)[:m])
+            outs.extend(np.asarray(yd)[:n] for yd, n in pending)
         y = np.concatenate(outs, axis=0) if outs else np.empty((0,))
 
         if y.ndim == 1:

@@ -175,25 +175,7 @@ def _replace_like(host_tree, placed_tree):
     return jax.tree_util.tree_map(conv, host_tree, placed_tree)
 
 
-def _require_inner_block_local(axes: dict):
-    """Multi-host locality rule shared by fit()/fitStream(): the inner
-    parallel block (product of the non-data axes) must divide the LOCAL
-    device count. make_mesh puts ``data`` outermost, so inner axes span
-    contiguous device ranges — this keeps every seq/expert/model/pipe
-    collective on within-host ICI while only the dp all-reduce crosses
-    hosts, and keeps checkpointing and model export reading
-    process-locally-complete params (_host_tree)."""
-    inner = int(np.prod([max(1, v) for v in axes.values()]))
-    if inner <= 1:
-        return
-    n_local = jax.local_device_count()
-    if inner > n_local or n_local % inner != 0:
-        desc = "*".join(f"{nm}={v}" for nm, v in axes.items() if v > 1)
-        raise ValueError(
-            f"the inner parallel block ({desc} = {inner}) must divide the "
-            f"LOCAL device count ({n_local}) on a multi-host mesh: "
-            f"seq/expert/model/pipe axes must ride ICI within a host "
-            f"while dp crosses hosts")
+_require_inner_block_local = meshlib.require_inner_block_local
 
 
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
@@ -209,7 +191,7 @@ def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
     if ep > 1:
         rules += [("expert_w", P("expert",)), ("expert_b", P("expert",))]
     if tp > 1:
-        rules += [("Dense", P(None, "model")), ("kernel", P())]
+        rules += list(meshlib.TP_PARAM_RULES)
     if meshlib.effective_process_count() == 1:
         # single process: jit-inferred init shardings are correct AND free
         # (no host round-trip of the whole model)

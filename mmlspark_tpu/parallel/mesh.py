@@ -92,6 +92,11 @@ def create_mesh(data: Optional[int] = None, model: int = 1,
     devices = list(devices)
     n = len(devices)
     if data is None:
+        if model < 1 or n % model != 0:
+            raise ValueError(
+                f"model axis ({model}) must divide the device count ({n}) "
+                f"— a silently-truncated mesh would train/serve on a "
+                f"subset of the chips")
         data = n // model
     if data < 1 or model < 1:
         raise ValueError(f"mesh {data}x{model} is empty: {n} devices cannot "
@@ -179,10 +184,14 @@ def pad_batch_to_local_devices(arr: np.ndarray, mesh: Mesh,
 def local_rows(global_array, n: Optional[int] = None) -> np.ndarray:
     """THIS process's contiguous rows of a dim-0-sharded global array
     (inverse of put_global_batch), optionally sliced to the first n real
-    (unpadded) rows."""
-    shards = sorted(global_array.addressable_shards,
-                    key=lambda s: s.index[0].start or 0)
-    out = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    (unpadded) rows. Arrays replicated over an inner (model/seq) axis
+    expose one addressable shard PER replica — dedupe by row range so a
+    tp-sharded inference output doesn't repeat its rows."""
+    shards = {}
+    for s in global_array.addressable_shards:
+        shards.setdefault(s.index[0].start or 0, s)
+    out = np.concatenate([np.asarray(shards[k].data)
+                          for k in sorted(shards)], axis=0)
     return out[:n] if n is not None else out
 
 
@@ -214,6 +223,33 @@ def put_replicated(tree, mesh: Mesh):
     return jax.tree_util.tree_map(
         lambda a: jax.make_array_from_process_local_data(sh, np.asarray(a)),
         tree)
+
+
+#: tensor-parallel placement rules shared by training (TpuLearner) and
+#: inference (TpuModel): wide Dense kernels shard columns over ``model``,
+#: every other kernel replicates. First match wins (shard_params_tp).
+TP_PARAM_RULES = (("Dense", P(None, "model")), ("kernel", P()))
+
+
+def require_inner_block_local(axes: dict):
+    """Multi-host locality rule shared by fit()/fitStream()/transform():
+    the inner parallel block (product of the non-data axes) must divide
+    the LOCAL device count. make_mesh puts ``data`` outermost, so inner
+    axes span contiguous device ranges — this keeps every
+    seq/expert/model/pipe collective on within-host ICI while only the dp
+    all-reduce crosses hosts, and keeps checkpointing and model export
+    reading process-locally-complete params."""
+    inner = int(np.prod([max(1, v) for v in axes.values()]))
+    if inner <= 1:
+        return
+    n_local = jax.local_device_count()
+    if inner > n_local or n_local % inner != 0:
+        desc = "*".join(f"{nm}={v}" for nm, v in axes.items() if v > 1)
+        raise ValueError(
+            f"the inner parallel block ({desc} = {inner}) must divide the "
+            f"LOCAL device count ({n_local}) on a multi-host mesh: "
+            f"seq/expert/model/pipe axes must ride ICI within a host "
+            f"while dp crosses hosts")
 
 
 def shard_params_tp(params, mesh: Mesh, rules: Sequence[tuple[str, P]] = (),

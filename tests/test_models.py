@@ -143,6 +143,42 @@ class TestTpuModelInference:
         got = np.stack(list(out.col("scores")))
         np.testing.assert_allclose(got, direct, rtol=2e-2, atol=2e-2)
 
+    def test_tensor_parallel_inference_matches_replicated(self):
+        """setTensorParallel(k) serves with wide Dense kernels sharded over
+        the model axis (TP_PARAM_RULES — the training-side placement): the
+        scores must match the replicated single-axis program."""
+        cfg = {"type": "mlp", "input_dim": 8, "hidden": [32], "num_classes": 4}
+        m = build_model(cfg)
+        x = np.random.default_rng(2).normal(size=(21, 8)).astype(np.float32)
+        p = m.init(jax.random.PRNGKey(3), jnp.asarray(x[:2]))
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i]
+        df = DataFrame({"features": feats})
+
+        def scores(tp):
+            tm = (TpuModel().setModelConfig(cfg).setModelParams(p)
+                  .setMiniBatchSize(16).setTensorParallel(tp))
+            return np.stack(list(tm.transform(df).col("scores")))
+
+        np.testing.assert_allclose(scores(2), scores(1),
+                                   rtol=2e-2, atol=2e-2)
+        # the sharded placement really happened: a model-axis leaf of the
+        # device tree is not fully replicated
+        tm = (TpuModel().setModelConfig(cfg).setModelParams(p)
+              .setTensorParallel(2))
+        dev = tm._device_params(tm._cached_mesh())
+        leaves = jax.tree_util.tree_leaves(dev)
+        assert any(not l.is_fully_replicated for l in leaves
+                   if hasattr(l, "is_fully_replicated"))
+
+    def test_tensor_parallel_validation(self):
+        tm = (TpuModel().setModelConfig({"type": "mlp", "num_classes": 2})
+              .setModelParams({"params": {}})
+              .setTensorParallel(3))   # 3 does not divide the 8-device mesh
+        with pytest.raises(ValueError, match="divide the device count"):
+            tm._cached_mesh()
+
     @pytest.mark.extended
     def test_image_column_input(self):
         rng = np.random.default_rng(0)

@@ -721,6 +721,12 @@ digests = dp.allgather_pyobj(digest)
 assert len(set(digests)) == 1, digests
 out = model.transform(df)
 assert len(out.col('scores')) == int(mine.sum())
+# serve the same model TENSOR-PARALLEL: wide Dense kernels shard over the
+# model axis (process-local), batch stays on data — scores must match the
+# replicated serving path
+s1 = np.stack(list(out.col('scores')))
+s2 = np.stack(list(model.setTensorParallel(2).transform(df).col('scores')))
+assert s1.shape == s2.shape and np.allclose(s1, s2, atol=2e-2), 'tp serving'
 dist.shutdown()
 print('TP_WORKER_OK', digest)
 '''
