@@ -10,7 +10,7 @@ import numpy as np
 
 from mmlspark_tpu import DataFrame
 from mmlspark_tpu.automl import ComputeModelStatistics, TrainClassifier
-from mmlspark_tpu.models import LogisticRegression
+from mmlspark_tpu.models import GBTClassifier, LogisticRegression
 
 rng = np.random.default_rng(0)
 n = 400
@@ -33,4 +33,15 @@ row = metrics.first()
 print({k: round(float(v), 3) for k, v in row.items()
        if k in ("accuracy", "AUC")})
 assert row["accuracy"] > 0.7, "model should beat chance comfortably"
+
+# tree-backed AutoML models also expose split-count feature importances
+# (assembled-feature space: continuous slots like age/hours collect many
+# split thresholds, binary one-hot slots need only one — read counts per
+# slot, not as a cross-type ranking)
+tree_model = (TrainClassifier()
+              .setModel(GBTClassifier().setNumIterations(15).setMaxBin(31))
+              .fit(train))
+imp = tree_model.featureImportances()
+print("split-count importances (assembled slots):", imp.tolist())
+assert imp.sum() > 0
 print("example 101 OK")
