@@ -48,10 +48,44 @@ def main() -> int:
         print(f"spark-submit 101: held-out accuracy {acc:.3f} "
               f"({len(out)} rows scored on executors)")
         assert acc > 0.7, acc
+
+        _distributed_fit_demo(spark)
         print("SPARK_SUBMIT_101 OK")
         return 0
     finally:
         spark.stop()
+
+
+def _distributed_fit_demo(spark) -> None:
+    """The reference's signature move (LightGBMClassifier.scala:35-47):
+    fit launched FROM the data plane — every partition task joins the JAX
+    coordination service and the collective fit spans the executors.
+    Needs mapInArrow(..., barrier=True) (pyspark >= 3.5); skipped, with a
+    message, on older pyspark."""
+    import pandas as pd
+    import pyspark
+
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    from mmlspark_tpu.spark import wrapDistributed
+
+    if tuple(int(v) for v in pyspark.__version__.split(".")[:2]
+             if v.isdigit()) < (3, 5) and "shim" not in pyspark.__version__:
+        print("distributed fit: skipped (needs pyspark >= 3.5 for "
+              "barrier mapInArrow)")
+        return
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(600, 6)).astype(np.float32)
+    y = (x[:, 0] - 0.4 * x[:, 3] > 0).astype(np.float64)
+    sdf = spark.createDataFrame(pd.DataFrame(
+        {"features": [r.tolist() for r in x], "label": y}))
+    est = wrapDistributed(LightGBMClassifier().setNumIterations(10)
+                          .setNumLeaves(15).setMaxBin(63), numWorkers=2)
+    model = est.fit(sdf)       # barrier stage: executors ARE the fleet
+    out = model.transform(sdf).toPandas()
+    acc = float((out["prediction"].astype(float).to_numpy() == y).mean())
+    print(f"distributed fit: 2-worker barrier-stage GBDT accuracy "
+          f"{acc:.3f}")
+    assert acc > 0.85, acc
 
 
 if __name__ == "__main__":

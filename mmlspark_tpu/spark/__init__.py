@@ -211,18 +211,31 @@ class SparkTransformer:
 
 
 class SparkEstimator:
-    """A TPU-native Estimator driven from Spark: collects the
-    (driver-sized) training set as Arrow, fits natively — on the TPU when
-    one is attached to the driver — and wraps the fitted model."""
+    """A TPU-native Estimator driven from Spark. Two fit modes:
 
-    def __init__(self, inner):
+      * default — collects the (driver-sized, as in the reference's own
+        estimators) training set as Arrow, fits natively on the driver.
+      * ``distributed=True`` (see :func:`wrapDistributed`) — runs fit as a
+        barrier-stage job across the executors; every partition joins the
+        JAX coordination service and the collective fit spans the fleet
+        (the reference's partitions-are-workers architecture,
+        LightGBMClassifier.scala:35-47)."""
+
+    def __init__(self, inner, distributed: bool = False,
+                 numWorkers: Optional[int] = None):
         _pyspark()
         self.inner = inner
+        self.distributed = distributed
+        self.numWorkers = numWorkers
         self.uid = f"mmltpu_{type(inner).__name__}_{id(inner):x}"
 
     __getattr__ = _forward_params
 
     def fit(self, sdf):
+        if self.distributed:
+            from .distributed import fit_distributed
+            return SparkTransformer(
+                fit_distributed(self.inner, sdf, self.numWorkers))
         native = _spark_df_to_native(sdf)
         return SparkTransformer(self.inner.fit(native))
 
@@ -265,4 +278,9 @@ def readImages(spark, path: str, recursive: bool = True,
     return spark.createDataFrame(pdf)
 
 
-__all__ = ["wrap", "SparkTransformer", "SparkEstimator", "readImages"]
+# fit-across-the-executors entry point (module imports stay lazy for
+# pyspark: distributed.py's top level is stdlib-only)
+from .distributed import wrapDistributed  # noqa: E402
+
+__all__ = ["wrap", "wrapDistributed", "SparkTransformer", "SparkEstimator",
+           "readImages"]

@@ -8,6 +8,13 @@ surface the adapter touches, over pandas/pyarrow, with REAL partition
 semantics (the frame splits into record batches and the adapter's
 function runs per batch, exactly as executors would drive it).
 
+``mapInArrow(..., barrier=True)`` (the distributed-fit path) is the one
+place the shim is MORE than pandas glue: each partition's task runs in
+its own spawned OS process, concurrently, with a ``BarrierTaskContext``
+double whose ``allGather`` synchronizes across those processes — so the
+adapter's JAX-coordination-service rendezvous and collective fit execute
+for real, exactly as Spark's barrier scheduler would drive them.
+
 When real pyspark is importable the tests use it instead and this module
 is never loaded. Honesty note: passing against the shim proves the
 adapter's Python logic, not Spark integration — the spark-submit E2E
@@ -17,12 +24,133 @@ pyspark exists.
 
 from __future__ import annotations
 
+import os
 import sys
 import types
 
 import numpy as np
 import pandas as pd
 import pyarrow as pa
+
+#: set by _barrier_child in barrier-task worker processes; read by the
+#: shim BarrierTaskContext.get() that install() registers
+_ACTIVE_BARRIER_CTX = None
+
+
+def _pickler():
+    """cloudpickle when present (what real pyspark ships task closures
+    with); plain pickle otherwise — BarrierFitTask is deliberately
+    closure-free, so either works."""
+    try:
+        import cloudpickle
+        return cloudpickle
+    except ImportError:
+        import pickle
+        return pickle
+
+
+def _ipc_bytes(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def _ipc_batches(blob: bytes) -> list:
+    if not blob:
+        return []
+    with pa.ipc.open_stream(pa.py_buffer(blob)) as r:
+        return list(r)
+
+
+class _TaskInfo:
+    def __init__(self, address: str):
+        self.address = address
+
+
+class ShimBarrierTaskContext:
+    """BarrierTaskContext double: partitionId/getTaskInfos/allGather
+    synchronized through marker FILES in a directory shared by the
+    concurrently-running task processes. File-based (not
+    multiprocessing.Manager) so the tasks can be plain subprocesses —
+    immune to the spawn-reimports-__main__ trap when the driver script is
+    stdin or an embedded interpreter."""
+
+    def __init__(self, pid: int, nparts: int, sync_dir: str,
+                 timeout: float = 180.0):
+        self._pid, self._n = pid, nparts
+        self._dir, self._timeout = sync_dir, timeout
+        self._gen = 0
+
+    @classmethod
+    def get(cls):
+        if _ACTIVE_BARRIER_CTX is None:
+            raise RuntimeError("not inside a barrier task")
+        return _ACTIVE_BARRIER_CTX
+
+    def partitionId(self):
+        return self._pid
+
+    def getTaskInfos(self):
+        return [_TaskInfo("127.0.0.1:0") for _ in range(self._n)]
+
+    def _write(self, name: str, payload: str) -> None:
+        final = os.path.join(self._dir, name)
+        tmp = final + ".tmp"       # atomic publish: no partial reads
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, final)
+
+    def _await(self, names: list) -> None:
+        import time
+        deadline = time.monotonic() + self._timeout
+        while True:
+            if all(os.path.exists(os.path.join(self._dir, n))
+                   for n in names):
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"barrier sync timed out waiting for "
+                                   f"{names} in {self._dir}")
+            time.sleep(0.01)
+
+    def allGather(self, message: str = ""):
+        self._gen += 1
+        names = [f"g{self._gen}_p{i}.msg" for i in range(self._n)]
+        self._write(names[self._pid], message)
+        self._await(names)
+        out = []
+        for n in names:
+            with open(os.path.join(self._dir, n)) as f:
+                out.append(f.read())
+        return out
+
+    def barrier(self):
+        self._gen += 1
+        names = [f"b{self._gen}_p{i}" for i in range(self._n)]
+        self._write(names[self._pid], "")
+        self._await(names)
+
+
+def _barrier_child_main(sync_dir: str, pid: int, nparts: int) -> None:
+    """Entry point of one barrier-task subprocess (the shim's
+    executor-python-worker analog; launched `python -c`). Env is pinned
+    to a small CPU mesh BEFORE jax loads, the shim pyspark (incl. the
+    live barrier context) is installed, then the adapter's pickled task
+    function runs over the partition's Arrow batches."""
+    global _ACTIVE_BARRIER_CTX
+    os.environ.setdefault("MMLTPU_INIT_TIMEOUT", "90")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    install()
+    _ACTIVE_BARRIER_CTX = ShimBarrierTaskContext(pid, nparts, sync_dir)
+    with open(os.path.join(sync_dir, "task.pkl"), "rb") as f:
+        fn = _pickler().loads(f.read())
+    with open(os.path.join(sync_dir, f"part_p{pid}.arrow"), "rb") as f:
+        batches = _ipc_batches(f.read())
+    out = list(fn(iter(batches)))
+    blob = _ipc_bytes(pa.Table.from_batches(out)) if out else b""
+    with open(os.path.join(sync_dir, f"out_p{pid}.arrow"), "wb") as f:
+        f.write(blob)
 
 
 class ShimDataFrame:
@@ -63,10 +191,19 @@ class ShimDataFrame:
             lo = hi
         return out
 
-    def mapInArrow(self, fn, schema):
+    def repartition(self, n):
+        return ShimDataFrame(self._pdf, int(n))
+
+    def mapInArrow(self, fn, schema, barrier=False):
         """Real partition semantics: split rows into npartitions, feed each
-        partition's record batches through fn, concatenate the outputs."""
+        partition's record batches through fn, concatenate the outputs.
+        ``barrier=True`` (pyspark >= 3.5 contract) runs the partitions as
+        CONCURRENT spawned OS processes sharing a live barrier context —
+        the adapter's fleet rendezvous and collective fit execute for
+        real."""
         parts = np.array_split(np.arange(len(self._pdf)), self._nparts)
+        if barrier:
+            return self._barrier_map(fn, schema, parts)
         tables = []
         for idx in parts:
             batches = pa.Table.from_pandas(
@@ -74,6 +211,52 @@ class ShimDataFrame:
             out = list(fn(iter(batches)))
             if out:
                 tables.append(pa.Table.from_batches(out))
+        merged = (pa.concat_tables(tables) if tables
+                  else pa.table({f.name: [] for f in schema}))
+        return ShimDataFrame(merged.to_pandas(), self._nparts)
+
+    def _barrier_map(self, fn, schema, parts):
+        import subprocess
+        import sys as _sys
+        import tempfile
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with tempfile.TemporaryDirectory(prefix="shim_barrier_") as sd:
+            with open(os.path.join(sd, "task.pkl"), "wb") as f:
+                f.write(_pickler().dumps(fn))
+            for pid, idx in enumerate(parts):
+                with open(os.path.join(sd, f"part_p{pid}.arrow"),
+                          "wb") as f:
+                    f.write(_ipc_bytes(
+                        pa.Table.from_pandas(self._pdf.iloc[idx])))
+            env = dict(os.environ, PYTHONPATH=repo,
+                       XLA_FLAGS="--xla_force_host_platform_device_count=2")
+            env.pop("JAX_PLATFORMS", None)
+            procs = [subprocess.Popen(
+                [_sys.executable, "-c",
+                 f"from tests.pyspark_shim import _barrier_child_main; "
+                 f"_barrier_child_main({sd!r}, {pid}, {self._nparts})"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True)
+                for pid in range(self._nparts)]
+            results = {}
+            try:
+                for pid, p in enumerate(procs):
+                    out, err = p.communicate(timeout=300)
+                    if p.returncode != 0:
+                        raise AssertionError(
+                            f"barrier task {pid} failed:\n"
+                            f"{out[-1000:]}\n{err[-3000:]}")
+                    with open(os.path.join(sd, f"out_p{pid}.arrow"),
+                              "rb") as f:
+                        results[pid] = f.read()
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                        p.communicate()
+        tables = [pa.Table.from_batches(_ipc_batches(results[pid]))
+                  for pid in sorted(results) if results[pid]]
         merged = (pa.concat_tables(tables) if tables
                   else pa.table({f.name: [] for f in schema}))
         return ShimDataFrame(merged.to_pandas(), self._nparts)
@@ -134,6 +317,7 @@ def install() -> None:
     sql.types = t
     pyspark.sql = sql
     pyspark.ml = ml
+    pyspark.BarrierTaskContext = ShimBarrierTaskContext
     pyspark.__version__ = "0.0-shim"
     sys.modules.setdefault("pyspark", pyspark)
     sys.modules.setdefault("pyspark.sql", sql)

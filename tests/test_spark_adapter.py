@@ -171,6 +171,222 @@ def test_read_images_implicit(spark, tmp_path):
     assert all(len(b) == 12 * 10 * 3 for b in out["data"])
 
 
+def test_stage_bytes_round_trip_and_wrap_distributed_guard():
+    """The distributed-fit wire format round-trips estimators AND fitted
+    models; wrapDistributed refuses transformers with guidance."""
+    if not _have_real_pyspark():
+        from tests import pyspark_shim
+        pyspark_shim.install()
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    from mmlspark_tpu.spark.distributed import (stage_from_bytes,
+                                                stage_to_bytes,
+                                                wrapDistributed)
+    from mmlspark_tpu.stages import DropColumns
+
+    est = LightGBMClassifier().setNumIterations(4).setNumLeaves(7) \
+        .setMaxBin(31)
+    est2 = stage_from_bytes(stage_to_bytes(est))
+    assert est2.getNumIterations() == 4 and est2.getMaxBin() == 31
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(80, 4)).astype(np.float32)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": (x[:, 0] > 0).astype(np.float64)})
+    model = est.fit(df)
+    model2 = stage_from_bytes(stage_to_bytes(model))
+    a = np.stack(list(model.transform(df).col("probability")))
+    b = np.stack(list(model2.transform(df).col("probability")))
+    np.testing.assert_array_equal(a, b)
+
+    with pytest.raises(TypeError, match="Estimator"):
+        wrapDistributed(DropColumns())
+
+
+_SOLO_FIT_WORKER = r'''
+import hashlib, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import TpuLearner
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+
+assert jax.device_count() == 4, jax.devices()
+d = np.load(os.environ["SOLO_NPZ"])
+
+ldf = DataFrame({"features": object_column([r for r in d["x_learner"]]),
+                 "label": d["y_learner"].astype(np.int64)})
+lm = (TpuLearner()
+      .setModelConfig({"type": "mlp", "hidden": [8], "num_classes": 2})
+      .setEpochs(2).setBatchSize(16).setShuffle(False)
+      .setLearningRate(0.05).fit(ldf))
+leaves = jax.tree_util.tree_leaves(lm.getModelParams())
+print("LEARNER_DIGEST", hashlib.sha256(b"".join(
+    np.ascontiguousarray(l).tobytes() for l in leaves)).hexdigest())
+
+'''
+
+
+_GBDT_FLEET_WORKER = r'''
+import hashlib, os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models.gbdt import LightGBMClassifier
+from mmlspark_tpu.parallel import distributed as dist
+
+assert dist.initialize_from_env() is True
+pid = jax.process_index()
+d = np.load(os.environ["SOLO_NPZ"])
+xg, yg = d["x_gbdt"], d["y_gbdt"]
+n = len(xg)
+lo, hi = pid * n // 2, (pid + 1) * n // 2   # the shim's contiguous halves
+gdf = DataFrame({"features": object_column([r for r in xg[lo:hi]]),
+                 "label": yg[lo:hi].astype(np.float64)})
+gm = (LightGBMClassifier().setNumIterations(10).setNumLeaves(7)
+      .setMaxBin(31).fit(gdf))
+state = gm.getBoosterState()
+print("GBDT_DIGEST", hashlib.sha256(b"".join(
+    np.ascontiguousarray(np.asarray(state[k])).tobytes()
+    for k in sorted(state)
+    if getattr(state[k], "ndim", None))).hexdigest())
+dist.shutdown()
+'''
+
+
+def _learner_digest(model) -> str:
+    import hashlib
+
+    import jax
+    leaves = jax.tree_util.tree_leaves(model.getModelParams())
+    return hashlib.sha256(b"".join(
+        np.ascontiguousarray(l).tobytes() for l in leaves)).hexdigest()
+
+
+def _gbdt_digest(model) -> str:
+    """Digest of the booster's array state, indifferent to whether the
+    arrays are numpy (fresh fit) or jax (serialization round-trip — bytes
+    are identical, the state is all f32/i32/bool)."""
+    import hashlib
+    state = model.getBoosterState()
+    return hashlib.sha256(b"".join(
+        np.ascontiguousarray(np.asarray(state[k])).tobytes()
+        for k in sorted(state)
+        if getattr(state[k], "ndim", None))).hexdigest()
+
+
+@pytest.mark.extended
+def test_distributed_fit_from_spark_data_plane(spark, tmp_path):
+    """THE reference architecture through the adapter
+    (LightGBMClassifier.scala:35-47): fit runs as a barrier-stage job —
+    every partition task joins the JAX coordination service, its Arrow
+    batches become its ShardedDataFrame shard, and the collective fit
+    spans the fleet. The returned model must be DIGEST-IDENTICAL to a
+    solo fit of the same data on the same global device count (4), for
+    the trainer (DP gradient all-reduce). The GBDT model is instead
+    required digest-identical to a fit launched through the NATIVE
+    MMLTPU_* fleet contract over the same shards: cross-process psum
+    reduces in a different float order than the single-process
+    all-reduce (probe: psum([1e8, 1, -1e8, 1]) = 1.0 solo vs 0.0 on a
+    2-process mesh), so GBDT's histogram sums cannot be bitwise
+    solo-identical on any framework — the claim that matters is that the
+    Spark adapter drives EXACTLY the collective fit the native launcher
+    does.
+
+    Partition layout: the shim splits rows into contiguous halves, and
+    the fleet assembles global batches as [proc0's batch-slice, proc1's
+    batch-slice] — so the frame handed to Spark is laid out with row i of
+    the solo order living in shard (i // (B/2)) %% 2, making fleet batch k
+    equal solo batch k row-for-row (the exact inverse of the layout in
+    __graft_entry__.py's _MP_TP_WORKER)."""
+    import pandas as pd
+
+    from mmlspark_tpu.models import TpuLearner
+    from mmlspark_tpu.models.gbdt import LightGBMClassifier
+    from mmlspark_tpu.spark import wrapDistributed
+
+    if _have_real_pyspark():
+        # the digest layout arithmetic encodes the SHIM's contiguous-half
+        # partitioning and its 2-devices-per-worker env; real Spark
+        # round-robins repartition() and gives workers 1 XLA device. The
+        # real-Spark proof of the barrier fit is the quality-asserting
+        # demo inside spark_submit_101 (test_spark_submit_e2e).
+        pytest.skip("digest layout is shim-specific; real-pyspark proof "
+                    "lives in test_spark_submit_e2e")
+
+    rng = np.random.default_rng(7)
+    B = 16
+    n = 64
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.4 * x[:, 1] > 0).astype(np.int64)
+    shard_of = (np.arange(n) // (B // 2)) % 2
+    fleet_order = np.concatenate([np.where(shard_of == s)[0]
+                                  for s in (0, 1)])
+
+    ng = 400
+    xg = rng.normal(size=(ng, 6)).astype(np.float32)
+    yg = (xg[:, 0] - 0.3 * xg[:, 2] > 0).astype(np.int64)
+
+    # solo ground truth in a subprocess pinned to 4 CPU devices (= the
+    # fleet's 2 procs x 2 devices), so mesh layouts match bit-for-bit
+    npz = tmp_path / "solo.npz"
+    np.savez(npz, x_learner=x, y_learner=y, x_gbdt=xg, y_gbdt=yg)
+    wf = tmp_path / "solo_worker.py"
+    wf.write_text(_SOLO_FIT_WORKER)
+    env = dict(os.environ, PYTHONPATH=REPO, SOLO_NPZ=str(npz),
+               XLA_FLAGS="--xla_force_host_platform_device_count=4")
+    env.pop("JAX_PLATFORMS", None)
+    r = subprocess.run([sys.executable, str(wf)], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    solo = dict(ln.split() for ln in r.stdout.splitlines()
+                if "_DIGEST" in ln)
+
+    # GBDT ground truth: the native-launcher 2-process fleet on the same
+    # contiguous half-shards the shim will hand the barrier tasks
+    from tests.test_dataplane import _spawn_fleet
+    fleet_outs = _spawn_fleet(tmp_path, _GBDT_FLEET_WORKER,
+                              env_extra={"SOLO_NPZ": str(npz)},
+                              timeout=300)
+    native = dict(ln.split() for o in fleet_outs
+                  for ln in o.splitlines() if "_DIGEST" in ln)
+
+    # --- trainer through the adapter: barrier fleet fit ---
+    ldf = spark.createDataFrame(pd.DataFrame({
+        "features": [x[i].tolist() for i in fleet_order],
+        "label": y[fleet_order]}))
+    lest = wrapDistributed(
+        TpuLearner()
+        .setModelConfig({"type": "mlp", "hidden": [8], "num_classes": 2})
+        .setEpochs(2).setBatchSize(B).setShuffle(False)
+        .setLearningRate(0.05), numWorkers=2)
+    lmodel = lest.fit(ldf)
+    assert _learner_digest(lmodel.inner) == solo["LEARNER_DIGEST"]
+    out = lmodel.transform(ldf).toPandas()
+    assert len(out) == n
+    scores = np.stack([np.asarray(s) for s in out["scores"]])
+    acc = float((out["label"].to_numpy() == scores.argmax(1)).mean())
+    assert acc > 0.7, acc   # sanity only; the digest above is the claim
+
+    # --- GBDT through the adapter: collective histograms ---
+    gdf = spark.createDataFrame(pd.DataFrame({
+        "features": [r.tolist() for r in xg],
+        "label": yg.astype(np.float64)}))
+    gest = wrapDistributed(
+        LightGBMClassifier().setNumIterations(10).setNumLeaves(7)
+        .setMaxBin(31), numWorkers=2)
+    gmodel = gest.fit(gdf)
+    assert _gbdt_digest(gmodel.inner) == native["GBDT_DIGEST"]
+    pred = gmodel.transform(gdf).toPandas()["prediction"] \
+        .astype(float).to_numpy()
+    assert (pred == yg).mean() > 0.9
+
+
 def test_wrapped_native_pipeline(spark):
     """Multi-stage composition crosses Spark once: build the pipeline
     NATIVE-side (TextFeaturizer -> LogisticRegression via Pipeline), wrap
