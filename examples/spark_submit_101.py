@@ -32,6 +32,11 @@ def main() -> int:
 
     spark = (SparkSession.builder.master(
         os.environ.get("SPARK_MASTER", "local[2]"))
+        # fresh python worker per task: the distributed-fit barrier stage
+        # must initialize JAX's coordination service BEFORE any other JAX
+        # work in the worker process, and reused workers have already run
+        # the mapInArrow transforms above
+        .config("spark.python.worker.reuse", "false")
         .appName("mmlspark_tpu-101").getOrCreate())
     try:
         from mmlspark_tpu.testing.datagen import census_pandas

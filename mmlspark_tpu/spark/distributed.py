@@ -112,8 +112,20 @@ class BarrierFitTask:
         from ..parallel import distributed as dist
         if n > 1:
             dist.configure_xla_cache()
-            dist.initialize(coordinator_address=coordinator,
-                            num_processes=n, process_id=pid)
+            try:
+                dist.initialize(coordinator_address=coordinator,
+                                num_processes=n, process_id=pid)
+            except RuntimeError as e:
+                # a REUSED executor python worker has often already run
+                # JAX (e.g. a mapInArrow transform), and jax.distributed
+                # cannot initialize after backends exist
+                raise RuntimeError(
+                    "distributed fit needs a fresh executor python worker "
+                    "per barrier task (JAX's coordination service must "
+                    "initialize before any other JAX work in the "
+                    "process). Set spark.python.worker.reuse=false on the "
+                    "SparkSession, or run the distributed fit before "
+                    "executor-side transforms") from e
         try:
             from ..parallel.dataplane import ShardedDataFrame
             from . import _pdf_to_native

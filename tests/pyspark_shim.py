@@ -232,21 +232,25 @@ class ShimDataFrame:
             env = dict(os.environ, PYTHONPATH=repo,
                        XLA_FLAGS="--xla_force_host_platform_device_count=2")
             env.pop("JAX_PLATFORMS", None)
+            # child output goes to FILES, not pipes: a verbose child
+            # filling a 64KB pipe mid-collective would deadlock the fleet
+            logs = [open(os.path.join(sd, f"log_p{pid}.txt"), "w+")
+                    for pid in range(self._nparts)]
             procs = [subprocess.Popen(
                 [_sys.executable, "-c",
                  f"from tests.pyspark_shim import _barrier_child_main; "
                  f"_barrier_child_main({sd!r}, {pid}, {self._nparts})"],
-                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True)
+                env=env, stdout=logs[pid], stderr=subprocess.STDOUT)
                 for pid in range(self._nparts)]
             results = {}
             try:
                 for pid, p in enumerate(procs):
-                    out, err = p.communicate(timeout=300)
+                    p.wait(timeout=300)
                     if p.returncode != 0:
+                        logs[pid].seek(0)
                         raise AssertionError(
                             f"barrier task {pid} failed:\n"
-                            f"{out[-1000:]}\n{err[-3000:]}")
+                            f"{logs[pid].read()[-4000:]}")
                     with open(os.path.join(sd, f"out_p{pid}.arrow"),
                               "rb") as f:
                         results[pid] = f.read()
@@ -254,7 +258,9 @@ class ShimDataFrame:
                 for p in procs:
                     if p.poll() is None:
                         p.kill()
-                        p.communicate()
+                        p.wait()
+                for lf in logs:
+                    lf.close()
         tables = [pa.Table.from_batches(_ipc_batches(results[pid]))
                   for pid in sorted(results) if results[pid]]
         merged = (pa.concat_tables(tables) if tables
