@@ -6,6 +6,24 @@ The featurizer here is the committed zoo/ artifact (ResNet-20 pretrained on
 shapes10 — see tools/build_zoo.py and zoo/README.md), loaded through the
 ModelDownloader local-repo path; the classifier trains on its pooled
 embeddings of the augmented set.
+
+A user who has REAL ImageNet ResNet-50 weights (torchvision's, exported
+to safetensors/npz/.pth) swaps the zoo backbone for them in two lines —
+the import folds BatchNorm running stats and reproduces torch's
+eval-mode activations exactly (models/import_weights.py):
+
+    from mmlspark_tpu.models.import_weights import import_resnet50
+    cfg, params = import_resnet50("resnet50-imagenet.safetensors",
+                                  preprocess="imagenet_uint8")
+    feat = (ImageFeaturizer().setInputCol("image").setOutputCol("feats")
+            .setModel(TpuModel().setModelConfig(cfg)
+                      .setModelParams(params))
+            .setCutOutputLayers(1))     # 2048-d ImageNet embeddings
+
+(preprocess="imagenet_uint8" folds torchvision's (x/255 - mean)/std
+input transform into the stem, so the raw uint8 image rows this
+pipeline carries reproduce torch's normalized-input activations
+exactly.)
 """
 
 import os

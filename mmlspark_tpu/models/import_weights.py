@@ -1,0 +1,254 @@
+"""Import externally-trained weights into the flax model zoo.
+
+The reference's ImageFeaturizer value rests on CDN-hosted pretrained
+ImageNet nets (reference: ModelDownloader.scala:109, Schema.scala:54-72
+— CNTK-format artifacts fetched by name). This zero-egress build cannot
+download, but a user who HAS a pretrained checkpoint — torchvision's
+ResNet-50 saved as safetensors/npz/torch .pth — can map it onto the
+``resnet50`` pytree here and get the full ImageFeaturizer/e305 flow:
+
+    from mmlspark_tpu.models.import_weights import import_resnet50
+    cfg, params = import_resnet50("resnet50-imagenet.safetensors",
+                                  preprocess="imagenet_uint8")
+    feat = (ImageFeaturizer().setModel(
+        TpuModel().setModelConfig(cfg).setModelParams(params))
+        .setCutOutputLayers(1))              # 2048-d ImageNet features
+
+(``preprocess="imagenet_uint8"`` folds torchvision's input transform
+into the stem so the featurizer's raw uint8 pixels are exactly what the
+torch net would see after its normalize step.)
+
+Fidelity: the returned config pins ``norm="frozen"`` and
+``padding="torch"`` so the forward pass reproduces torch's EVAL-mode
+activations exactly — BatchNorm running statistics fold into per-channel
+affines (scale = gamma/sqrt(var+eps), bias = beta - mean*scale; see
+``modules._FrozenAffine``), and stride-2 convs use torch's symmetric
+padding instead of XLA's SAME. Conv kernels transpose OIHW -> HWIO, the
+classifier head (out, in) -> (in, out).
+
+``import_flax_paths`` is the family-agnostic fallback: a checkpoint
+whose keys are already flax path strings ("Conv_0/kernel") loads onto
+ANY zoo family's pytree with shape validation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..core.utils import get_logger
+
+log = get_logger("import_weights")
+
+#: BatchNorm epsilon used when folding running stats (torch's default)
+BN_EPS = 1e-5
+
+#: torchvision's ImageNet input normalization (per RGB channel)
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+# torchvision resnet stage depths per family name
+RESNET_DEPTHS = {
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+
+
+def load_checkpoint(path: str) -> dict:
+    """name -> float32 ndarray from .safetensors / .npz / torch .pt(h).
+    Torch checkpoints may wrap the weights in a 'state_dict' entry."""
+    ext = os.path.splitext(path)[1].lower()
+    if ext == ".safetensors":
+        from safetensors.numpy import load_file
+        return {k: np.asarray(v) for k, v in load_file(path).items()}
+    if ext == ".npz":
+        with np.load(path) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    if ext in (".pt", ".pth", ".bin"):
+        import torch
+        state = torch.load(path, map_location="cpu", weights_only=True)
+        if isinstance(state, dict) and "state_dict" in state:
+            state = state["state_dict"]
+        return {k: v.detach().cpu().numpy() for k, v in state.items()
+                if hasattr(v, "detach")}
+    raise ValueError(f"unsupported checkpoint format {ext!r} "
+                     f"(expected .safetensors, .npz, .pt/.pth/.bin)")
+
+
+def fold_batchnorm(gamma, beta, mean, var, eps: float = BN_EPS):
+    """BN eval-mode -> (scale, bias) for _FrozenAffine:
+    y = gamma*(x-mean)/sqrt(var+eps) + beta  ==  x*scale + bias."""
+    scale = np.asarray(gamma, np.float32) / np.sqrt(
+        np.asarray(var, np.float32) + eps)
+    bias = np.asarray(beta, np.float32) - np.asarray(mean,
+                                                     np.float32) * scale
+    return scale, bias
+
+
+def _conv(state: dict, key: str) -> np.ndarray:
+    """torch conv weight OIHW -> flax HWIO."""
+    w = state.pop(key)
+    if w.ndim != 4:
+        raise ValueError(f"{key}: expected a 4-D conv kernel, "
+                         f"got shape {w.shape}")
+    return np.ascontiguousarray(
+        np.transpose(w, (2, 3, 1, 0)).astype(np.float32))
+
+
+def _affine(state: dict, prefix: str) -> dict:
+    """torch BN param group -> folded _FrozenAffine {scale, bias}."""
+    scale, bias = fold_batchnorm(
+        state.pop(f"{prefix}.weight"), state.pop(f"{prefix}.bias"),
+        state.pop(f"{prefix}.running_mean"),
+        state.pop(f"{prefix}.running_var"))
+    state.pop(f"{prefix}.num_batches_tracked", None)
+    return {"scale": scale, "bias": bias}
+
+
+def import_resnet50(checkpoint, num_classes: Optional[int] = None,
+                    family: str = "resnet50", depths=None,
+                    widths=None, preprocess: Optional[str] = None) -> tuple:
+    """Map a torchvision-layout ResNet-50/101/152 checkpoint (path or
+    preloaded name->array dict) onto the zoo pytree.
+
+    Returns ``(config, params)`` ready for TpuModel / ImageFeaturizer:
+    config is the ``resnet50`` family pinned to frozen-affine norms and
+    torch padding (exact eval-mode parity), params the flax pytree.
+    Raises with the offending key on any shape mismatch; warns on
+    leftover keys so a truncated/mislabeled checkpoint can't load
+    silently. ``depths``/``widths`` override the family table for
+    sibling layouts (wide-resnet, custom stacks).
+
+    ``preprocess="imagenet_uint8"`` folds torchvision's input transform
+    ((x/255 - mean)/std per RGB channel) into a per-channel input affine
+    INSIDE the net (ahead of the stem conv, so the zero-padded border is
+    the normalized zero exactly as torch sees it) — the net consumes raw
+    uint8 0..255 pixels (the ImageFeaturizer wire) and still reproduces
+    torch exactly. Default None expects already-normalized float input,
+    matching torch's own forward contract."""
+    state = dict(load_checkpoint(checkpoint)
+                 if isinstance(checkpoint, (str, os.PathLike))
+                 else checkpoint)
+    if depths is None:
+        if family not in RESNET_DEPTHS:
+            raise ValueError(
+                f"family must be one of {sorted(RESNET_DEPTHS)} (or pass "
+                f"depths=), got {family!r}")
+        depths = RESNET_DEPTHS[family]
+    widths = list(widths) if widths is not None else [256, 512, 1024, 2048]
+    fc_w = state.pop("fc.weight")
+    if num_classes is None:
+        num_classes = int(fc_w.shape[0])
+
+    input_affine = None
+    if preprocess == "imagenet_uint8":
+        # torchvision normalizes the image and THEN convolves with zero
+        # padding, so the transform must run inside the net ahead of the
+        # stem (a kernel fold would mis-handle the padded border): an
+        # input affine with z = x*(1/(255*std)) - mean/std
+        input_affine = {
+            "scale": (1.0 / (255.0 * IMAGENET_STD)).astype(np.float32),
+            "bias": (-IMAGENET_MEAN / IMAGENET_STD).astype(np.float32)}
+    elif preprocess is not None:
+        raise ValueError(f"preprocess must be None or 'imagenet_uint8', "
+                         f"got {preprocess!r}")
+
+    params = {"Conv_0": {"kernel": _conv(state, "conv1.weight")},
+              "_FrozenAffine_0": _affine(state, "bn1"),
+              "Dense_0": {
+                  "kernel": np.ascontiguousarray(
+                      fc_w.T.astype(np.float32)),
+                  "bias": state.pop("fc.bias").astype(np.float32)}}
+    bi = 0   # flax numbers blocks sequentially across stages
+    for stage, depth in enumerate(depths, start=1):
+        for b in range(depth):
+            t = f"layer{stage}.{b}"
+            blk = {"Conv_0": {"kernel": _conv(state, f"{t}.conv1.weight")},
+                   "_FrozenAffine_0": _affine(state, f"{t}.bn1"),
+                   "Conv_1": {"kernel": _conv(state, f"{t}.conv2.weight")},
+                   "_FrozenAffine_1": _affine(state, f"{t}.bn2"),
+                   "Conv_2": {"kernel": _conv(state, f"{t}.conv3.weight")},
+                   "_FrozenAffine_2": _affine(state, f"{t}.bn3")}
+            if f"{t}.downsample.0.weight" in state:
+                blk["Conv_3"] = {
+                    "kernel": _conv(state, f"{t}.downsample.0.weight")}
+                blk["_FrozenAffine_3"] = _affine(state, f"{t}.downsample.1")
+            params[f"_BottleneckBlock_{bi}"] = blk
+            bi += 1
+    if state:
+        import re
+        structural = sorted(k for k in state
+                            if re.match(r"(layer\d+|conv1|bn1|fc)\.", k))
+        if structural:
+            # a deeper net loaded under the wrong family pops cleanly and
+            # leaves its extra blocks here — that MUST be loud
+            raise ValueError(
+                f"checkpoint has {len(structural)} unconsumed backbone "
+                f"keys (first: {structural[0]!r}) — wrong family/depths? "
+                f"(e.g. a resnet101 checkpoint needs family='resnet101')")
+        log.warning("checkpoint keys not consumed by the %s mapping "
+                    "(ignored non-backbone entries): %s",
+                    family, sorted(state)[:8])
+
+    config = {"type": "resnet50", "blocks_per_stage": list(depths),
+              "widths": widths, "num_classes": num_classes,
+              "norm": "frozen", "padding": "torch", "dtype": "float32",
+              "height": 224, "width": 224}
+    if input_affine is not None:
+        params["input_norm"] = input_affine
+        config["input_norm"] = True
+    _validate_against_module(config, {"params": params})
+    return config, {"params": params}
+
+
+def import_flax_paths(checkpoint, config: dict) -> dict:
+    """Family-agnostic import: checkpoint keys are flax path strings
+    ('_BottleneckBlock_0/Conv_1/kernel' or with '.' separators) laid
+    directly onto ``build_model(config)``'s pytree, shape-checked."""
+    state = (load_checkpoint(checkpoint)
+             if isinstance(checkpoint, (str, os.PathLike))
+             else dict(checkpoint))
+    params: dict = {}
+    for key, value in state.items():
+        parts = [p for p in key.replace(".", "/").split("/")
+                 if p and p != "params"]
+        node = params
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = np.asarray(value, np.float32)
+    tree = {"params": params}
+    _validate_against_module(config, tree)
+    return tree
+
+
+def _validate_against_module(config: dict, tree: dict) -> None:
+    """Init the module on tiny input and compare pytree structure+shapes;
+    raises naming the first mismatch (an import must never half-load)."""
+    import jax
+    from flax.traverse_util import flatten_dict
+
+    from .modules import build_model, example_input
+
+    module = build_model(config)
+    ref = jax.eval_shape(
+        lambda: module.init(jax.random.PRNGKey(0),
+                            example_input(config, batch=1)))
+
+    def paths(t):
+        return {"/".join(k): tuple(v.shape)
+                for k, v in flatten_dict(t).items()}
+
+    want, got = paths(ref), paths(tree)
+    missing = sorted(set(want) - set(got))
+    extra = sorted(set(got) - set(want))
+    if missing or extra:
+        raise ValueError(
+            f"imported params do not match the {config['type']} pytree; "
+            f"missing={missing[:5]} extra={extra[:5]}")
+    for k in want:
+        if want[k] != got[k]:
+            raise ValueError(f"shape mismatch at {k}: checkpoint "
+                             f"{got[k]} vs module {want[k]}")

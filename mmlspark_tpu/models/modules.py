@@ -98,23 +98,66 @@ class ConvNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+class _FrozenAffine(nn.Module):
+    """BatchNorm in EVAL mode as a per-channel affine: y = x*scale + bias.
+
+    Exactly torch ``bn.eval()`` when scale = gamma/sqrt(var+eps) and
+    bias = beta - mean*scale — ``models.import_weights`` folds a foreign
+    checkpoint's running statistics into these two vectors, which is what
+    makes imported nets bit-faithful feature extractors (and is pure
+    elementwise math XLA fuses into the preceding conv)."""
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,))
+        bias = self.param("bias", nn.initializers.zeros, (c,))
+        return x * scale.astype(self.dtype) + bias.astype(self.dtype)
+
+
+def _norm_layer(norm: str, c: int, dtype):
+    """The normalization the net trains with ("group", batch-independent,
+    shards cleanly) or the affine an imported eval-mode net needs
+    ("frozen")."""
+    if norm == "frozen":
+        return _FrozenAffine(dtype=dtype)
+    return nn.GroupNorm(num_groups=None, group_size=c, dtype=dtype)
+
+
+def _conv_pad(padding: str, kernel: int):
+    """flax "SAME" (default) vs torch's fixed symmetric padding — for
+    stride-2 convs they disagree on WHERE the pixels land (SAME pads
+    (k-1)//2 low / k//2 high, torch k//2 both sides), so imported torch
+    nets need the torch layout to reproduce activations exactly."""
+    if padding == "torch":
+        p = kernel // 2
+        return ((p, p), (p, p))
+    return "SAME"
+
+
 class _BasicBlock(nn.Module):
     filters: int
     strides: int
     dtype: Any
+    norm: str = "group"
+    padding: str = "same"
 
     @nn.compact
     def __call__(self, x):
         y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding=_conv_pad(self.padding, 3),
                     use_bias=False, dtype=self.dtype)(x)
-        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
-                                 dtype=self.dtype)(y))
-        y = nn.Conv(self.filters, (3, 3), use_bias=False, dtype=self.dtype)(y)
-        y = nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
-                         dtype=self.dtype)(y)
+        y = nn.relu(_norm_layer(self.norm, y.shape[-1], self.dtype)(y))
+        y = nn.Conv(self.filters, (3, 3),
+                    padding=_conv_pad(self.padding, 3),
+                    use_bias=False, dtype=self.dtype)(y)
+        y = _norm_layer(self.norm, y.shape[-1], self.dtype)(y)
         if x.shape != y.shape:
             x = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
                         use_bias=False, dtype=self.dtype)(x)
+            if self.norm == "frozen":   # torch normalizes the projection too
+                x = _FrozenAffine(dtype=self.dtype)(x)
         return nn.relu(x + y)
 
 
@@ -123,23 +166,25 @@ class _BottleneckBlock(nn.Module):
     filters: int            # output width (the expanded 4x width)
     strides: int
     dtype: Any
+    norm: str = "group"
+    padding: str = "same"
 
     @nn.compact
     def __call__(self, x):
         inner = self.filters // 4
         y = nn.Conv(inner, (1, 1), use_bias=False, dtype=self.dtype)(x)
-        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
-                                 dtype=self.dtype)(y))
+        y = nn.relu(_norm_layer(self.norm, y.shape[-1], self.dtype)(y))
         y = nn.Conv(inner, (3, 3), (self.strides, self.strides),
+                    padding=_conv_pad(self.padding, 3),
                     use_bias=False, dtype=self.dtype)(y)
-        y = nn.relu(nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
-                                 dtype=self.dtype)(y))
+        y = nn.relu(_norm_layer(self.norm, y.shape[-1], self.dtype)(y))
         y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
-        y = nn.GroupNorm(num_groups=None, group_size=y.shape[-1],
-                         dtype=self.dtype)(y)
+        y = _norm_layer(self.norm, y.shape[-1], self.dtype)(y)
         if x.shape != y.shape:
             x = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
                         use_bias=False, dtype=self.dtype)(x)
+            if self.norm == "frozen":   # torch normalizes the projection too
+                x = _FrozenAffine(dtype=self.dtype)(x)
         return nn.relu(x + y)
 
 
@@ -162,6 +207,13 @@ class ResNet(nn.Module):
     block: str = "basic"               # basic | bottleneck
     stem: str = "cifar"                # cifar (3x3) | imagenet (7x7/2 + pool)
     dtype: Any = jnp.bfloat16
+    norm: str = "group"                # group (train) | frozen (imported eval)
+    padding: str = "same"              # same (XLA) | torch (imported nets)
+    #: per-channel affine applied to the RAW input before the stem —
+    #: imported nets fold their preprocessing (e.g. torchvision's
+    #: (x/255 - mean)/std) here so the padded border still sees the
+    #: normalized zero exactly as torch does
+    input_norm: bool = False
 
     def _depths(self):
         if isinstance(self.blocks_per_stage, int):
@@ -187,21 +239,31 @@ class ResNet(nn.Module):
                              f"got {self.block!r}")
         if self.stem not in ("cifar", "imagenet"):
             raise ValueError(f"stem must be cifar|imagenet, got {self.stem!r}")
+        if self.norm not in ("group", "frozen"):
+            raise ValueError(f"norm must be group|frozen, got {self.norm!r}")
+        if self.padding not in ("same", "torch"):
+            raise ValueError(f"padding must be same|torch, "
+                             f"got {self.padding!r}")
         Block = _BasicBlock if self.block == "basic" else _BottleneckBlock
         stem_width = (self.widths[0] // 4 if self.block == "bottleneck"
                       else self.widths[0])
         tap = _LayerTap(output_layer)
         x = x.astype(self.dtype)
+        if self.input_norm:
+            x = _FrozenAffine(dtype=self.dtype, name="input_norm")(x)
         if self.stem == "imagenet":
-            x = nn.Conv(stem_width, (7, 7), (2, 2), use_bias=False,
-                        dtype=self.dtype)(x)
+            x = nn.Conv(stem_width, (7, 7), (2, 2),
+                        padding=_conv_pad(self.padding, 7),
+                        use_bias=False, dtype=self.dtype)(x)
         else:
-            x = nn.Conv(stem_width, (3, 3), use_bias=False,
-                        dtype=self.dtype)(x)
-        x = nn.relu(nn.GroupNorm(num_groups=None, group_size=x.shape[-1],
-                                 dtype=self.dtype)(x))
+            x = nn.Conv(stem_width, (3, 3),
+                        padding=_conv_pad(self.padding, 3),
+                        use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(_norm_layer(self.norm, x.shape[-1], self.dtype)(x))
         if self.stem == "imagenet":
-            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=("SAME" if self.padding == "same"
+                                     else ((1, 1), (1, 1))))
         x = tap.tap("stem", x)
         if tap.done:
             return tap.result.astype(jnp.float32)
@@ -209,7 +271,8 @@ class ResNet(nn.Module):
             for b in range(depth):
                 strides = 2 if (s > 0 and b == 0) else 1
                 x = tap.tap(f"stage{s}_block{b}",
-                            Block(width, strides, self.dtype)(x))
+                            Block(width, strides, self.dtype,
+                                  self.norm, self.padding)(x))
                 if tap.done:
                     return tap.result.astype(jnp.float32)
         x = tap.tap("pool", jnp.mean(x, axis=(1, 2)))
@@ -405,13 +468,21 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
         widths=tuple(cfg.get("widths", (16, 32, 64))),
         num_classes=cfg.get("num_classes", 10),
         block=cfg.get("block", "basic"),
-        stem=cfg.get("stem", "cifar")),
+        stem=cfg.get("stem", "cifar"),
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16)),
+        norm=cfg.get("norm", "group"),
+        padding=cfg.get("padding", "same"),
+        input_norm=cfg.get("input_norm", False)),
     # the reference ImageFeaturizer's headline model (ResNet-50, ImageNet)
     "resnet50": lambda cfg: ResNet(
         blocks_per_stage=tuple(cfg.get("blocks_per_stage", (3, 4, 6, 3))),
         widths=tuple(cfg.get("widths", (256, 512, 1024, 2048))),
         num_classes=cfg.get("num_classes", 1000),
-        block="bottleneck", stem="imagenet"),
+        block="bottleneck", stem="imagenet",
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16)),
+        norm=cfg.get("norm", "group"),
+        padding=cfg.get("padding", "same"),
+        input_norm=cfg.get("input_norm", False)),
     "bilstm": lambda cfg: BiLSTMTagger(
         vocab_size=cfg.get("vocab_size", 10000),
         embed_dim=cfg.get("embed_dim", 128),
