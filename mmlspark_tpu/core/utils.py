@@ -59,6 +59,10 @@ def to_float32_matrix(col: np.ndarray) -> np.ndarray:
         if col.ndim == 1:
             return col.astype(np.float32).reshape(-1, 1)
         return col.astype(np.float32).reshape(len(col), -1)
+    if len(col) == 0:
+        # width is unknowable from an empty object column; multi-host
+        # callers recover it from the fleet (TpuModel._transform_multihost)
+        return np.zeros((0, 0), np.float32)
     return np.stack([np.asarray(v, dtype=np.float32).ravel() for v in col])
 
 

@@ -44,6 +44,10 @@ def _prep_input(df: DataFrame, col_name: str, input_shape) -> np.ndarray:
     NHWC when input_shape=(C,H,W) is given."""
     col = df.col(col_name)
     if is_image_column(df, col_name):
+        if len(col) == 0:
+            # layout unknowable from an empty shard; multi-host scoring
+            # adopts a peer's (see _transform_multihost's meta allgather)
+            return np.zeros((0, 1, 1, 3), np.uint8)
         return np.stack([image_to_array(r) for r in col])
     mat = to_float32_matrix(col)
     if input_shape:
@@ -294,9 +298,10 @@ class TpuModel(Transformer):
                  else contextlib.nullcontext())
         if nproc > 1:
             # multi-host: this df is the process-local shard; SPMD demands
-            # identical shapes/call counts everywhere, so the whole shard
-            # goes in ONE globally-assembled batch (padded to the max local
-            # length) and each process reads back its own rows
+            # identical shapes/call counts everywhere, so the fleet agrees
+            # on a chunk count and every process dispatches that many
+            # fixed-shape global chunks in lockstep (HBM stays bounded by
+            # miniBatchSize, not shard size)
             with guard:
                 y = self._transform_multihost(x, mesh, apply_fn, params)
             if y.ndim == 1:
@@ -304,44 +309,30 @@ class TpuModel(Transformer):
             from ..core.utils import object_column
             return df.withColumn(self.getOutputCol(), object_column(y))
 
-        pending: list = []
-        outs = []
-        window = 2  # in-flight chunks: overlap transfer/compute, bound HBM
         bs = self.getMiniBatchSize()
-        # round the device batch up to a multiple of the data axis;
-        # outputs are sliced back so padding never leaks. A small dispatch
-        # window keeps the next chunk queued (JAX async dispatch overlaps
-        # host transfer with compute) while fetching finished ones, so HBM
-        # residency stays ~window*miniBatchSize instead of the whole dataset
-        with guard:
+
+        def chunks():
             for lo in range(0, len(x), bs):
                 chunk = x[lo:lo + bs]
                 n_real = len(chunk)
                 # bucket partial chunks to the next power of two: serving
-                # feeds ragged request batches, and every distinct shape is
-                # a fresh XLA compile (seconds) — bucketing bounds the
-                # shape set to log2(miniBatchSize) and the padding rows are
-                # sliced off below
+                # feeds ragged request batches, and every distinct shape
+                # is a fresh XLA compile (seconds) — bucketing bounds the
+                # shape set to log2(miniBatchSize) and the padding rows
+                # are sliced off on read-back
                 target = min(_next_pow2(n_real), bs)
                 if n_real < target:
                     filler = np.zeros((target - n_real,) + chunk.shape[1:],
                                       chunk.dtype)
                     chunk = np.concatenate([chunk, filler])
-                padded, n = meshlib.pad_batch_to_devices(chunk, mesh)
-                n = n_real
-                xb = meshlib.shard_batch(padded, mesh)
-                if self._is_moe():
-                    wb = np.zeros(len(padded), dtype=np.float32)
-                    wb[:n] = 1.0
-                    yd = apply_fn(params, xb, meshlib.shard_batch(wb, mesh))
-                else:
-                    yd = apply_fn(params, xb)
-                pending.append((yd, n))
-                if len(pending) > window:
-                    done, m = pending.pop(0)
-                    outs.append(np.asarray(done)[:m])
-            outs.extend(np.asarray(yd)[:n] for yd, n in pending)
-        y = np.concatenate(outs, axis=0) if outs else np.empty((0,))
+                padded, _ = meshlib.pad_batch_to_devices(chunk, mesh)
+                yield padded, n_real
+
+        with guard:
+            y = self._dispatch_windowed(
+                chunks(), apply_fn, params,
+                put=lambda a: meshlib.shard_batch(a, mesh),
+                read=lambda yd, m: np.asarray(yd)[:m])
 
         if y.ndim == 1:
             return df.withColumn(self.getOutputCol(), y)
@@ -349,29 +340,94 @@ class TpuModel(Transformer):
         return df.withColumn(self.getOutputCol(), object_column(y))
 
     def _transform_multihost(self, x, mesh, apply_fn, params) -> np.ndarray:
-        """One synchronized global inference call over every process's local
-        shard. Local rows pad to the all-process max (miniBatchSize does not
-        apply — whole-shard batching keeps call counts identical)."""
+        """Fleet-synchronized CHUNKED inference over every process's local
+        shard. The fleet agrees ONCE (allgather) on the chunk count — the
+        max over processes at miniBatchSize rows per chunk — then every
+        process makes that many identical-shape global calls in lockstep,
+        short shards contributing zero-padded dummy chunks (the fitStream
+        drain pattern). Bounds HBM at ~window * miniBatchSize per process
+        where the previous whole-shard dispatch scaled with shard size;
+        a windowed pending queue overlaps transfer with compute like the
+        single-host path."""
         from jax.experimental import multihost_utils
 
         from ..parallel import mesh as meshlib
-        padded, n = meshlib.pad_batch_to_local_devices(x, mesh)
-        target = int(multihost_utils.process_allgather(
-            np.asarray(len(padded))).max())
-        if target == 0:
+
+        per_proc = mesh.shape["data"] // meshlib.effective_process_count()
+        # fixed per-process chunk length: miniBatchSize rounded up to the
+        # local share of the data axis — ONE compiled shape for the loop
+        bs = max(self.getMiniBatchSize(), per_proc)
+        bs = -(-bs // per_proc) * per_proc
+        n = len(x)
+        # chunk count AND row layout agreed fleet-wide in one allgather: a
+        # zero-row shard cannot know the feature shape/dtype, so it adopts
+        # a peer's to build its dummy chunks (dims padded into a fixed-size
+        # int vector; last slot is a dtype code)
+        import ml_dtypes
+        dtypes = [np.dtype(np.float32), np.dtype(np.int32),
+                  np.dtype(np.uint8), np.dtype(ml_dtypes.bfloat16)]
+        meta = np.full(10, -1, np.int64)
+        meta[0] = -(-n // bs)
+        if n > 0:
+            meta[1] = x.ndim - 1
+            meta[2:2 + x.ndim - 1] = x.shape[1:]
+            meta[-1] = dtypes.index(np.dtype(x.dtype))
+        gathered = multihost_utils.process_allgather(meta)
+        n_chunks = int(gathered[:, 0].max())
+        if n_chunks == 0:
             return np.empty((0,))
-        if len(padded) < target:  # extend with dummy rows to the global max
-            filler = np.zeros((target - len(padded),) + padded.shape[1:],
-                              padded.dtype)
-            padded = np.concatenate([padded, filler], axis=0)
-        xb = meshlib.put_global_batch(padded, mesh)
-        if self._is_moe():
-            wb = np.zeros(len(padded), dtype=np.float32)
-            wb[:n] = 1.0
-            yd = apply_fn(params, xb, meshlib.put_global_batch(wb, mesh))
-        else:
-            yd = apply_fn(params, xb)
-        return meshlib.local_rows(yd, n)
+        if n == 0:
+            rows = gathered[gathered[:, 1] >= 0]
+            if not len(rows):       # every shard empty yet chunks > 0
+                return np.empty((0,))
+            rank = int(rows[0, 1])
+            x = np.zeros((0,) + tuple(int(d) for d in
+                                      rows[0, 2:2 + rank]),
+                         dtypes[int(rows[0, -1])])
+
+        shape_tail = x.shape[1:]
+
+        def chunks():
+            for k in range(n_chunks):
+                chunk = x[k * bs:(k + 1) * bs]
+                n_real = len(chunk)    # 0 for a drained shard's dummy chunk
+                if n_real < bs:
+                    filler = np.zeros((bs - n_real,) + shape_tail, x.dtype)
+                    chunk = (np.concatenate([chunk, filler])
+                             if n_real else filler)
+                yield chunk, n_real
+
+        return self._dispatch_windowed(
+            chunks(), apply_fn, params,
+            put=lambda a: meshlib.put_global_batch(a, mesh),
+            read=meshlib.local_rows)
+
+    def _dispatch_windowed(self, chunks, apply_fn, params, put, read,
+                           window: int = 2) -> np.ndarray:
+        """Shared dispatch loop for both scoring paths: each (padded_chunk,
+        n_real) ships via ``put`` and runs, with a small in-flight window —
+        JAX async dispatch overlaps the next chunk's host transfer with
+        compute while finished results drain through ``read`` — so HBM
+        residency stays ~window * miniBatchSize instead of the dataset.
+        MoE models get a per-row weight vector zeroing the padding so dummy
+        rows never claim expert capacity."""
+        pending: list = []
+        outs: list = []
+        for chunk, n_real in chunks:
+            xb = put(chunk)
+            if self._is_moe():
+                wb = np.zeros(len(chunk), dtype=np.float32)
+                wb[:n_real] = 1.0
+                yd = apply_fn(params, xb, put(wb))
+            else:
+                yd = apply_fn(params, xb)
+            pending.append((yd, n_real))
+            if len(pending) > window:
+                done, m = pending.pop(0)
+                outs.append(read(done, m))
+        outs.extend(read(yd, m) for yd, m in pending)
+        return (np.concatenate(outs, axis=0) if outs
+                else np.empty((0,)))
 
     def saveModel(self, path: str):
         """Persist {config.json, params.msgpack} (ModelDownloader layout)."""
