@@ -134,6 +134,151 @@ def bin_data(x: np.ndarray, edges: np.ndarray,
     return out
 
 
+#: rows per device binning slab — one compiled shape, ~112 MB f32 at d=28
+_BIN_SLAB = 1 << 20
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "n_edges"))
+def _bin_slab_device(xs, edges_t, cat_mask, *, max_bin: int, n_edges: int):
+    """(m, d) f32 -> (m, d) uint8 on device. Vectorized lower-bound binary
+    search over each feature's edges (8 gather/compare rounds for 255
+    edges) — O(m*d) live memory, never the (m, d, bins) broadcast; exact
+    searchsorted(side='left') semantics including ties and NaN->0."""
+    lo = jnp.zeros(xs.shape, jnp.int32)
+    hi = jnp.full(xs.shape, n_edges, jnp.int32)
+    for _ in range(max(1, int(np.ceil(np.log2(n_edges + 1))))):
+        active = lo < hi           # converged lanes must not move again
+        mid = (lo + hi) // 2
+        emid = jnp.take_along_axis(
+            edges_t, jnp.clip(mid, 0, n_edges - 1), axis=0)
+        right = (emid < xs) & active   # edge < x -> answer right of mid
+        lo = jnp.where(right, mid + 1, lo)
+        hi = jnp.where(active & ~right, mid, hi)
+    out = lo.astype(jnp.uint8)
+    catv = jnp.clip(jnp.nan_to_num(xs), 0, max_bin - 1).astype(jnp.uint8)
+    out = jnp.where(cat_mask[None, :], catv, out)
+    return jnp.where(jnp.isnan(xs), jnp.uint8(0), out)
+
+
+def bin_data_device(x: np.ndarray, edges: np.ndarray,
+                    cat_features: Optional[np.ndarray] = None,
+                    max_bin: int = 256,
+                    slab: int = _BIN_SLAB) -> np.ndarray:
+    """``bin_data`` computed ON DEVICE in fixed-shape slabs: the host loop
+    was ~15 s of the 10M-row fit's fixed cost (BASELINE.md) while the
+    edges are tiny and the rows stream to HBM anyway. A 2-deep pending
+    window lets JAX async dispatch overlap slab upload with compute; the
+    result returns as the uint8 wire matrix."""
+    n, d = x.shape
+    edges_t = jnp.asarray(np.ascontiguousarray(edges.T))
+    cat = jnp.asarray(cat_features if cat_features is not None
+                      else np.zeros(d, bool))
+    n_edges = int(edges.shape[1])
+    out = np.empty((n, d), dtype=np.uint8)
+    pending: list = []
+
+    def drain(entry):
+        start, m, yd = entry
+        out[start:start + m] = np.asarray(yd)[:m]
+
+    for start in range(0, n, slab):
+        xs = np.ascontiguousarray(x[start:start + slab], dtype=np.float32)
+        m = len(xs)
+        # pad EVERY partial slab to a power-of-two bucket (capped at the
+        # slab) so varying row counts reuse a handful of compiled shapes
+        # instead of paying an XLA compile per distinct tail
+        target = min(1 << max(0, int(np.ceil(np.log2(max(m, 1))))), slab)
+        if m < target:
+            xs = np.concatenate(
+                [xs, np.zeros((target - m, d), np.float32)])
+        yd = _bin_slab_device(jnp.asarray(xs), edges_t, cat,
+                              max_bin=max_bin, n_edges=n_edges)
+        pending.append((start, m, yd))
+        if len(pending) > 2:
+            drain(pending.pop(0))
+    for entry in pending:
+        drain(entry)
+    return out
+
+
+#: rows*features above which device binning is worth CONSIDERING (below,
+#: dispatch overhead dominates and the host loop is instant anyway)
+_DEVICE_BIN_MIN_ELEMS = 2_000_000
+
+#: measured single-core numpy searchsorted cost (~75-80 ns/element on this
+#: class of host; 10M x 28 took 21.5 s)
+_HOST_BIN_NS_PER_ELEM = 77.0
+
+#: cached auto-binning verdict ([] = unmeasured; [True] = device wins)
+_device_bin_verdict: list = []
+
+
+def bin_data_auto(x: np.ndarray, edges: np.ndarray,
+                  cat_features: Optional[np.ndarray] = None,
+                  max_bin: int = 256) -> np.ndarray:
+    """Pick the binning backend by MEASURED cost: run the first device
+    slab and time it end-to-end (upload + compute + uint8 readback); if
+    it beats the host loop's ~77 ns/element, the remaining slabs stay on
+    device, otherwise they run on host. Device binning uploads f32 — 4x
+    the uint8 wire — so over a thin tunnel (~25 MB/s axon) it loses to
+    the host loop while on a TPU-VM DMA path it wins by 10x+; a synthetic
+    bandwidth probe mispredicts tunnels that buffer small transfers, so
+    the decision times the real workload (its result is kept either way).
+    MMLTPU_GBDT_BINNING=host|device overrides; any device error falls
+    back to host — binning must never fail a fit."""
+    import os
+    import time
+    mode = os.environ.get("MMLTPU_GBDT_BINNING", "auto")
+    if mode not in ("auto", "host", "device"):
+        raise ValueError(f"MMLTPU_GBDT_BINNING must be auto|host|device, "
+                         f"got {mode!r}")
+    n, d = x.shape
+    if mode == "host" or (mode == "auto" and n * d < _DEVICE_BIN_MIN_ELEMS):
+        return bin_data(x, edges, cat_features, max_bin)
+    try:
+        if mode == "device":
+            return bin_data_device(x, edges, cat_features, max_bin)
+        if _device_bin_verdict and not _device_bin_verdict[0]:
+            return bin_data(x, edges, cat_features, max_bin)
+
+        def timed_slab(lo_i, hi_i):
+            t0 = time.perf_counter()
+            part = bin_data_device(x[lo_i:hi_i], edges, cat_features,
+                                   max_bin)
+            ns = (time.perf_counter() - t0) * 1e9 / ((hi_i - lo_i) * d)
+            return part, ns
+
+        first = min(_BIN_SLAB, n)
+        head, dev_ns = timed_slab(0, first)
+        pieces = [head]
+        done = first
+        if dev_ns > _HOST_BIN_NS_PER_ELEM and done < n:
+            # the first call may be compile-tainted; a losing verdict is
+            # only CACHED after a warm same-shape re-measure (a DMA host
+            # must not get pinned to the host loop by one jit compile)
+            second = min(done + _BIN_SLAB, n)
+            part, dev_ns = timed_slab(done, second)
+            pieces.append(part)
+            done = second
+        if first == _BIN_SLAB:   # sub-slab trials are dispatch-dominated
+            _device_bin_verdict.clear()
+            _device_bin_verdict.append(dev_ns <= _HOST_BIN_NS_PER_ELEM)
+        if done < n:
+            if dev_ns <= _HOST_BIN_NS_PER_ELEM:
+                pieces.append(bin_data_device(x[done:], edges,
+                                              cat_features, max_bin))
+            else:
+                pieces.append(bin_data(x[done:], edges, cat_features,
+                                       max_bin))
+        return (pieces[0] if len(pieces) == 1
+                else np.concatenate(pieces, axis=0))
+    except Exception as e:       # never let an accelerator hiccup fail a fit
+        from ...core.utils import get_logger
+        get_logger("gbdt").warning(
+            "device binning failed (%s); falling back to host", e)
+        return bin_data(x, edges, cat_features, max_bin)
+
+
 # ------------------------------------------------------------- tree builder
 
 def _histograms(bins, g, h, node, n_nodes: int, n_bins: int,
@@ -538,7 +683,8 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     else:
         edges = compute_bin_edges(x[real], p.max_bin)
         base_global = None
-    bins = bin_data(x, edges, cat_arr if cat_arr.any() else None, p.max_bin)
+    bins = bin_data_auto(x, edges, cat_arr if cat_arr.any() else None,
+                         p.max_bin)
     d_pad = d
     if tree_learner == "feature":
         # pad the feature axis to a device multiple; padded columns carry
@@ -613,7 +759,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         sample_weight = (holdout if sample_weight is None
                          else sample_weight * holdout)
     if eval_set is not None:
-        bins_val = jnp.asarray(bin_data(
+        bins_val = jnp.asarray(bin_data_auto(
             np.asarray(eval_set[0], dtype=np.float32), edges,
             cat_arr if cat_arr.any() else None, p.max_bin))
         y_val = jnp.asarray(np.asarray(eval_set[1], dtype=np.float32))
@@ -756,12 +902,12 @@ def predict_raw(ens, x: np.ndarray,
     leafwise.LeafwiseEnsemble."""
     from .leafwise import LeafwiseEnsemble, predict_raw_lw
     if isinstance(ens, LeafwiseEnsemble):
-        bins = jnp.asarray(bin_data(
+        bins = jnp.asarray(bin_data_auto(
             x, ens.bin_edges,
             ens.cat_features if ens.cat_features.any() else None,
             ens.bin_edges.shape[1] + 1))
         return predict_raw_lw(ens, bins, num_iteration)
-    bins = jnp.asarray(bin_data(x, ens.bin_edges))
+    bins = jnp.asarray(bin_data_auto(x, ens.bin_edges))
     T, K, _ = ens.feature.shape
     depth = int(np.log2(ens.leaf.shape[2]))
     T = min(T, num_iteration) if num_iteration else T

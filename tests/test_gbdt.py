@@ -643,3 +643,71 @@ class TestFeatureImportances:
         # the model separates the classes via tail features, so tail credit
         # should not be a rounding error next to dense-noise credit
         assert sig_total >= imp[:64].sum() * 0.1, imp[:64].sum()
+
+
+class TestDeviceBinning:
+    """bin_data_device must be bit-identical to the host searchsorted loop
+    (it feeds the same uint8 wire) across ties, NaN, categoricals, and
+    slab boundaries."""
+
+    def _edges(self, rng, d, n_edges):
+        e = np.sort(rng.normal(size=(d, n_edges)).astype(np.float32), axis=1)
+        e[0, :] = 0.0            # all-tied edges: searchsorted tie semantics
+        return np.ascontiguousarray(e)
+
+    def test_parity_with_host(self):
+        from mmlspark_tpu.models.gbdt.engine import bin_data, bin_data_device
+        rng = np.random.default_rng(0)
+        n, d = 5000, 7
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        edges = self._edges(rng, d, 30)
+        x[::11, 2] = np.nan                      # NaN -> bin 0
+        x[::7, 3] = edges[3, 4]                  # exact tie with an edge
+        x[:, 5] = np.round(np.abs(x[:, 5]) * 9)  # categorical codes
+        cat = np.zeros(d, bool)
+        cat[5] = True
+        host = bin_data(x, edges, cat, 31)
+        dev = bin_data_device(x, edges, cat, 31)
+        np.testing.assert_array_equal(dev, host)
+
+    def test_slab_boundary_and_auto(self):
+        from mmlspark_tpu.models.gbdt import engine
+        rng = np.random.default_rng(1)
+        n, d = 2050, 3                    # spans 3 slabs at slab=1024
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        edges = self._edges(rng, d, 15)
+        host = engine.bin_data(x, edges, None, 16)
+        dev = engine.bin_data_device(x, edges, None, 16, slab=1024)
+        np.testing.assert_array_equal(dev, host)
+        # auto picks host below the threshold but must agree either way
+        np.testing.assert_array_equal(
+            engine.bin_data_auto(x, edges, None, 16), host)
+
+    def test_big_fit_uses_device_path_and_matches(self, monkeypatch):
+        """A fit above the element threshold routes through the device
+        binner; force the threshold down and check the fitted model equals
+        the host-binned fit exactly."""
+        from mmlspark_tpu.models.gbdt import engine
+        rng = np.random.default_rng(2)
+        n, d = 4000, 6
+        x = rng.normal(size=(n, d)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.float32)
+        p = engine.GBDTParams(num_iterations=5, max_depth=3,
+                              objective="binary")
+        calls = {"device": 0}
+        real = engine.bin_data_device
+
+        def spy(*a, **k):
+            calls["device"] += 1
+            return real(*a, **k)
+        monkeypatch.setattr(engine, "bin_data_device", spy)
+        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_ELEMS", 1000)
+        monkeypatch.setattr(engine, "_device_bin_verdict", [])
+        ens_dev = engine.fit_gbdt(x, y, p)
+        assert calls["device"] >= 1
+        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_ELEMS", 10**18)
+        ens_host = engine.fit_gbdt(x, y, p)
+        np.testing.assert_array_equal(np.asarray(ens_dev.leaf),
+                                      np.asarray(ens_host.leaf))
+        np.testing.assert_array_equal(np.asarray(ens_dev.feature),
+                                      np.asarray(ens_host.feature))
