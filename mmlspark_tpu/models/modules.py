@@ -363,6 +363,11 @@ class TransformerEncoder(nn.Module):
     (jax.checkpoint): activation memory drops from O(layers*T) to O(T) at
     ~1/3 extra FLOPs — the standard long-context trade.
 
+    TPU sizing note: pick ``d_model/heads`` (head_dim) = 128 where model
+    quality allows — the MXU contracts 128-deep, so head_dim 64 runs the
+    attention matmuls at roughly half rate (measured: BASELINE.md round-4
+    flash-attention row; the deficit is structural, not a kernel issue).
+
     Input: int32 token ids (B, T). Output: (B, num_classes) when
     ``pool='mean'``, else per-token (B, T, num_classes).
     """
