@@ -184,6 +184,23 @@ def make_shapes10(n: int, size: int = 32, num_classes: int = 10,
     return x, y.astype(np.int64)
 
 
+def _load_digit_scans(classes):
+    """(imgs (n,8,8) float 0..16, labels relabeled 0..len(classes)-1)."""
+    from sklearn.datasets import load_digits
+    d = load_digits()
+    keep = np.isin(d.target, classes)
+    remap = {c: i for i, c in enumerate(classes)}
+    y = np.array([remap[int(t)] for t in d.target[keep]], np.int64)
+    return d.images[keep], y
+
+
+def _scans_to_rgb32(batch8):
+    """(m, 8, 8) float 0..16 -> (m, 32, 32, 3) uint8 (x4 nearest)."""
+    x = np.kron(batch8, np.ones((4, 4)))
+    x = np.clip(x * (255.0 / 16.0), 0, 255).astype(np.uint8)
+    return np.repeat(x[..., None], 3, axis=-1)
+
+
 def digits_rgb32(classes=tuple(range(8))):
     """REAL image data: sklearn's bundled UCI handwritten-digits corpus
     (1,797 scanned 8x8 digits) as 32x32x3 uint8 + labels, restricted to
@@ -191,15 +208,66 @@ def digits_rgb32(classes=tuple(range(8))):
     classes 0-7; 8/9 stay held out so transfer examples (e303) have a
     genuinely unseen real downstream task. The only real-image corpus a
     zero-egress environment ships."""
-    from sklearn.datasets import load_digits
-    d = load_digits()
-    keep = np.isin(d.target, classes)
-    imgs = d.images[keep]                     # (n, 8, 8) float 0..16
-    remap = {c: i for i, c in enumerate(classes)}
-    y = np.array([remap[int(t)] for t in d.target[keep]], np.int64)
-    x = np.kron(imgs, np.ones((4, 4)))        # 8x8 -> 32x32 nearest
-    x = np.clip(x * (255.0 / 16.0), 0, 255).astype(np.uint8)
-    return np.repeat(x[..., None], 3, axis=-1), y
+    imgs, y = _load_digit_scans(classes)
+    return _scans_to_rgb32(imgs), y
+
+
+def digits_rgb32_augmented(total: int = 50_000, test_fraction: float = 0.15,
+                           seed: int = 0, classes=tuple(range(10))):
+    """The richest REAL 32x32 training corpus a zero-egress image ships:
+    all 10 classes of sklearn's UCI digit scans, split train/test at the
+    ORIGINAL-scan level (the held-out set is untouched originals — no
+    augmented twin of a test scan ever enters training), then the train
+    scans augmented to ``total`` rows with label-preserving transforms at
+    the native 8x8 resolution (rotation +-12deg, +-1px shifts, 0.9-1.1
+    zoom) before the x4 upscale, plus brightness/contrast jitter and
+    sensor-ish noise at 32x32. Returns (x_train, y_train, x_test, y_test)
+    as (n, 32, 32, 3) uint8 / int64."""
+    from scipy import ndimage
+    from sklearn.model_selection import train_test_split
+
+    imgs, y = _load_digit_scans(classes)
+    tr_i, te_i = train_test_split(np.arange(len(y)),
+                                  test_size=test_fraction, random_state=seed,
+                                  stratify=y)
+    rng = np.random.default_rng(seed)
+    base, yb = imgs[tr_i], y[tr_i]
+    reps = -(-total // len(base))
+    out = np.empty((reps * len(base), 8, 8), np.float32)
+    for r in range(reps):
+        for i, img in enumerate(base):
+            a = img
+            if r:                              # rep 0 keeps the originals
+                a = ndimage.rotate(a, rng.uniform(-12, 12), reshape=False,
+                                   order=1, mode="nearest")
+                z = rng.uniform(0.9, 1.1)
+                a = ndimage.zoom(a, z, order=1)
+                if a.shape[0] >= 8:
+                    o = (a.shape[0] - 8) // 2
+                    a = a[o:o + 8, o:o + 8]
+                else:
+                    p = 8 - a.shape[0]
+                    a = np.pad(a, ((p // 2, p - p // 2),) * 2,
+                               mode="edge")
+                a = ndimage.shift(a, rng.integers(-1, 2, size=2), order=0,
+                                  mode="constant")
+            out[r * len(base) + i] = a
+    order = rng.permutation(reps * len(base))[:total]
+    ya = np.tile(yb, reps)[order]
+    # jitter/noise chunked in float32: one full-corpus float64 temporary
+    # would peak multiple GB at total=50k on a small CI container
+    xa = np.empty((total, 32, 32, 3), np.uint8)
+    chunk = 8192
+    for lo in range(0, total, chunk):
+        part = _scans_to_rgb32(out[order[lo:lo + chunk]]) \
+            .astype(np.float32)
+        m = len(part)
+        jitter = rng.uniform(0.85, 1.15, (m, 1, 1, 1)).astype(np.float32)
+        shift = rng.uniform(-12, 12, (m, 1, 1, 1)).astype(np.float32)
+        noise = rng.normal(0, 4.0, part.shape).astype(np.float32)
+        xa[lo:lo + m] = np.clip(part * jitter + shift + noise,
+                                0, 255).astype(np.uint8)
+    return xa, ya, _scans_to_rgb32(imgs[te_i]), y[te_i]
 
 
 def census_pandas(n: int = 400, seed: int = 0):

@@ -591,3 +591,23 @@ def test_two_process_parallel_tuning(tmp_path):
     assert all("TUNE_WORKER_OK" in o for o in outs)
     picks = {o.strip().splitlines()[-1] for o in outs}
     assert len(picks) == 1, picks
+
+
+@pytest.mark.extended
+def test_three_process_gbdt_fit(tmp_path):
+    """Distributed boosting at THREE processes with uneven shards
+    (400/550/700 rows): histogram psums span an odd-sized process axis
+    and every worker must still end with the identical model."""
+    outs = _spawn_fleet(tmp_path, _GBDT_WORKER, nprocs=3, timeout=420)
+    assert all("GBDT_WORKER_OK" in o for o in outs)
+
+
+@pytest.mark.extended
+def test_four_process_dataplane(tmp_path):
+    """Relational ops + shard-aware estimator fits across a FOUR-process
+    fleet (uneven 40/50/60/70-row shards, differing key-level sets) match
+    the plain-global results — the allgather merges and broadcast joins
+    at a fleet size with a genuinely partial key overlap per shard."""
+    outs = _spawn_fleet(tmp_path, _WORKER, nprocs=4, devices_per_proc=1,
+                        timeout=420)
+    assert all("DATAPLANE_WORKER_OK" in o for o in outs)

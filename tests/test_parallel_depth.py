@@ -940,13 +940,17 @@ def batches_fn():
         for s in range(3):
             yield xs[s * 8:(s + 1) * 8], ys[s * 8:(s + 1) * 8]
     else:
-        # process p streams its half of each global batch, so global step
-        # s assembles exactly the solo run's batch s; a positive SHORTFALL
-        # makes process 1's stream shorter (3 = empty from the start) and
-        # rides the zero-weight dummy path while process 0 drains
-        for s in range(3 - pid * SHORTFALL):
-            lo = s * 8 + pid * 4
-            yield xs[lo:lo + 4], ys[lo:lo + 4]
+        # process p streams its 1/nproc slice of each global batch, so
+        # global step s assembles exactly the solo run's batch s; a
+        # positive SHORTFALL staggers stream lengths BY PROCESS RANK
+        # (3 - pid*SHORTFALL batches), so higher ranks drain earlier and
+        # ride the zero-weight dummy path while lower ranks finish — at
+        # nproc 4 / SHORTFALL 1 that is a 3/2/1/0 four-way drain order
+        # including one stream that is empty from the start
+        per = 8 // nproc
+        for s in range(max(0, 3 - pid * SHORTFALL)):
+            lo = s * 8 + pid * per
+            yield xs[lo:lo + per], ys[lo:lo + per]
 
 model = (TpuLearner()
          .setModelConfig({'type': 'mlp', 'hidden': [8], 'num_classes': 2})
@@ -1060,3 +1064,30 @@ def test_multihost_chunked_scoring(tmp_path):
     from tests.test_dataplane import _spawn_fleet
     outs = _spawn_fleet(tmp_path, _CHUNKED_SCORING_WORKER, timeout=300)
     assert all("CHUNKED_SCORING_OK" in o for o in outs)
+
+
+# ----------------------------------------------------- N>2 fleet coverage
+
+@pytest.mark.extended
+def test_trainer_four_process_dp_tp(tmp_path):
+    """Every fleet invariant so far is proven at the minimal fleet size;
+    this runs the strongest trainer claim at FOUR processes x 2 local
+    devices (dp=4 across hosts, tp=2 local): the 4-process digest must be
+    identical everywhere AND equal the solo fit on the same logical
+    8-device mesh."""
+    fleet, solo = _run_digest_fleet(tmp_path, "tp4", _TP_WORKER,
+                                    "TP_WORKER_OK", nprocs=4, devs=2)
+    assert len(set(fleet)) == 1, fleet
+    assert solo == fleet[0], (solo, fleet)
+
+
+@pytest.mark.extended
+def test_fitstream_four_process_staggered_drain(tmp_path):
+    """fitStream at 4 processes with stream lengths 3/2/1/0: a four-way
+    drain order (each step one more process rides zero-weight dummies,
+    one stream empty from the start) — the lockstep bucketing corner the
+    2-process tests cannot reach. All four digests must agree."""
+    fleet, _ = _run_digest_fleet(
+        tmp_path, "stream4", _STREAM_WORKER.replace("{SHORTFALL}", "1"),
+        "STREAM_WORKER_OK", nprocs=4, devs=1, solo=False)
+    assert len(set(fleet)) == 1, fleet
