@@ -18,8 +18,13 @@ itself validates for its own Params subclasses and is not supported.
     crossed columnar instead of per-row).
   * ``fit`` collects the (driver-sized, as in the reference's own
     estimators) dataset to the driver as Arrow, fits the TPU-native
-    estimator there, and returns the fitted model re-wrapped for Spark.
+    estimator there, and returns the fitted model re-wrapped for Spark —
+    or, via :func:`wrapDistributed`, runs as a barrier-stage job where
+    every partition joins the JAX coordination service and the
+    collective fit spans the executors (see ``spark/distributed.py``).
   * ``readImages(spark, path)`` mirrors the reference's reader implicit.
+  * ``spark/streaming.py`` serves HTTP through a Spark-driven micro-batch
+    loop over the worker-process fleet (the §3.5 readStream workflow).
 
 pyspark is NOT a dependency of the framework — everything here imports it
 lazily and raises a clear error when absent. The wrappers hold the
