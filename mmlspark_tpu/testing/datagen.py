@@ -270,6 +270,52 @@ def digits_rgb32_augmented(total: int = 50_000, test_fraction: float = 0.15,
     return xa, ya, _scans_to_rgb32(imgs[te_i]), y[te_i]
 
 
+def make_torchvision_state(depths=(3, 4, 6, 3),
+                           widths=(256, 512, 1024, 2048),
+                           num_classes: int = 1000, seed: int = 1,
+                           conv_scale: float = 0.05) -> dict:
+    """A synthetic checkpoint in torchvision's ResNet state-dict LAYOUT
+    (conv1/bn1/layer{L}.{B}.conv*/bn*/downsample/fc keys, torch OIHW conv
+    shapes, BN running stats) — the single source for exercising
+    ``models.import_weights.import_resnet50`` in tests and examples
+    without real downloaded weights."""
+    rng = np.random.default_rng(seed)
+
+    def conv(o, i, k):
+        return (rng.normal(size=(o, i, k, k)) * conv_scale).astype(np.float32)
+
+    def bn(c, prefix, state):
+        state[f"{prefix}.weight"] = np.abs(
+            rng.normal(size=c).astype(np.float32)) + 0.5
+        state[f"{prefix}.bias"] = rng.normal(size=c).astype(np.float32) * .1
+        state[f"{prefix}.running_mean"] = rng.normal(
+            size=c).astype(np.float32) * .1
+        state[f"{prefix}.running_var"] = np.abs(
+            rng.normal(size=c).astype(np.float32)) + 1.0
+        state[f"{prefix}.num_batches_tracked"] = np.array(1, np.int64)
+
+    state = {"conv1.weight": conv(widths[0] // 4, 3, 7)}
+    bn(widths[0] // 4, "bn1", state)
+    cin = widths[0] // 4
+    for li, (w, d) in enumerate(zip(widths, depths), start=1):
+        for b in range(d):
+            t = f"layer{li}.{b}"
+            state[f"{t}.conv1.weight"] = conv(w // 4, cin, 1)
+            bn(w // 4, f"{t}.bn1", state)
+            state[f"{t}.conv2.weight"] = conv(w // 4, w // 4, 3)
+            bn(w // 4, f"{t}.bn2", state)
+            state[f"{t}.conv3.weight"] = conv(w, w // 4, 1)
+            bn(w, f"{t}.bn3", state)
+            if b == 0:
+                state[f"{t}.downsample.0.weight"] = conv(w, cin, 1)
+                bn(w, f"{t}.downsample.1", state)
+            cin = w
+    state["fc.weight"] = rng.normal(size=(num_classes, cin)).astype(
+        np.float32) * 0.01
+    state["fc.bias"] = np.zeros(num_classes, np.float32)
+    return state
+
+
 def census_pandas(n: int = 400, seed: int = 0):
     """The notebook-101 census-shaped frame as pandas (shared by the
     example/notebook/spark-adapter copies of the 101 story: mixed

@@ -153,42 +153,10 @@ def test_full_resnet50_shapes_and_featurize(tmp_path):
                                                     import_resnet50)
     from mmlspark_tpu.models.modules import build_model
 
+    from mmlspark_tpu.testing.datagen import make_torchvision_state
     rng = np.random.default_rng(1)
-
-    def conv(o, i, k):
-        return (rng.normal(size=(o, i, k, k)) * 0.05).astype(np.float32)
-
-    def bn(c, prefix, state):
-        state[f"{prefix}.weight"] = np.abs(
-            rng.normal(size=c).astype(np.float32)) + 0.5
-        state[f"{prefix}.bias"] = rng.normal(size=c).astype(np.float32) * .1
-        state[f"{prefix}.running_mean"] = rng.normal(
-            size=c).astype(np.float32) * .1
-        state[f"{prefix}.running_var"] = np.abs(
-            rng.normal(size=c).astype(np.float32)) + 1.0
-        state[f"{prefix}.num_batches_tracked"] = np.array(1, np.int64)
-
-    state = {"conv1.weight": conv(64, 3, 7)}
-    bn(64, "bn1", state)
-    widths, cin = [256, 512, 1024, 2048], 64
-    for li, (w, d) in enumerate(zip(widths, RESNET_DEPTHS["resnet50"]),
-                                start=1):
-        inner = w // 4
-        for b in range(d):
-            t = f"layer{li}.{b}"
-            state[f"{t}.conv1.weight"] = conv(inner, cin, 1)
-            bn(inner, f"{t}.bn1", state)
-            state[f"{t}.conv2.weight"] = conv(inner, inner, 3)
-            bn(inner, f"{t}.bn2", state)
-            state[f"{t}.conv3.weight"] = conv(w, inner, 1)
-            bn(w, f"{t}.bn3", state)
-            if b == 0:
-                state[f"{t}.downsample.0.weight"] = conv(w, cin, 1)
-                bn(w, f"{t}.downsample.1", state)
-            cin = w
-    state["fc.weight"] = rng.normal(size=(1000, 2048)).astype(
-        np.float32) * 0.01
-    state["fc.bias"] = np.zeros(1000, np.float32)
+    state = make_torchvision_state(RESNET_DEPTHS["resnet50"],
+                                   [256, 512, 1024, 2048], seed=1)
 
     st_path = tmp_path / "rn50.safetensors"
     save_file({k: v for k, v in state.items()}, str(st_path))
