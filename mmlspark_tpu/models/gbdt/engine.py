@@ -201,16 +201,21 @@ def bin_data_device(x: np.ndarray, edges: np.ndarray,
     return out
 
 
-#: rows*features above which device binning is worth CONSIDERING (below,
-#: dispatch overhead dominates and the host loop is instant anyway)
-_DEVICE_BIN_MIN_ELEMS = 2_000_000
-
 #: measured single-core numpy searchsorted cost (~75-80 ns/element on this
 #: class of host; 10M x 28 took 21.5 s)
 _HOST_BIN_NS_PER_ELEM = 77.0
 
 #: cached auto-binning verdict ([] = unmeasured; [True] = device wins)
 _device_bin_verdict: list = []
+
+#: only consider the device binner for datasets at least this large in
+#: f32 bytes. Two reasons: below it the host loop is fast anyway, and a
+#: trustworthy bandwidth measurement needs a transfer LARGER than the
+#: link's burst buffering — the axon tunnel moves ~14 MB at 60+ MB/s but
+#: sustains only ~25 MB/s, so sub-slab trials flatter the device path
+#: (measured round 4: a 131k-row trial said "device wins" and the 1M-row
+#: fit then paid 4.5 s/fit for it)
+_DEVICE_BIN_MIN_BYTES = 96 << 20
 
 
 def bin_data_auto(x: np.ndarray, edges: np.ndarray,
@@ -233,36 +238,46 @@ def bin_data_auto(x: np.ndarray, edges: np.ndarray,
         raise ValueError(f"MMLTPU_GBDT_BINNING must be auto|host|device, "
                          f"got {mode!r}")
     n, d = x.shape
-    if mode == "host" or (mode == "auto" and n * d < _DEVICE_BIN_MIN_ELEMS):
+    if mode == "host" or (mode == "auto"
+                          and n * d * 4 < _DEVICE_BIN_MIN_BYTES):
         return bin_data(x, edges, cat_features, max_bin)
     try:
         if mode == "device":
             return bin_data_device(x, edges, cat_features, max_bin)
-        if _device_bin_verdict and not _device_bin_verdict[0]:
+        if _device_bin_verdict:
+            if _device_bin_verdict[0]:
+                return bin_data_device(x, edges, cat_features, max_bin)
             return bin_data(x, edges, cat_features, max_bin)
 
         def timed_slab(lo_i, hi_i):
             t0 = time.perf_counter()
             part = bin_data_device(x[lo_i:hi_i], edges, cat_features,
-                                   max_bin)
+                                   max_bin)   # np.asarray inside = real sync
             ns = (time.perf_counter() - t0) * 1e9 / ((hi_i - lo_i) * d)
             return part, ns
 
-        first = min(_BIN_SLAB, n)
-        head, dev_ns = timed_slab(0, first)
+        # the trial is sized in BYTES, not rows: it must exceed the
+        # link's burst buffering (~tens of MB on the axon tunnel) to see
+        # SUSTAINED bandwidth, whatever the feature width. The 96 MB
+        # dataset gate guarantees a >= 64 MB trial always fits.
+        trial = min(n, -(-(64 << 20) // (4 * d)))
+        head, dev_ns = timed_slab(0, trial)
         pieces = [head]
-        done = first
-        if dev_ns > _HOST_BIN_NS_PER_ELEM and done < n:
-            # the first call may be compile-tainted; a losing verdict is
-            # only CACHED after a warm same-shape re-measure (a DMA host
-            # must not get pinned to the host loop by one jit compile)
-            second = min(done + _BIN_SLAB, n)
+        done = trial
+        if dev_ns > _HOST_BIN_NS_PER_ELEM and (n - done) * d * 4 >= 32 << 20:
+            # the first call may be compile-tainted; re-measure WARM on a
+            # still-sustained-scale chunk before caching a loss (a DMA
+            # host must not get pinned to the host loop by one compile).
+            # When the remainder is too small to re-measure honestly the
+            # loss is cached as-is — the persistent XLA cache makes
+            # compile taint a first-process-ever event, and
+            # MMLTPU_GBDT_BINNING=device overrides a wrong pin
+            second = min(done + trial, n)
             part, dev_ns = timed_slab(done, second)
             pieces.append(part)
             done = second
-        if first == _BIN_SLAB:   # sub-slab trials are dispatch-dominated
-            _device_bin_verdict.clear()
-            _device_bin_verdict.append(dev_ns <= _HOST_BIN_NS_PER_ELEM)
+        _device_bin_verdict.clear()
+        _device_bin_verdict.append(dev_ns <= _HOST_BIN_NS_PER_ELEM)
         if done < n:
             if dev_ns <= _HOST_BIN_NS_PER_ELEM:
                 pieces.append(bin_data_device(x[done:], edges,

@@ -701,11 +701,11 @@ class TestDeviceBinning:
             calls["device"] += 1
             return real(*a, **k)
         monkeypatch.setattr(engine, "bin_data_device", spy)
-        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_ELEMS", 1000)
+        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_BYTES", 1000)
         monkeypatch.setattr(engine, "_device_bin_verdict", [])
         ens_dev = engine.fit_gbdt(x, y, p)
         assert calls["device"] >= 1
-        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_ELEMS", 10**18)
+        monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_BYTES", 10**18)
         ens_host = engine.fit_gbdt(x, y, p)
         np.testing.assert_array_equal(np.asarray(ens_dev.leaf),
                                       np.asarray(ens_host.leaf))
