@@ -354,12 +354,8 @@ class TpuModel(Transformer):
         from ..parallel import mesh as meshlib
 
         per_proc = mesh.shape["data"] // meshlib.effective_process_count()
-        # fixed per-process chunk length: miniBatchSize rounded up to the
-        # local share of the data axis — ONE compiled shape for the loop
-        bs = max(self.getMiniBatchSize(), per_proc)
-        bs = -(-bs // per_proc) * per_proc
         n = len(x)
-        # chunk count AND row layout agreed fleet-wide in one allgather: a
+        # shard size AND row layout agreed fleet-wide in one allgather: a
         # zero-row shard cannot know the feature shape/dtype, so it adopts
         # a peer's to build its dummy chunks (dims padded into a fixed-size
         # int vector; last slot is a dtype code)
@@ -367,15 +363,23 @@ class TpuModel(Transformer):
         dtypes = [np.dtype(np.float32), np.dtype(np.int32),
                   np.dtype(np.uint8), np.dtype(ml_dtypes.bfloat16)]
         meta = np.full(10, -1, np.int64)
-        meta[0] = -(-n // bs)
+        meta[0] = n
         if n > 0:
             meta[1] = x.ndim - 1
             meta[2:2 + x.ndim - 1] = x.shape[1:]
             meta[-1] = dtypes.index(np.dtype(x.dtype))
         gathered = multihost_utils.process_allgather(meta)
-        n_chunks = int(gathered[:, 0].max())
-        if n_chunks == 0:
+        max_n = int(gathered[:, 0].max())
+        if max_n == 0:
             return np.empty((0,))
+        # fixed per-process chunk length, identical fleet-wide (derived
+        # from gathered values only): miniBatchSize rounded to the local
+        # share of the data axis, but never beyond the fleet's LARGEST
+        # shard — a small scoring call must not pad (and compile) a full
+        # miniBatchSize of dummy rows
+        bs = max(min(self.getMiniBatchSize(), max_n), per_proc)
+        bs = -(-bs // per_proc) * per_proc
+        n_chunks = -(-max_n // bs)
         if n == 0:
             rows = gathered[gathered[:, 1] >= 0]
             if not len(rows):       # every shard empty yet chunks > 0
