@@ -15,6 +15,15 @@ difficulty calibrated against the reference's own committed metrics).
 Floors assert "our engine on this schema/difficulty clears what the
 reference committed"; exact values live in the golden CSV as the
 regression gate.
+
+Stated plainly (the honesty bar for any parity claim built on these):
+the floors accept `auc >= floor - 0.05`, matching the reference CSV's
+own one-decimal rounding — e.g. banknote passes at 0.96 against the
+reference's committed 1.0 — and the datasets are documented syntheses,
+not the real UCI downloads. So the claim these tests support is
+"meets the reference's committed metric AFTER its own rounding, on
+schema-faithful synthetic stand-ins", not a raw-number tie on the
+original corpora.
 """
 
 import os
