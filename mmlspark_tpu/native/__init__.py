@@ -73,6 +73,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fpp, ctypes.c_int, ctypes.c_int64,
         ctypes.POINTER(ctypes.c_float), ctypes.c_int]
     lib.mmltpu_interleave_f32.restype = None
+    lib.mmltpu_bin_data.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int, u8p, ctypes.c_int,
+        u8p, ctypes.c_int]
+    lib.mmltpu_bin_data.restype = None
     return lib
 
 
@@ -99,7 +104,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
                                 r.stderr[-2000:])
                     return None
             _lib = _bind(ctypes.CDLL(_SO))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError = a stale prebuilt .so missing a newer symbol
+            # (e.g. extracted with fresh mtimes so _needs_build says no):
+            # the contract is None-when-unavailable, never a crash
             log.warning("native runtime unavailable (%s), using fallbacks", e)
             _lib = None
         return _lib
@@ -258,3 +266,37 @@ def interleave_f32(cols: list, out: np.ndarray,
         threads = min(8, os.cpu_count() or 1)
     lib.mmltpu_interleave_f32(ptrs, d, n, out.ctypes.data_as(fp), threads)
     return True
+
+
+def bin_data_native(x: np.ndarray, edges: np.ndarray,
+                    cat_mask: Optional[np.ndarray] = None,
+                    max_bin: int = 256,
+                    threads: int = 0) -> Optional[np.ndarray]:
+    """GBDT quantile binning through the C++ kernel: (n, d) f32 ->
+    (n, d) uint8, bit-identical to engine.bin_data (searchsorted
+    side='left', NaN->0, categorical identity clip). Returns None when the
+    native runtime is unavailable so the caller can fall back."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    edges = np.ascontiguousarray(edges, dtype=np.float32)
+    n, d = x.shape
+    if edges.shape[0] != d:
+        raise ValueError(f"edges has {edges.shape[0]} feature rows for a "
+                         f"{d}-wide matrix")
+    out = np.empty((n, d), dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    cat_ptr = None
+    if cat_mask is not None:
+        cat_arr = np.ascontiguousarray(cat_mask, dtype=np.uint8)
+        if len(cat_arr) != d:
+            raise ValueError(f"cat_mask has {len(cat_arr)} entries for "
+                             f"{d} features")
+        cat_ptr = cat_arr.ctypes.data_as(u8p)
+    fp = ctypes.POINTER(ctypes.c_float)
+    lib.mmltpu_bin_data(x.ctypes.data_as(fp), n, d,
+                        edges.ctypes.data_as(fp), int(edges.shape[1]),
+                        cat_ptr, int(max_bin),
+                        out.ctypes.data_as(u8p), int(threads))
+    return out

@@ -60,6 +60,17 @@ int mmltpu_csv_parse(const char *path, int skip_header, char delim,
                      int n_threads, float **out, int64_t *out_rows,
                      int64_t *out_cols);
 
+// ---- GBDT binning ----
+// Quantile-bin an (n, d) row-major float32 matrix into uint8 bin ids in a
+// caller buffer of n*d bytes: out[i,j] = count of edges[j,:] strictly less
+// than x[i,j] (numpy searchsorted side='left'); NaN -> 0; columns flagged
+// in cat_mask (d bytes, may be NULL) bin by identity clipped to
+// [0, max_bin-1]. edges is (d, n_edges) ascending per row. Threads split
+// rows; n_threads <= 0 means hardware concurrency.
+void mmltpu_bin_data(const float *x, int64_t n, int d, const float *edges,
+                     int n_edges, const uint8_t *cat_mask, int max_bin,
+                     uint8_t *out, int n_threads);
+
 }  // extern "C"
 
 #endif  // MMLTPU_H
