@@ -658,6 +658,42 @@ assert acc > 0.9
 print("401 OK")"""))
 
 
+NB_SMOKE = nb(
+    "Basic DataFrame Ops Smoke Test",
+    md("Analog of the reference's `tests/BasicDFOpsSmokeTest.ipynb` — the "
+       "notebook-infrastructure canary: build a frame from sklearn's iris "
+       "(the reference's own corpus here), check shape/columns, and run "
+       "the basic relational ops the data plane guarantees. The `spark`/"
+       "`sc` globals it asserts become the framework's DataFrame + device "
+       "mesh."),
+    code("""\
+assert len(jax.devices()) > 0          # the defaultParallelism analog
+
+from sklearn.datasets import load_iris
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+
+d = load_iris()
+cols = {fname: d["data"][:, i].astype(np.float32)
+        for i, fname in enumerate(d["feature_names"])}
+cols["target"] = np.array([str(d["target_names"][t]) for t in d["target"]],
+                          dtype=object)
+df = DataFrame(cols)
+assert df.count() == 150
+expected = list(d["feature_names"]) + ["target"]
+assert df.columns == expected, df.columns"""),
+    code("""\
+# the relational surface the reference smoke-checks via Spark SQL
+by_class = df.groupBy("target").count().sort("target")
+print(list(zip(by_class.col("target"), by_class.col("count"))))
+assert list(by_class.col("count")) == [50, 50, 50]
+wide = df.filter(df.col("sepal length (cm)") > 5.0)
+assert 0 < wide.count() < 150
+train, test = df.randomSplit([0.7, 0.3], seed=0)
+assert train.count() + test.count() == 150
+print("SMOKE OK")"""))
+
+
 def main() -> int:
     os.makedirs(OUT, exist_ok=True)
     books = {"101_adult_census_income_training.ipynb": N101,
@@ -674,7 +710,8 @@ def main() -> int:
              "303_transfer_learning_dnn_featurization.ipynb": N303,
              "304_medical_entity_extraction.ipynb": N304,
              "305_flowers_image_featurizer.ipynb": N305,
-             "401_distributed_training.ipynb": N401}
+             "401_distributed_training.ipynb": N401,
+             "basic_df_ops_smoke_test.ipynb": NB_SMOKE}
     for name, book in books.items():
         path = os.path.join(OUT, name)
         nbf.write(book, path)
