@@ -48,7 +48,7 @@ class GBDTParams(NamedTuple):
     seed: int = 0
     early_stopping_round: int = 0
     boosting_type: str = "gbdt"     # gbdt | rf (bagged trees, LightGBM rf mode)
-    hist_impl: str = "auto"         # auto | segment | pallas (histogram build)
+    hist_impl: str = "auto"   # auto | compare | segment | pallas (hist build)
     # LightGBM tree_learner (TrainParams.scala `parallelism`):
     #   data    — rows sharded, per-device histograms psum'ed over ICI
     #             (shard_map; the socket-allreduce ring of TrainUtils.scala:141)
@@ -323,13 +323,24 @@ def _histograms(bins, g, h, node, n_nodes: int, n_bins: int,
       histogram_fused — the MXU path (vmap adds the node dimension).
     """
     n, d = bins.shape
-    from ...ops.pallas_kernels import histogram_fused, segment_histogram
+    from ...ops.pallas_kernels import (compare_reduce_histogram,
+                                       histogram_fused, segment_histogram)
 
     # fold the node id into the bin id: ONE pass per level builds all nodes'
     # histograms as (d, n_nodes*n_bins) columns (a per-node vmap would
     # re-scan all rows 2^level times)
     comb = node[:, None] * n_bins + bins
-    build = histogram_fused if hist_impl == "pallas" else segment_histogram
+    if hist_impl == "pallas":
+        build = histogram_fused
+    elif hist_impl == "compare" and n_nodes * n_bins <= 256:
+        # uint8-id space (single-node builds — the root level of every
+        # iteration): the scatter-free compare-reduce wins 4x on TPU;
+        # wider id spaces force int32 keys and lose (pallas_kernels
+        # docstring has the measured crossover). An explicit "segment"
+        # never routes here, so pure segment_sum stays selectable
+        build = compare_reduce_histogram
+    else:
+        build = segment_histogram
     hg, hh = build(comb, g, h, n_bins=n_nodes * n_bins)
     return (hg.reshape(d, n_nodes, n_bins).transpose(1, 0, 2),
             hh.reshape(d, n_nodes, n_bins).transpose(1, 0, 2))
@@ -630,9 +641,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     if p.tree_learner not in ("serial", "data", "feature", "auto"):
         raise ValueError(f"unknown tree_learner {p.tree_learner!r}; expected "
                          "serial|data|feature|auto")
-    if p.hist_impl not in ("auto", "segment", "pallas"):
+    if p.hist_impl not in ("auto", "compare", "segment", "pallas"):
         raise ValueError(f"unknown hist_impl {p.hist_impl!r}; expected "
-                         "auto|segment|pallas")
+                         "auto|compare|segment|pallas")
     if not 2 <= p.max_bin <= 256:
         raise ValueError(f"max_bin must be in [2, 256] (uint8 bin ids; "
                          f"LightGBM's own ceiling is 255), got {p.max_bin}")
@@ -674,12 +685,19 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                          "rejects this combination too)")
     # global statistics (bin edges, init score) must come from REAL rows only
     # — mesh padding / user-masked rows are weight 0
-    # histogram backend: the Pallas one-hot-matmul kernel wins on TPU MXU;
-    # segment_sum is the portable scatter-add (and faster on CPU)
+    # histogram backend: auto = XLA segment_sum everywhere. Round-1 chose
+    # the Pallas one-hot matmul on TPU from unsynced timings; a strict
+    # synced sweep (round 4, v5e, 28 features x 256 bins) shows
+    # segment_sum faster at EVERY size — 0.16 s vs 3.9 s at 50k rows,
+    # 1.9 s vs 4.4 s at 4M (the one-hot staging is HBM/VMEM-bandwidth
+    # bound, not MXU bound; BASELINE.md round-4 row). hist_impl="pallas"
+    # remains selectable for A/B.
+    # "compare" = the hybrid: scatter-free compare-reduce for uint8 id
+    # spaces, segment_sum beyond; "segment" = pure segment_sum (for A/B
+    # and bit-reproducing older fits); "pallas" = the v1 one-hot kernel
     hist_impl = p.hist_impl
     if hist_impl == "auto":
-        hist_impl = ("pallas" if jax.default_backend() == "tpu"
-                     and mesh is None else "segment")
+        hist_impl = "compare"
     real = slice(None) if sample_weight is None else sample_weight > 0
     from ...parallel import mesh as _meshlib
     nproc = _meshlib.effective_process_count()

@@ -9,11 +9,16 @@ Two places where a custom kernel beats what XLA emits from jnp-level code
     statistics live in VMEM scratch across the KV grid dimension. This is the
     single-chip engine under the long-context path; ring/Ulysses (parallel/
     sequence.py) shard sequence across chips and can call this per shard.
-  * ``histogram_fused`` — the GBDT histogram build (the op LightGBM does in
-    native C++ with a socket all-reduce, reference TrainUtils.scala:70-77):
-    per row-block, bins are expanded to a one-hot matrix IN VMEM and the
-    (grad, hess) sums become two thin matmuls on the MXU — a scatter-add
-    re-expressed as dense compute, which is exactly the trade TPUs want.
+  * the GBDT histogram build (the op LightGBM does in native C++ with a
+    socket all-reduce, reference TrainUtils.scala:70-77) ships three
+    backends: ``compare_reduce_histogram`` (scatter-free per-bin masked
+    sums — the fastest on TPU for uint8 id spaces, 0.13 s per 1M x 28
+    build), XLA ``segment_histogram`` (the general case), and the
+    original ``histogram_fused`` Pallas one-hot-matmul kernel. Round-4
+    SYNCED measurements corrected round 1's call: the one-hot staging
+    makes the Pallas kernel HBM/VMEM-bound (4.0 s per 1M x 28 build vs
+    segment's 0.50 s), so the engine's auto policy now picks
+    compare-reduce/segment; the kernel stays selectable for A/B.
 
 Both kernels run in interpret mode off-TPU (CI runs them on the CPU mesh);
 ``_interpret()`` flips automatically so the same call sites work everywhere.
@@ -380,6 +385,32 @@ def segment_histogram(bins, grad, hess, n_bins: int):
     hg = jax.ops.segment_sum(bcast(grad), seg, num_segments=F * n_bins)
     hh = jax.ops.segment_sum(bcast(hess), seg, num_segments=F * n_bins)
     return hg.reshape(F, n_bins), hh.reshape(F, n_bins)
+
+def compare_reduce_histogram(bins, grad, hess, n_bins: int):
+    """Per-bin compare-and-reduce histograms: ``lax.map`` over the bin ids,
+    each step one masked sum over the whole (N, F) matrix — pure VPU
+    elementwise + reduction, no scatter. HBM-bound at ~N*F bytes per bin
+    pass, which beats segment_sum's sort/scatter by 4-10x on TPU when the
+    bin-id space fits uint8 (measured v5e, 28 features x 1M rows:
+    0.13 s vs 0.56 s at 256 ids — but 1.05 s vs 0.50 s already at 512
+    ids, where the id matrix must widen to int32 and the per-id HBM pass
+    quadruples). Callers route here ONLY when n_bins <= 256 (the GBDT
+    engine: single-node builds — the root level of every iteration).
+
+    Same contract as segment_histogram: bins (N, F) int in [0, n_bins);
+    returns ((F, n_bins), (F, n_bins)) f32.
+    """
+    assert n_bins <= 256, "compare-reduce needs a uint8 id space"
+    bins = bins.astype(jnp.uint8)
+
+    def one(b):
+        m = bins == b
+        return (jnp.where(m, grad[:, None], 0.0).sum(0),
+                jnp.where(m, hess[:, None], 0.0).sum(0))
+
+    hg, hh = jax.lax.map(one, jnp.arange(n_bins, dtype=jnp.uint8))
+    return hg.T, hh.T
+
 
 def _hist_kernel(bins_ref, g_ref, h_ref, hg_ref, hh_ref, *, n_bins: int,
                  block_n: int, n_rows: int):

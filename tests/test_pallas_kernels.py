@@ -164,3 +164,53 @@ def test_flash_bf16_forward_and_grad_parity(rng, causal):
         np.testing.assert_allclose(
             np.asarray(gf, dtype=np.float32) / scale,
             np.asarray(gr) / scale, atol=5e-2)
+
+
+def test_compare_reduce_matches_segment_directly():
+    """Direct parity of the scatter-free backend against segment_sum on
+    the same inputs (ties, zero-weight rows, full uint8 id range) — the
+    backend the engine's auto policy prefers for single-node builds."""
+    import numpy as np
+
+    from mmlspark_tpu.ops.pallas_kernels import (compare_reduce_histogram,
+                                                 segment_histogram)
+    rng = np.random.default_rng(5)
+    n, d = 4000, 6
+    bins = jnp.asarray(rng.integers(0, 256, size=(n, d)), jnp.int32)
+    g = jnp.asarray(rng.normal(size=n), jnp.float32)
+    h = jnp.asarray(np.abs(rng.normal(size=n)), jnp.float32)
+    g = g.at[::9].set(0.0)                       # zero-weight rows
+    a_g, a_h = compare_reduce_histogram(bins, g, h, 256)
+    b_g, b_h = segment_histogram(bins, g, h, 256)
+    np.testing.assert_allclose(np.asarray(a_g), np.asarray(b_g),
+                               rtol=1e-6, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(a_h), np.asarray(b_h),
+                               rtol=1e-6, atol=1e-5)
+
+
+def test_explicit_segment_is_pure_segment(monkeypatch):
+    """hist_impl='segment' must NEVER route through compare-reduce (users
+    pin it to bit-reproduce older fits); 'auto' resolves to the hybrid."""
+    import numpy as np
+
+    from mmlspark_tpu.models.gbdt import engine
+    calls = {"cr": 0}
+    real = engine.__dict__  # routing imports inside _histograms
+    import mmlspark_tpu.ops.pallas_kernels as pk
+    orig = pk.compare_reduce_histogram
+
+    def spy(*a, **k):
+        calls["cr"] += 1
+        return orig(*a, **k)
+    monkeypatch.setattr(pk, "compare_reduce_histogram", spy)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    p = engine.GBDTParams(num_iterations=2, max_depth=2, max_bin=15,
+                          hist_impl="segment")
+    engine.fit_gbdt(x, y, p)
+    assert calls["cr"] == 0
+    p2 = engine.GBDTParams(num_iterations=2, max_depth=2, max_bin=15,
+                           hist_impl="auto")
+    engine.fit_gbdt(x, y, p2)
+    assert calls["cr"] >= 1          # hybrid used the uint8 path
