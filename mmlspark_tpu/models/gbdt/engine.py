@@ -48,7 +48,8 @@ class GBDTParams(NamedTuple):
     seed: int = 0
     early_stopping_round: int = 0
     boosting_type: str = "gbdt"     # gbdt | rf (bagged trees, LightGBM rf mode)
-    hist_impl: str = "auto"   # auto | compare | segment | pallas (hist build)
+    hist_impl: str = "auto"   # auto | mxu | compare | segment | pallas
+                              # (auto = mxu kernel on TPU, compare hybrid off)
     # LightGBM tree_learner (TrainParams.scala `parallelism`):
     #   data    — rows sharded, per-device histograms psum'ed over ICI
     #             (shard_map; the socket-allreduce ring of TrainUtils.scala:141)
@@ -315,16 +316,37 @@ def bin_data_auto(x: np.ndarray, edges: np.ndarray,
 # ------------------------------------------------------------- tree builder
 
 def _histograms(bins, g, h, node, n_nodes: int, n_bins: int,
-                hist_impl: str):
-    """(node, feature, bin) grad/hess histograms, two implementations:
+                hist_impl: str, bins_t=None):
+    """(node, feature, bin) grad/hess histograms, several implementations:
 
-    * ``segment``: one flat segment_sum over combined ids — XLA scatter-add;
-    * ``pallas``: per-node masked one-hot matmuls via ops.pallas_kernels.
-      histogram_fused — the MXU path (vmap adds the node dimension).
+    * ``mxu`` (round 5, the TPU default): ops.pallas_kernels.
+      mxu_node_histogram — per-feature bin one-hots contracted on the MXU
+      with the node axis folded into the grad operand, so cost never
+      scales with the node count and is linear in rows. 14.6 ms per
+      1M x 28 x 16-node build vs segment_sum's 384 ms (v5e, synced).
+      ``bins_t`` (d, n) — the transposed bin matrix — is used when the
+      caller precomputed it (the leaf-wise grower hoists it out of its
+      scan); otherwise it is derived here (XLA CSEs the transpose across
+      the levels of one tree build).
+    * ``segment``: one flat segment_sum over combined ids — XLA
+      scatter-add (the portable path);
+    * ``compare``: scatter-free compare-reduce for uint8 id spaces;
+    * ``pallas``: the v1 one-hot matmul kernel, kept for A/B.
     """
     n, d = bins.shape
     from ...ops.pallas_kernels import (compare_reduce_histogram,
-                                       histogram_fused, segment_histogram)
+                                       histogram_fused, mxu_node_histogram,
+                                       segment_histogram)
+
+    # deep levels (n_nodes > 64, i.e. level-wise depth > 7) fall back to
+    # segment_sum PER LEVEL: past that the kernel's VMEM budget shrinks
+    # its row blocks enough that the scatter is competitive, and the
+    # shallow levels — where nearly all the time goes — still ride the MXU
+    if hist_impl == "mxu" and n_nodes <= 64:
+        if bins_t is None:
+            bins_t = bins.T.astype(jnp.int32)
+        return mxu_node_histogram(bins_t, node, g, h, n_nodes=n_nodes,
+                                  n_bins=n_bins)
 
     # fold the node id into the bin id: ONE pass per level builds all nodes'
     # histograms as (d, n_nodes*n_bins) columns (a per-node vmap would
@@ -380,7 +402,8 @@ def _best_splits(hg, hh, feat_mask, n_bins: int, lambda_l2, lambda_l1,
 
 def _grow_tree(bins, g, h, depth: int, n_bins: int, candidate_fn,
                lambda_l2, lambda_l1, min_split_gain,
-               leaf_axis_name: Optional[str] = None):
+               leaf_axis_name: Optional[str] = None,
+               hist_impl: str = "segment"):
     """Shared level-wise scaffolding for every tree_learner mode.
 
     `bins` (n, d) is whatever each device routes its rows with (full
@@ -388,7 +411,11 @@ def _grow_tree(bins, g, h, depth: int, n_bins: int, candidate_fn,
     supplies per-node split candidates (this is where each mode's histogram
     build + collective lives). Leaf grad/hess sums are psum'ed over
     `leaf_axis_name` when rows are sharded.
-    Returns (feature (2^depth-1,), threshold (2^depth-1,), leaf (2^depth,)).
+    Returns (feature (2^depth-1,), threshold (2^depth-1,), leaf (2^depth,),
+    node (n,) — each training row's final leaf, so the boosting loop's raw
+    update is a table gather instead of replaying the tree's gathers over
+    the training set every iteration; round 4 re-predicted here at ~30 ms
+    per level per 1M rows).
     """
     n = bins.shape[0]
     node = jnp.zeros(n, dtype=jnp.int32)
@@ -413,15 +440,16 @@ def _grow_tree(bins, g, h, depth: int, n_bins: int, candidate_fn,
         go_right = bins[jnp.arange(n), nf] > nt
         node = node * 2 + go_right.astype(jnp.int32)
 
-    # --- leaves ---
-    lg = jax.ops.segment_sum(g, node, num_segments=2 ** depth)
-    lh = jax.ops.segment_sum(h, node, num_segments=2 ** depth)
+    # --- leaves (scatter-free reduction; see ops.pallas_kernels.node_sums;
+    # hist_impl="segment" keeps the segment_sum order for bit-reproduction)
+    from ...ops.pallas_kernels import node_sums
+    lg, lh = node_sums(node, g, h, 2 ** depth, impl=hist_impl)
     if leaf_axis_name is not None:
         lg = jax.lax.psum(lg, leaf_axis_name)
         lh = jax.lax.psum(lh, leaf_axis_name)
     lgs = jnp.sign(lg) * jnp.maximum(jnp.abs(lg) - lambda_l1, 0.0)
     leaf = -lgs / (lh + lambda_l2)
-    return feat_arr, thr_arr, leaf
+    return feat_arr, thr_arr, leaf, node
 
 
 def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
@@ -449,7 +477,8 @@ def _build_tree_impl(bins, grad, hess, row_mask, feat_mask, depth: int,
                             min_child_weight)
 
     return _grow_tree(bins, g, h, depth, n_bins, candidates, lambda_l2,
-                      lambda_l1, min_split_gain, leaf_axis_name=axis_name)
+                      lambda_l1, min_split_gain, leaf_axis_name=axis_name,
+                      hist_impl=hist_impl)
 
 
 def _build_tree_fp(bins, grad, hess, row_mask, feat_mask, *, depth: int,
@@ -491,7 +520,7 @@ def _build_tree_fp(bins, grad, hess, row_mask, feat_mask, *, depth: int,
 
     # leaves need no psum: full rows + replicated routing on every device
     return _grow_tree(bins, g, h, depth, n_bins, candidates, lambda_l2,
-                      lambda_l1, min_split_gain)
+                      lambda_l1, min_split_gain, hist_impl=hist_impl)
 
 
 def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
@@ -504,18 +533,19 @@ def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
     tree_learner="feature": inputs replicated, histogram work split by
     feature slice, split candidates all_gather'ed.
     Signature of the returned fn matches `_build_tree_multi`:
-    (bins, grad (n,K), hess, row_mask, feat_mask) -> (f, t, leaf) stacked
-    over the class axis.
+    (bins, grad (n,K), hess, row_mask, feat_mask) -> (f, t, leaf, node)
+    stacked over the class axis.
     """
     from jax.sharding import PartitionSpec as P
 
     if tree_learner == "data":
         def body(bins, g, h, rm, fm):
-            build = lambda g1, h1: _build_tree_impl(
-                bins, g1, h1, rm, fm, depth, n_bins, lambda_l2, lambda_l1,
-                min_child_weight, min_split_gain, hist_impl,
-                axis_name=axis_name)
-            return jax.vmap(build, in_axes=1, out_axes=0)(g, h)
+            return _stack_class_axis([
+                _build_tree_impl(bins, g[:, k], h[:, k], rm, fm, depth,
+                                 n_bins, lambda_l2, lambda_l1,
+                                 min_child_weight, min_split_gain,
+                                 hist_impl, axis_name=axis_name)
+                for k in range(g.shape[1])])
         in_specs = (P(axis_name, None), P(axis_name, None), P(axis_name, None),
                     P(axis_name), P(None))
     elif tree_learner == "feature":
@@ -524,35 +554,49 @@ def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
         d_local = d_pad // n_dev
 
         def body(bins, g, h, rm, fm):
-            build = lambda g1, h1: _build_tree_fp(
-                bins, g1, h1, rm, fm, depth=depth, n_bins=n_bins,
-                d_local=d_local, axis_name=axis_name, lambda_l2=lambda_l2,
-                lambda_l1=lambda_l1, min_child_weight=min_child_weight,
-                min_split_gain=min_split_gain, hist_impl=hist_impl)
-            return jax.vmap(build, in_axes=1, out_axes=0)(g, h)
+            return _stack_class_axis([
+                _build_tree_fp(bins, g[:, k], h[:, k], rm, fm, depth=depth,
+                               n_bins=n_bins, d_local=d_local,
+                               axis_name=axis_name, lambda_l2=lambda_l2,
+                               lambda_l1=lambda_l1,
+                               min_child_weight=min_child_weight,
+                               min_split_gain=min_split_gain,
+                               hist_impl=hist_impl)
+                for k in range(g.shape[1])])
         in_specs = (P(None, None), P(None, None), P(None, None), P(None),
                     P(None))
     else:
         raise ValueError(f"unknown tree_learner {tree_learner!r}")
 
+    # tree arrays replicate; the per-row node assignment stays sharded like
+    # the rows it describes (feature mode holds full rows on every device)
+    node_spec = (P(None, axis_name) if tree_learner == "data"
+                 else P(None, None))
     fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                       out_specs=(P(None), P(None), P(None)),
+                       out_specs=(P(None), P(None), P(None), node_spec),
                        check_vma=False)
     return jax.jit(fn)
+
+
+def _stack_class_axis(builds):
+    """[per-class output tuples] -> one tuple stacked over the class axis.
+    A Python unroll rather than vmap: batching a pallas_call over 1D row
+    operands produces block shapes Mosaic rejects, and K is 1 for every
+    objective but multiclass, so the unroll is free in the common case."""
+    return tuple(jnp.stack(parts) for parts in zip(*builds))
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "n_bins", "hist_impl"))
 def _build_tree_multi(bins, grad, hess, row_mask, feat_mask, *, depth: int,
                       n_bins: int, lambda_l2, lambda_l1, min_child_weight,
                       min_split_gain, hist_impl: str = "segment"):
-    """vmap the tree builder over the class axis of grad/hess (K trees per
-    boosting iteration for multiclass; K=1 otherwise)."""
-    return jax.vmap(
-        lambda g, h: _build_tree_impl(bins, g, h, row_mask, feat_mask,
-                                      depth, n_bins, lambda_l2, lambda_l1,
-                                      min_child_weight, min_split_gain,
-                                      hist_impl),
-        in_axes=1, out_axes=0)(grad, hess)
+    """K trees per boosting iteration over the class axis of grad/hess
+    (multiclass; K=1 otherwise)."""
+    return _stack_class_axis([
+        _build_tree_impl(bins, grad[:, k], hess[:, k], row_mask, feat_mask,
+                         depth, n_bins, lambda_l2, lambda_l1,
+                         min_child_weight, min_split_gain, hist_impl)
+        for k in range(grad.shape[1])])
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
@@ -641,9 +685,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     if p.tree_learner not in ("serial", "data", "feature", "auto"):
         raise ValueError(f"unknown tree_learner {p.tree_learner!r}; expected "
                          "serial|data|feature|auto")
-    if p.hist_impl not in ("auto", "compare", "segment", "pallas"):
+    if p.hist_impl not in ("auto", "mxu", "compare", "segment", "pallas"):
         raise ValueError(f"unknown hist_impl {p.hist_impl!r}; expected "
-                         "auto|compare|segment|pallas")
+                         "auto|mxu|compare|segment|pallas")
     if not 2 <= p.max_bin <= 256:
         raise ValueError(f"max_bin must be in [2, 256] (uint8 bin ids; "
                          f"LightGBM's own ceiling is 255), got {p.max_bin}")
@@ -685,19 +729,18 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                          "rejects this combination too)")
     # global statistics (bin edges, init score) must come from REAL rows only
     # — mesh padding / user-masked rows are weight 0
-    # histogram backend: auto = XLA segment_sum everywhere. Round-1 chose
-    # the Pallas one-hot matmul on TPU from unsynced timings; a strict
-    # synced sweep (round 4, v5e, 28 features x 256 bins) shows
-    # segment_sum faster at EVERY size — 0.16 s vs 3.9 s at 50k rows,
-    # 1.9 s vs 4.4 s at 4M (the one-hot staging is HBM/VMEM-bandwidth
-    # bound, not MXU bound; BASELINE.md round-4 row). hist_impl="pallas"
-    # remains selectable for A/B.
-    # "compare" = the hybrid: scatter-free compare-reduce for uint8 id
-    # spaces, segment_sum beyond; "segment" = pure segment_sum (for A/B
-    # and bit-reproducing older fits); "pallas" = the v1 one-hot kernel
+    # histogram backend: auto = the round-5 "mxu" kernel on TPU (node axis
+    # in the matmul M dim, one-hot width fixed at n_bins: 14.6 ms per
+    # 1M x 28 x 16-node build vs segment_sum's 384 ms and the v1 pallas
+    # one-hot's 4.0 s, all synced — see mxu_node_histogram's docstring for
+    # the measured table), falling back to the "compare" hybrid off-TPU
+    # (compare-reduce for uint8 id spaces, segment_sum beyond — CPU CI
+    # shouldn't pay Pallas interpret-mode costs). "segment" = pure
+    # segment_sum (A/B + bit-reproducing older fits); "pallas" = the v1
+    # one-hot kernel (A/B); explicit values never re-route.
     hist_impl = p.hist_impl
     if hist_impl == "auto":
-        hist_impl = "compare"
+        hist_impl = "mxu" if jax.default_backend() == "tpu" else "compare"
     real = slice(None) if sample_weight is None else sample_weight > 0
     from ...parallel import mesh as _meshlib
     nproc = _meshlib.effective_process_count()
@@ -886,9 +929,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 [lv[k][node_tr[k]] for k in range(K)], axis=1)
         else:
             if builder is not None:
-                f, t, lv = builder(bins_j, g, h, rm, fm)
+                f, t, lv, node_tr = builder(bins_j, g, h, rm, fm)
             else:
-                f, t, lv = _build_tree_multi(
+                f, t, lv, node_tr = _build_tree_multi(
                     bins_j, g, h, rm, fm,
                     depth=p.max_depth, n_bins=p.max_bin,
                     lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
@@ -905,7 +948,11 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 [_predict_tree(b, loc(f[k]), loc(t[k]), loc(lv[k]),
                                depth=p.max_depth)
                  for k in range(K)], axis=1)
-            train_step_fn = lambda: step(bins_j)
+            # training rows' leaves came back from the build: the raw
+            # update is a tiny-table gather, no tree replay (same trick
+            # the leaf-wise path uses)
+            train_step_fn = lambda: jnp.stack(
+                [lv[k][node_tr[k]] for k in range(K)], axis=1)
         if not is_rf:
             raw = raw + train_step_fn()
 

@@ -173,12 +173,16 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
     L = num_leaves
     cat_feats = jnp.asarray(cat_feats, jnp.float32)
     neg_inf = jnp.float32(-jnp.inf)
+    # the transposed bin matrix feeds the mxu histogram kernel; hoisted out
+    # of the scan so it is materialized once per tree, not once per round
+    bins_t = (bins.T.astype(jnp.int32) if hist_impl == "mxu" else None)
 
     def hist_pair(node, a, b):
         """Histograms for leaves a and b in ONE pass; other rows discard."""
         ids = jnp.where(node == a, 0, jnp.where(node == b, 1, 2)) \
             .astype(jnp.int32)
-        hg, hh = _histograms(bins, g, h, ids, 3, n_bins, hist_impl)
+        hg, hh = _histograms(bins, g, h, ids, 3, n_bins, hist_impl,
+                             bins_t=bins_t)
         if axis_name is not None:
             hg = jax.lax.psum(hg, axis_name)
             hh = jax.lax.psum(hh, axis_name)
@@ -231,8 +235,8 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
         round_fn, (node0, cg, cf, ct, cw, dep),
         jnp.arange(L - 1, dtype=jnp.int32))
 
-    lg = jax.ops.segment_sum(g, node, num_segments=L)
-    lh = jax.ops.segment_sum(h, node, num_segments=L)
+    from ...ops.pallas_kernels import node_sums
+    lg, lh = node_sums(node, g, h, L, impl=hist_impl)
     if axis_name is not None:
         lg = jax.lax.psum(lg, axis_name)
         lh = jax.lax.psum(lh, axis_name)
@@ -249,7 +253,11 @@ def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
                               cat_feats, *, num_leaves, n_bins, lambda_l2,
                               lambda_l1, min_child_weight, min_split_gain,
                               cat_smooth, max_depth, hist_impl="segment"):
-    """vmap over the class axis (K leaf-wise trees per boosting iter)."""
+    """K leaf-wise trees per boosting iter over the class axis (a Python
+    unroll, not vmap — see engine._stack_class_axis; K=1 except
+    multiclass)."""
+    from .engine import _stack_class_axis
+
     def one(g, h):
         return grow_tree_leafwise(
             bins, g * row_mask, h * row_mask, num_leaves=num_leaves,
@@ -258,7 +266,8 @@ def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
             min_child_weight=min_child_weight,
             min_split_gain=min_split_gain, cat_smooth=cat_smooth,
             max_depth=max_depth, hist_impl=hist_impl)
-    return jax.vmap(one, in_axes=1, out_axes=0)(grad, hess)
+    return _stack_class_axis([one(grad[:, k], hess[:, k])
+                              for k in range(grad.shape[1])])
 
 
 def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
@@ -271,6 +280,8 @@ def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
     from jax.sharding import PartitionSpec as P
 
     def body(bins, g, h, rm, fm, cat):
+        from .engine import _stack_class_axis
+
         def one(g1, h1):
             return grow_tree_leafwise(
                 bins, g1 * rm, h1 * rm, num_leaves=num_leaves,
@@ -280,7 +291,8 @@ def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
                 min_split_gain=min_split_gain, cat_smooth=cat_smooth,
                 max_depth=max_depth, hist_impl=hist_impl,
                 axis_name=axis_name)
-        return jax.vmap(one, in_axes=1, out_axes=0)(g, h)
+        return _stack_class_axis([one(g[:, k], h[:, k])
+                                  for k in range(g.shape[1])])
 
     fn = jax.shard_map(
         body, mesh=mesh,

@@ -188,29 +188,102 @@ def test_compare_reduce_matches_segment_directly():
                                rtol=1e-6, atol=1e-5)
 
 
+def test_mxu_node_histogram_matches_segment(rng):
+    """The round-5 MXU kernel must match segment_sum per (node, feat, bin),
+    including out-of-range node ids (discard slots) and row padding."""
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.pallas_kernels import (mxu_node_histogram,
+                                                 segment_histogram)
+    N, F, n_bins, n_nodes = 333, 5, 16, 3
+    bins = rng.integers(0, n_bins, size=(N, F)).astype(np.int32)
+    node = rng.integers(0, n_nodes + 2, size=N).astype(np.int32)  # some OOR
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.random(N).astype(np.float32)
+    hg, hh = mxu_node_histogram(jnp.asarray(bins.T), jnp.asarray(node),
+                                jnp.asarray(g), jnp.asarray(h),
+                                n_nodes=n_nodes, n_bins=n_bins, block_n=128)
+    in_r = node < n_nodes
+    comb = jnp.asarray(node[:, None] * n_bins + bins)
+    rg, rh = segment_histogram(comb, jnp.asarray(g * in_r),
+                               jnp.asarray(h * in_r),
+                               n_bins=(n_nodes + 2) * n_bins)
+    rg = np.asarray(rg).reshape(F, n_nodes + 2, n_bins)[:, :n_nodes]
+    rh = np.asarray(rh).reshape(F, n_nodes + 2, n_bins)[:, :n_nodes]
+    np.testing.assert_allclose(np.asarray(hg), rg.transpose(1, 0, 2),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(hh), rh.transpose(1, 0, 2),
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_gbdt_mxu_hist_matches_segment(rng):
+    """Level- and leaf-wise fits must grow identical trees under the mxu
+    backend (the TPU auto default) and the segment reference."""
+    from mmlspark_tpu.models.gbdt.engine import (GBDTParams, fit_gbdt,
+                                                 predict)
+    x = rng.normal(size=(300, 6)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.float32)
+    for extra in (dict(max_depth=3,),
+                  dict(num_leaves=7, max_depth=0)):
+        base = dict(num_iterations=5, max_bin=16, objective="binary",
+                    **extra)
+        e1 = fit_gbdt(x, y, GBDTParams(**base, hist_impl="segment"))
+        e2 = fit_gbdt(x, y, GBDTParams(**base, hist_impl="mxu"))
+        np.testing.assert_array_equal(np.asarray(e1.feature),
+                                      np.asarray(e2.feature))
+        np.testing.assert_array_equal(np.asarray(e1.threshold),
+                                      np.asarray(e2.threshold))
+        np.testing.assert_allclose(predict(e1, x), predict(e2, x),
+                                   atol=1e-5)
+
+
+def test_node_sums_matches_segment(rng):
+    import jax.numpy as jnp
+    from mmlspark_tpu.ops.pallas_kernels import node_sums
+    N, L = 1000, 7
+    node = jnp.asarray(rng.integers(0, L, N).astype(np.int32))
+    g = jnp.asarray(rng.normal(size=N).astype(np.float32))
+    h = jnp.asarray(rng.random(N).astype(np.float32))
+    lg, lh = node_sums(node, g, h, L)
+    sg, sh = node_sums(node, g, h, L, impl="segment")
+    np.testing.assert_allclose(np.asarray(lg), np.asarray(sg), rtol=1e-6,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lh), np.asarray(sh), rtol=1e-6,
+                               atol=1e-5)
+
+
 def test_explicit_segment_is_pure_segment(monkeypatch):
-    """hist_impl='segment' must NEVER route through compare-reduce (users
-    pin it to bit-reproduce older fits); 'auto' resolves to the hybrid."""
+    """hist_impl='segment' must NEVER route through another backend (users
+    pin it to bit-reproduce older fits); 'auto' resolves to the mxu kernel
+    on TPU and to the compare hybrid elsewhere."""
+    import jax
     import numpy as np
 
     from mmlspark_tpu.models.gbdt import engine
-    calls = {"cr": 0}
-    real = engine.__dict__  # routing imports inside _histograms
+    calls = {"cr": 0, "mxu": 0}
     import mmlspark_tpu.ops.pallas_kernels as pk
-    orig = pk.compare_reduce_histogram
+    orig_cr = pk.compare_reduce_histogram
+    orig_mxu = pk.mxu_node_histogram
 
-    def spy(*a, **k):
+    def spy_cr(*a, **k):
         calls["cr"] += 1
-        return orig(*a, **k)
-    monkeypatch.setattr(pk, "compare_reduce_histogram", spy)
+        return orig_cr(*a, **k)
+
+    def spy_mxu(*a, **k):
+        calls["mxu"] += 1
+        return orig_mxu(*a, **k)
+    monkeypatch.setattr(pk, "compare_reduce_histogram", spy_cr)
+    monkeypatch.setattr(pk, "mxu_node_histogram", spy_mxu)
     rng = np.random.default_rng(0)
     x = rng.normal(size=(300, 4)).astype(np.float32)
     y = (x[:, 0] > 0).astype(np.float32)
     p = engine.GBDTParams(num_iterations=2, max_depth=2, max_bin=15,
                           hist_impl="segment")
     engine.fit_gbdt(x, y, p)
-    assert calls["cr"] == 0
+    assert calls["cr"] == 0 and calls["mxu"] == 0
     p2 = engine.GBDTParams(num_iterations=2, max_depth=2, max_bin=15,
                            hist_impl="auto")
     engine.fit_gbdt(x, y, p2)
-    assert calls["cr"] >= 1          # hybrid used the uint8 path
+    if jax.default_backend() == "tpu":
+        assert calls["mxu"] >= 1     # auto = the MXU kernel on TPU
+    else:
+        assert calls["cr"] >= 1      # hybrid used the uint8 path
