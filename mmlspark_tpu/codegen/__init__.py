@@ -228,9 +228,15 @@ def stage_r_wrapper(qual: str, cls: type) -> str:
              f"{_r_name(cls.__name__)} <- function({sig}) {{",
              f'  stage <- mt_stage("{qual}")']
     if simple:
-        lines.append("  mt_set_params(stage, list(")
-        lines.append("    " + ",\n    ".join(f"{n} = {n}" for n in simple))
-        lines += ["  ))", "}", ""]
+        # only args the CALLER supplied become set params: stages
+        # distinguish explicitly-set values from defaults (isSet drives
+        # e.g. the GBDT auto growth policy), and materializing every
+        # default here would erase that signal for all R-built stages
+        lines.append("  vals <- list()")
+        for n in simple:
+            lines.append(
+                f"  if (!missing({n})) vals${n} <- {n}")
+        lines += ["  mt_set_params(stage, vals)", "}", ""]
     else:
         lines += ["  stage", "}", ""]
     return "\n".join(lines)

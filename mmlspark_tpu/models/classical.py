@@ -298,9 +298,9 @@ class RandomForestClassifier(LightGBMClassifier):
     featureFraction = FloatParam("features per tree", default=0.7)
 
     def _engine_params(self, objective, num_class=1, alpha=0.9,
-                       categorical=()):
+                       categorical=(), n_rows=None):
         return super()._engine_params(objective, num_class, alpha,
-                                      categorical) \
+                                      categorical, n_rows=n_rows) \
             ._replace(boosting_type="rf")
 
 
@@ -311,9 +311,9 @@ class RandomForestRegressor(LightGBMRegressor):
     featureFraction = FloatParam("features per tree", default=0.7)
 
     def _engine_params(self, objective, num_class=1, alpha=0.9,
-                       categorical=()):
+                       categorical=(), n_rows=None):
         return super()._engine_params(objective, num_class, alpha,
-                                      categorical) \
+                                      categorical, n_rows=n_rows) \
             ._replace(boosting_type="rf")
 
 

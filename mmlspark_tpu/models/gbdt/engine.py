@@ -223,8 +223,10 @@ def _host_bin_ns() -> float:
     from ...native import available
     return 30.0 if available() else 77.0
 
-#: cached auto-binning verdict ([] = unmeasured; [True] = device wins)
-_device_bin_verdict: list = []
+#: cached auto-binning verdicts keyed by feature width (the host/device
+#: crossover depends on d and link state, so one wide dataset's timing must
+#: not pin the backend for every later narrow one; {} = unmeasured)
+_device_bin_verdict: dict = {}
 
 #: only consider the device binner for datasets at least this large in
 #: f32 bytes. Two reasons: below it the host loop is fast anyway, and a
@@ -262,8 +264,8 @@ def bin_data_auto(x: np.ndarray, edges: np.ndarray,
     try:
         if mode == "device":
             return bin_data_device(x, edges, cat_features, max_bin)
-        if _device_bin_verdict:
-            if _device_bin_verdict[0]:
+        if d in _device_bin_verdict:
+            if _device_bin_verdict[d]:
                 return bin_data_device(x, edges, cat_features, max_bin)
             return bin_data(x, edges, cat_features, max_bin)
 
@@ -295,8 +297,7 @@ def bin_data_auto(x: np.ndarray, edges: np.ndarray,
             part, dev_ns = timed_slab(done, second)
             pieces.append(part)
             done = second
-        _device_bin_verdict.clear()
-        _device_bin_verdict.append(dev_ns <= host_ns)
+        _device_bin_verdict[d] = dev_ns <= host_ns
         if done < n:
             if dev_ns <= host_ns:
                 pieces.append(bin_data_device(x[done:], edges,
@@ -680,6 +681,10 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     (explicit shard_map — LightGBM's socket-allreduce ring), "feature"
     splits histogram work by feature with all_gather'ed split candidates,
     "auto" shards rows and lets XLA auto-SPMD place the collectives."""
+    # persistent compile cache: a first single-process fit in a fresh
+    # interpreter otherwise pays full XLA recompile of cacheable programs
+    from ...parallel.distributed import configure_xla_cache
+    configure_xla_cache()
     p = params
     n, d = x.shape
     if p.tree_learner not in ("serial", "data", "feature", "auto"):

@@ -38,8 +38,16 @@ class _BoosterParams:
     growthPolicy = StringParam(
         "leafwise = native-LightGBM best-first growth to numLeaves leaves "
         "(supports categorical splits); depthwise = level-wise to maxDepth "
-        "(the feature_parallel mode's form)", default="leafwise",
-        choices=("leafwise", "depthwise"))
+        "(the feature_parallel mode's form); auto (default) = leafwise "
+        "EXCEPT for pure-default fits at >= 262144 rows, which run "
+        "depthwise to the numLeaves-equivalent depth — on TPU one "
+        "level-wise round histograms every node at once, so at scale it "
+        "is ~10x faster per tree than the 30 sequential best-first "
+        "splits (measured: 0.08 vs 0.86 s/iter at 1M rows). The trees "
+        "differ from LightGBM's (balanced 2^depth leaves vs best-first "
+        "31); set growthPolicy='leafwise' for exact LightGBM semantics — "
+        "setting numLeaves/maxDepth/categorical slots already implies it",
+        default="auto", choices=("auto", "leafwise", "depthwise"))
     categoricalSlotIndexes = ListParam(
         "feature-vector slot indexes to split as category sets; [] also "
         "auto-detects single-slot categorical columns from the assembled "
@@ -77,9 +85,10 @@ class _BoosterParams:
         return max(1, int(np.ceil(np.log2(self.getOrDefault("numLeaves")))))
 
     def _engine_params(self, objective: str, num_class: int = 1,
-                       alpha: float = 0.9,
-                       categorical: tuple = ()) -> engine.GBDTParams:
-        leafwise = self._effective_leafwise()
+                       alpha: float = 0.9, categorical: tuple = (),
+                       n_rows: int = None) -> engine.GBDTParams:
+        leafwise = self._effective_leafwise(n_rows=n_rows,
+                                            categorical=bool(categorical))
         if not leafwise and self.getOrDefault("growthPolicy") == "leafwise":
             # feature-parallel split candidates are level-wise only
             from ...core.utils import get_logger
@@ -119,12 +128,31 @@ class _BoosterParams:
             seed=self.getOrDefault("seed"),
             tree_learner=self._tree_learner())
 
-    def _effective_leafwise(self) -> bool:
+    #: auto growth routes pure-default fits at or above this many rows to
+    #: the depthwise program (see growthPolicy's doc for the measured gap)
+    AUTO_DEPTHWISE_ROWS = 1 << 18
+
+    def _effective_leafwise(self, n_rows: int = None,
+                            categorical: bool = False) -> bool:
         """The ONE place the growth decision lives: leaf-wise unless the
-        user chose depthwise or a feature-parallel learner (whose split
-        candidates are level-wise only)."""
-        return (self.getOrDefault("growthPolicy") == "leafwise"
-                and self._tree_learner() != "feature")
+        user chose depthwise, a feature-parallel learner (whose split
+        candidates are level-wise only), or — under the default "auto"
+        policy — left every tree-shape param at its default on a large
+        fit, where the depthwise program is ~10x faster per tree and the
+        policy prefers it. Any signal of leaf-wise intent (explicit
+        numLeaves/maxDepth, categorical splits, small or unknown n) keeps
+        native LightGBM semantics. Multi-process callers pass the GLOBAL
+        row count so every process routes identically."""
+        if self._tree_learner() == "feature":
+            return False
+        policy = self.getOrDefault("growthPolicy")
+        if policy != "auto":
+            return policy == "leafwise"
+        if (self.isSet("numLeaves") or self.isSet("maxDepth")
+                or categorical
+                or self.getOrDefault("categoricalSlotIndexes")):
+            return True
+        return n_rows is None or n_rows < self.AUTO_DEPTHWISE_ROWS
 
     def _tree_learner(self) -> str:
         return {"data_parallel": "data", "voting_parallel": "data",
@@ -249,8 +277,12 @@ def _prepare_fit_features(stage, df):
     # every condition below is a pure function of params (replicated) and
     # the fleet-validated (kind, width) — all processes branch together
     cap = stage.getMaxDenseFeatures()
+    # sparse-wide inputs signal EFB (categorical bundles) intent, which
+    # needs leaf-wise growth — pass categorical=True so the auto policy
+    # keeps it rather than routing large fits depthwise
     if hasattr(mat, "tocsc") and mat.shape[1] > cap \
-            and stage._effective_leafwise():
+            and stage._effective_leafwise(n_rows=_global_rows(mat.shape[0]),
+                                          categorical=True):
         from .efb import apply_bundles, plan_and_split
         seed = stage.getOrDefault("seed")
         doc_freq = _fleet_doc_freq(mat)
@@ -357,9 +389,19 @@ def _categorical_slots(df: DataFrame, feat_col: str, explicit, sel):
     return tuple(sorted(set(idxs)))
 
 
+def _global_rows(n_local: int) -> int:
+    """Fleet-wide row count: the auto growth policy must route every
+    process identically, and shard sizes differ."""
+    if meshlib.effective_process_count() > 1:
+        from ...parallel import dataplane
+        return int(sum(dataplane.allgather_pyobj(int(n_local))))
+    return int(n_local)
+
+
 def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
                   categorical=()):
-    p = params_holder._engine_params(objective, num_class, alpha, categorical)
+    p = params_holder._engine_params(objective, num_class, alpha, categorical,
+                                     n_rows=_global_rows(x.shape[0]))
     mesh = params_holder._mesh(x.shape[0])
     nproc = meshlib.effective_process_count()
     if nproc > 1 and p.tree_learner not in ("data", "auto"):

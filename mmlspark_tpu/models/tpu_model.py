@@ -365,6 +365,12 @@ class TpuModel(Transformer):
         meta = np.full(10, -1, np.int64)
         meta[0] = n
         if n > 0:
+            if np.dtype(x.dtype) not in dtypes:
+                # the wire table covers the supported transfer dtypes; cast
+                # anything else (f64/i64 reaching transform) like the
+                # single-host path accepts instead of an opaque index error
+                x = x.astype(np.int32 if np.issubdtype(x.dtype, np.integer)
+                             else np.float32)
             meta[1] = x.ndim - 1
             meta[2:2 + x.ndim - 1] = x.shape[1:]
             meta[-1] = dtypes.index(np.dtype(x.dtype))

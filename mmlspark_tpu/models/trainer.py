@@ -447,6 +447,10 @@ class TpuLearner(Estimator):
 
     # ---- training ----
     def fit(self, df: DataFrame) -> TpuModel:
+        # persistent compile cache for cold single-process fits (the
+        # distributed path and tests already configure it)
+        from ..parallel.distributed import configure_xla_cache
+        configure_xla_cache()
         cfg = dict(self.getModelConfig())
         x = _prep_input(df, self.getFeaturesCol(), tuple(self.getInputShape()))
         if cfg.get("type") in TOKEN_MODELS:

@@ -88,10 +88,20 @@ class SparkServingStream:
                 codes = (out[self.code_col].astype(int)
                          if self.code_col in out.columns
                          else [200] * len(out))
+                answered = set()
                 for ex_id, code, reply in zip(out["id"], codes,
                                               out[self.reply_col]):
                     self.source.respond(str(ex_id), int(code), str(reply))
-                n = len(out)
+                    answered.add(str(ex_id))
+                # a transformer that filters rows would otherwise leave the
+                # dropped exchanges unanswered until the client's socket
+                # times out; fail them explicitly before the commit
+                for ex_id in ids:
+                    if ex_id not in answered:
+                        self.source.respond(ex_id, 500, json.dumps(
+                            {"error": "transformer returned no row for "
+                                      "this request id"}))
+                n = len(ids)   # every request was answered (some with 500)
                 break
             except Exception as e:
                 log.warning("spark micro-batch (%d, %d] attempt %d "

@@ -180,6 +180,38 @@ class TestStages:
                       rmse, tolerance=3.0)
         assert rmse < np.std(yte)  # beats predicting the mean
 
+    def test_auto_growth_policy_routing(self):
+        """Pins the default growth policy (VERDICT round-4 #4): pure-
+        default fits route depthwise at >= AUTO_DEPTHWISE_ROWS (the fast
+        program at scale), while any leaf-wise-intent signal — explicit
+        numLeaves/maxDepth, categorical slots, small n, an explicit
+        growthPolicy — keeps native LightGBM best-first growth."""
+        big = LightGBMClassifier.AUTO_DEPTHWISE_ROWS
+        clf = LightGBMClassifier()
+        assert clf.getOrDefault("growthPolicy") == "auto"
+        # pure defaults: small n leafwise, large n depthwise
+        assert clf._effective_leafwise(n_rows=big - 1)
+        assert not clf._effective_leafwise(n_rows=big)
+        assert clf._effective_leafwise(n_rows=None)    # unknown n: LightGBM
+        # leaf-wise intent signals win at any size
+        assert clf._effective_leafwise(n_rows=big, categorical=True)
+        assert (LightGBMClassifier().setNumLeaves(31)
+                ._effective_leafwise(n_rows=big))
+        assert (LightGBMClassifier().setMaxDepth(6)
+                ._effective_leafwise(n_rows=big))
+        assert (LightGBMClassifier().setCategoricalSlotIndexes((1,))
+                ._effective_leafwise(n_rows=big))
+        # explicit policy always honored
+        assert (LightGBMClassifier().setGrowthPolicy("leafwise")
+                ._effective_leafwise(n_rows=big))
+        assert not (LightGBMClassifier().setGrowthPolicy("depthwise")
+                    ._effective_leafwise(n_rows=10))
+        # the engine params agree: depthwise derives depth 5 from 31 leaves
+        p = clf._engine_params("binary", n_rows=big)
+        assert p.num_leaves == 0 and p.max_depth == 5
+        p2 = clf._engine_params("binary", n_rows=1000)
+        assert p2.num_leaves == 31
+
     def test_quantile_regressor_stage(self):
         rng = np.random.default_rng(0)
         x = rng.normal(size=(1000, 4)).astype(np.float32)
@@ -702,7 +734,7 @@ class TestDeviceBinning:
             return real(*a, **k)
         monkeypatch.setattr(engine, "bin_data_device", spy)
         monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_BYTES", 1000)
-        monkeypatch.setattr(engine, "_device_bin_verdict", [])
+        monkeypatch.setattr(engine, "_device_bin_verdict", {})
         ens_dev = engine.fit_gbdt(x, y, p)
         assert calls["device"] >= 1
         monkeypatch.setattr(engine, "_DEVICE_BIN_MIN_BYTES", 10**18)
