@@ -67,4 +67,38 @@ acc = float((np.asarray(pred.col("prediction"))
              == np.asarray(test.col("label"))).mean())
 print("accuracy:", round(acc, 3))
 assert acc > 0.85
+
+# ---- 224x224: the ImageNet-resolution committed artifact (round 5) ----
+# The reference's notebook-305 flow runs at 224x224 against CDN-hosted
+# ImageNet nets (ModelDownloader.scala:109); the zoo's digits224 backbone
+# (trained on real digit strokes over real photo crops — see
+# testing.datagen.digits_rgb224_augmented) fills that role offline.
+if os.path.exists(os.path.join(REPO, "zoo",
+                               "ResNet26b_digits224.model.meta")):
+    from mmlspark_tpu.testing.datagen import digits_rgb224_augmented
+    # demo scale: a handful of train/held-out rows keeps the CPU-mesh CI
+    # run inside its budget; the committed held-out accuracy over the full
+    # 270-scan set lives in zoo/README.md
+    x4, y4, xt4, yt4 = digits_rgb224_augmented(total=80,
+                                               classes=(0, 1, 2, 3))
+    x4, y4 = x4[:64], y4[:64]
+    xt4, yt4 = xt4[:16], yt4[:16]
+    mk = lambda xa, ya: DataFrame({
+        "image": object_column([make_image_row(f"g{i}", 224, 224, 3, xa[i])
+                                for i in range(len(xa))]),
+        "label": ya})
+    s224 = ModelDownloader(os.path.join(REPO, "zoo")) \
+        .downloadByName("ResNet26b", "digits224")
+    f224 = (ImageFeaturizer().setInputCol("image").setOutputCol("features")
+            .setModelSchema(s224).setCutOutputLayers(1))
+    emb = f224.transform(mk(x4, y4))
+    clf224 = LogisticRegression().setMaxIter(80).fit(emb)
+    pred4 = clf224.transform(f224.transform(mk(xt4, yt4)))
+    acc224 = float((np.asarray(pred4.col("prediction")) == yt4).mean())
+    print("224x224 zoo featurizer accuracy (4-class demo):",
+          round(acc224, 3))
+    assert acc224 > 0.5      # 4-class task, 16 held-out rows, chance 0.25
+else:
+    print("(zoo ResNet26b/digits224 absent; 224x224 section skipped — "
+          "run tools/build_zoo.py)")
 print("example 305 OK")

@@ -209,7 +209,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dvec_ref,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    block_q: int = 1024, block_k: int = 1024,
+                    block_q: int = None, block_k: int = None,
                     interpret=None):
     """FlashAttention on TPU. q/k/v: (B, T, H, D) -> (B, T, H, D).
 
@@ -222,10 +222,15 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     over the saved row logsumexp) — O(T) memory in both directions, the full
     FlashAttention recurrence.
 
-    Default blocks from an on-chip sweep at (B,T,H,D)=(8,4096,8,64), causal,
-    v5e, scalar-sync timing: 128x128 10 TF/s, 256x256 21, 512x512 34,
-    512x1024 46, 1024x1024 58 TF/s; 1024x2048 exceeds the 16MB scoped VMEM
-    limit. Blocks clamp to the sequence length for short inputs.
+    Default blocks are head-dim aware (``block_q/block_k=None``): D >= 128
+    picks 512x1024, smaller D keeps 1024x1024 — from strict chained-loop
+    sweeps on v5e. At (8,4096,4,128) causal (same H*D as the round-4
+    (8,4096,8,64) shape): 512x512 17.3 TF/s, **512x1024 30.8**, 1024x512
+    26.3, 1024x1024 21.8, 2048x512 24.8 — the D=128 contraction fills the
+    MXU's 128-deep systolic array where D=64 half-fills it (19.5 TF/s at
+    its best blocks), a 1.58x end-to-end gain, which is why transformer
+    configs in this repo default to head_dim 128. Blocks clamp to the
+    sequence length for short inputs.
 
     Round-4 re-measurement with a STRICTER harness (20 chained calls in one
     fori_loop, single scalar sync — the per-call numbers above let the
@@ -258,6 +263,7 @@ def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
     Tk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     interpret = _interpret() if interpret is None else interpret
+    block_q, block_k = _default_blocks(D, causal, block_q, block_k)
     # the (bq, bk) temporaries (S, P, dP, dS) quadruple the block footprint
     # vs the forward — halve the blocks to stay inside scoped VMEM
     block_q = min(block_q, 512, max(8, Tq))
@@ -323,12 +329,25 @@ def _flash_attention_bwd(causal, scale, block_q, block_k, interpret,
 flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 
 
+def _default_blocks(D, causal, block_q, block_k):
+    """Head-dim- and mask-aware default tiles (flash_attention docstring
+    has the measured sweeps): at D >= 128 the causal path wants a half q
+    block (512x1024, 30.8 TF/s) while the non-causal path wants a deep k
+    block (1024x2048, 51.8 TF/s); smaller D keeps 1024x1024."""
+    if D >= 128:
+        dq, dk = (512, 1024) if causal else (1024, 2048)
+    else:
+        dq, dk = 1024, 1024
+    return block_q or dq, block_k or dk
+
+
 def _flash_attention_fwd_impl(q, k, v, causal, scale, block_q, block_k,
                               interpret):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     interpret = _interpret() if interpret is None else interpret
+    block_q, block_k = _default_blocks(D, causal, block_q, block_k)
     block_q = min(block_q, max(8, Tq))
     block_k = min(block_k, max(8, Tk))
 

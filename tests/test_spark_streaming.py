@@ -135,6 +135,30 @@ def test_micro_batch_contract_and_replay(spark):
     assert src3.committedOffset() == 1         # failed != stuck
 
 
+def test_filtering_transformer_answers_dropped_ids(spark):
+    """A pipeline stage that FILTERS rows must not leave the dropped
+    requests hanging until socket timeout: every id absent from the
+    transform output is answered 500 before the offset commits, and the
+    cycle reports all ids answered (round-4 advisor finding)."""
+    from mmlspark_tpu.spark.streaming import SparkServingStream
+
+    class _DropSome:
+        def transform(self, sdf):
+            pdf = sdf.toPandas()
+            keep = pdf[pdf["value"] != "drop"].copy()
+            keep["reply"] = keep["value"].str.upper()
+            return spark.createDataFrame(keep)
+
+    src = _FakeSource([("a", "hi"), ("b", "drop"), ("c", "yo")])
+    stream = SparkServingStream(spark, src, _DropSome())
+    assert stream.processBatch() == 3          # every request answered
+    assert src.replies["a"] == (200, "HI")
+    assert src.replies["c"] == (200, "YO")
+    code, body = src.replies["b"]
+    assert code == 500 and "no row" in json.loads(body)["error"]
+    assert src.committedOffset() == 3
+
+
 def _post(url, payload, timeout=15.0):
     req = urllib.request.Request(url, data=payload.encode(),
                                  headers={"Content-Type":

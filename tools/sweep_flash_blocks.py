@@ -1,0 +1,40 @@
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from mmlspark_tpu.ops.pallas_kernels import flash_attention
+
+B, T, H, D = 8, 4096, 4, 128   # same H*D=512 as the round-4 (8,4096,8,64)
+rng = np.random.default_rng(0)
+q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32)).astype(jnp.bfloat16)
+k = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32)).astype(jnp.bfloat16)
+v = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32)).astype(jnp.bfloat16)
+
+def tfs(dt, causal):
+    fl = 4 * B * T * T * H * D * (0.5 if causal else 1.0)
+    return fl / dt / 1e12
+
+for causal in (True, False):
+    for bq, bk in ((512, 512), (512, 1024), (1024, 512), (1024, 1024),
+                   (2048, 512), (1024, 2048)):
+        try:
+            @jax.jit
+            def loop(qx):
+                def body(i, carry):
+                    o = flash_attention(carry, k, v, causal, None, bq, bk)
+                    return o * 1e-3 + carry * (1 - 1e-3)  # data dependence
+                return jax.lax.fori_loop(0, 20, body, qx)
+            r = loop(q)
+            float(jnp.sum(r.astype(jnp.float32)))
+            t0 = time.perf_counter()
+            r = loop(q)
+            float(jnp.sum(r.astype(jnp.float32)))
+            dt = (time.perf_counter() - t0) / 20
+            print(f"causal={causal} {bq}x{bk}: {dt*1e3:7.2f} ms "
+                  f"{tfs(dt, causal):6.1f} TF/s", flush=True)
+        except Exception as e:
+            print(f"causal={causal} {bq}x{bk}: {type(e).__name__} "
+                  f"{str(e)[:80]}", flush=True)
