@@ -822,7 +822,8 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
                 min_child_weight=p.min_child_weight,
                 min_split_gain=p.min_split_gain, cat_smooth=p.cat_smooth,
-                max_depth=lw_depth, hist_impl=hist_impl)
+                max_depth=lw_depth, hist_impl=hist_impl,
+                has_cats=bool(cat_arr.any()))
     elif mesh is not None and tree_learner in ("data", "feature"):
         builder = make_sharded_builder(
             mesh, tree_learner, depth=p.max_depth, n_bins=p.max_bin,
@@ -915,7 +916,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                     min_child_weight=p.min_child_weight,
                     min_split_gain=p.min_split_gain,
                     cat_smooth=p.cat_smooth, max_depth=lw_depth,
-                    hist_impl=hist_impl)
+                    hist_impl=hist_impl, has_cats=bool(cat_arr.any()))
             S, f, t, W, IC, lv, node_tr = tree
             lv = lv * (1.0 if is_rf else p.learning_rate)
             feats.append((S, f, t, W, IC))

@@ -81,12 +81,17 @@ def _leaf_score(gsum, hsum, l2, l1):
 
 
 def _candidates_2(hg, hh, feat_mask, cat_feats, n_bins, l2, l1,
-                  min_child_weight, cat_smooth):
+                  min_child_weight, cat_smooth, has_cats: bool = True):
     """Best split per node from (2, d, B) histograms, numeric AND
     categorical forms evaluated per feature.
 
     Returns per node: gain (2,), feat (2,), thr (2,) (numeric bin or
     sorted-prefix length for categorical), bitset (2, CAT_WORDS) uint32.
+
+    ``has_cats=False`` (static, the common no-categorical fit) skips the
+    whole categorical arm — the per-round argsort/re-rank over
+    (2, d, B) ran unconditionally and was pure overhead when
+    ``cat_feats`` is all-zero.
     """
     n_nodes, d, B = hg.shape
 
@@ -104,6 +109,14 @@ def _candidates_2(hg, hh, feat_mask, cat_feats, n_bins, l2, l1,
     gain_n = gain_n.at[:, :, -1].set(-jnp.inf)  # all-left split is no split
     bin_n = jnp.argmax(gain_n, axis=2)
     best_n = jnp.take_along_axis(gain_n, bin_n[:, :, None], axis=2)[:, :, 0]
+
+    if not has_cats:
+        gain_f = jnp.where(feat_mask[None, :] > 0, best_n, -jnp.inf)
+        bf = jnp.argmax(gain_f, axis=1)
+        gain = jnp.take_along_axis(gain_f, bf[:, None], axis=1)[:, 0]
+        thr = jnp.take_along_axis(bin_n, bf[:, None], axis=1)[:, 0]
+        return (gain, bf.astype(jnp.int32), thr.astype(jnp.int32),
+                jnp.zeros((n_nodes, CAT_WORDS), dtype=jnp.uint32))
 
     # ---- categorical: prefix over bins sorted by grad/hess ratio ----
     ratio = hg / (hh + cat_smooth)
@@ -161,7 +174,8 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
                        cat_feats, feat_mask, lambda_l2, lambda_l1,
                        min_child_weight, min_split_gain, cat_smooth: float,
                        max_depth: int = 0, hist_impl: str = "segment",
-                       axis_name: Optional[str] = None):
+                       axis_name: Optional[str] = None,
+                       has_cats: bool = True):
     """One leaf-wise tree. bins (n, d) int; g/h (n,) f32 (already masked).
 
     Returns (split_leaf (L-1,), feature (L-1,), threshold (L-1,),
@@ -192,7 +206,7 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
         hg, hh = hist_pair(node, a, b)
         return _candidates_2(hg, hh, feat_mask, cat_feats, n_bins,
                              lambda_l2, lambda_l1, min_child_weight,
-                             cat_smooth)
+                             cat_smooth, has_cats=has_cats)
 
     node0 = jnp.zeros(n, dtype=jnp.int32)
     g0, f0, t0, w0 = cand_pair(node0, 0, -1)   # root candidates (slot 0)
@@ -207,9 +221,13 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
         s = jnp.argmax(cg).astype(jnp.int32)
         ok = cg[s] > min_split_gain
         f, t, w = cf[s], ct[s], cw[s]
-        f_is_cat = cat_feats[f] > 0
         rb = bins[jnp.arange(n), f].astype(jnp.int32)
-        right = jnp.where(f_is_cat, _bit_test(w, rb), rb > t)
+        if has_cats:
+            f_is_cat = cat_feats[f] > 0
+            right = jnp.where(f_is_cat, _bit_test(w, rb), rb > t)
+        else:
+            f_is_cat = jnp.bool_(False)
+            right = rb > t
         right = right & (node == s) & ok
         node = jnp.where(right, r + 1, node)
 
@@ -248,11 +266,12 @@ def grow_tree_leafwise(bins, g, h, *, num_leaves: int, n_bins: int,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "num_leaves", "n_bins", "max_depth", "hist_impl"))
+    "num_leaves", "n_bins", "max_depth", "hist_impl", "has_cats"))
 def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
                               cat_feats, *, num_leaves, n_bins, lambda_l2,
                               lambda_l1, min_child_weight, min_split_gain,
-                              cat_smooth, max_depth, hist_impl="segment"):
+                              cat_smooth, max_depth, hist_impl="segment",
+                              has_cats=True):
     """K leaf-wise trees per boosting iter over the class axis (a Python
     unroll, not vmap — see engine._stack_class_axis; K=1 except
     multiclass)."""
@@ -265,7 +284,7 @@ def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
             lambda_l2=lambda_l2, lambda_l1=lambda_l1,
             min_child_weight=min_child_weight,
             min_split_gain=min_split_gain, cat_smooth=cat_smooth,
-            max_depth=max_depth, hist_impl=hist_impl)
+            max_depth=max_depth, hist_impl=hist_impl, has_cats=has_cats)
     return _stack_class_axis([one(grad[:, k], hess[:, k])
                               for k in range(grad.shape[1])])
 
@@ -273,7 +292,7 @@ def build_tree_leafwise_multi(bins, grad, hess, row_mask, feat_mask,
 def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
                             lambda_l1, min_child_weight, min_split_gain,
                             cat_smooth, max_depth, hist_impl="segment",
-                            axis_name: str = "data"):
+                            axis_name: str = "data", has_cats=True):
     """Data-parallel leaf-wise builder: rows sharded over `axis_name`,
     per-round histograms + leaf sums psum'ed (the LightGBM data-parallel
     ring, TrainUtils.scala:141, as ICI collectives)."""
@@ -290,7 +309,7 @@ def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
                 min_child_weight=min_child_weight,
                 min_split_gain=min_split_gain, cat_smooth=cat_smooth,
                 max_depth=max_depth, hist_impl=hist_impl,
-                axis_name=axis_name)
+                axis_name=axis_name, has_cats=has_cats)
         return _stack_class_axis([one(g[:, k], h[:, k])
                                   for k in range(g.shape[1])])
 

@@ -222,9 +222,9 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     over the saved row logsumexp) — O(T) memory in both directions, the full
     FlashAttention recurrence.
 
-    Default blocks are head-dim aware (``block_q/block_k=None``): D >= 128
-    picks 512x1024, smaller D keeps 1024x1024 — from strict chained-loop
-    sweeps on v5e. At (8,4096,4,128) causal (same H*D as the round-4
+    Default blocks are head-dim and mask aware (``block_q/block_k=None``):
+    D >= 128 picks 512x1024 causal / 1024x2048 non-causal, smaller D
+    keeps 1024x1024 — from strict chained-loop sweeps on v5e. At (8,4096,4,128) causal (same H*D as the round-4
     (8,4096,8,64) shape): 512x512 17.3 TF/s, **512x1024 30.8**, 1024x512
     26.3, 1024x1024 21.8, 2048x512 24.8 — the D=128 contraction fills the
     MXU's 128-deep systolic array where D=64 half-fills it (19.5 TF/s at
