@@ -661,17 +661,45 @@ def _boost_step_leafwise(bins, raw, y, row_mask, feat_mask, cat_feats, lr,
 
 
 @functools.partial(jax.jit, static_argnames=("depth",))
-def _predict_tree(bins, feature, threshold, leaf, depth: int):
-    """bins (n,d); tree arrays for one class -> (n,) leaf values."""
-    n = bins.shape[0]
+def _predict_tree_t(bins_t, feature, threshold, leaf, depth: int):
+    """One level-wise tree from the TRANSPOSED bin matrix (d, n).
+
+    All 2^depth-1 node tests are precomputed with one row-DMA
+    (``jnp.take`` over rows of bins_t) + compare; the level walk then
+    selects from the small (2^depth-1, n) bool table instead of doing a
+    per-row feature gather against the full (n, d) matrix per level —
+    the same round-5 scoring fix as the leaf-wise replay
+    (leafwise._tree_tests_lw). rows stay uint8 (the int32 promote fuses
+    into the compare; thresholds carry the 256 no-split sentinel)."""
+    rows = jnp.take(bins_t, feature, axis=0)
+    tests = rows > threshold[:, None]                  # (2^depth-1, n)
+    n = bins_t.shape[1]
     pos = jnp.zeros(n, dtype=jnp.int32)
     for level in range(depth):
-        heap = 2 ** level - 1 + pos
-        f = feature[heap]
-        t = threshold[heap]
-        go_right = bins[jnp.arange(n), f] > t
+        off = 2 ** level - 1
+        cnt = 2 ** level
+        if cnt <= 64:
+            # select the row's node test with a where-chain — pure
+            # elementwise VPU work; the take_along gather it replaces was
+            # ~12 ms per level at 1M rows (5 gathers/tree dominated the
+            # 100-tree scoring scan)
+            go_right = tests[off + cnt - 1]
+            for k in range(cnt - 2, -1, -1):
+                go_right = jnp.where(pos == k, tests[off + k], go_right)
+        else:   # deep levels: the chain would unroll too far
+            heap = off + pos
+            go_right = jnp.take_along_axis(tests, heap[None, :],
+                                           axis=0)[0]
         pos = pos * 2 + go_right.astype(jnp.int32)
     return leaf[pos]
+
+
+@functools.partial(jax.jit, static_argnames=("depth",))
+def _predict_tree(bins, feature, threshold, leaf, depth: int):
+    """bins (n,d); tree arrays for one class -> (n,) leaf values.
+    Row-major wrapper over _predict_tree_t (multi-tree scorers transpose
+    once and call the _t form)."""
+    return _predict_tree_t(bins.T, feature, threshold, leaf, depth)
 
 
 # ------------------------------------------------------------- objectives
@@ -922,6 +950,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         bins_val = jnp.asarray(bin_data_auto(
             np.asarray(eval_set[0], dtype=np.float32), edges,
             cat_arr if cat_arr.any() else None, p.max_bin))
+        # transposed once for the per-iteration eval predicts (the _t
+        # scoring forms); re-transposing per class per iteration is waste
+        bins_val_t = bins_val.T
         y_val = jnp.asarray(np.asarray(eval_set[1], dtype=np.float32))
         raw_val = jnp.broadcast_to(jnp.asarray(base)[None, :],
                                    (bins_val.shape[0], K)).astype(jnp.float32)
@@ -996,9 +1027,10 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             # (the val set is process-local; mixing global and local arrays
             # in one jit is undefined).
             loc = (lambda a: np.asarray(a)) if nproc > 1 else (lambda a: a)
-            step = lambda b: jnp.stack(
-                [lw.predict_tree_lw(b, loc(S[k]), loc(f[k]), loc(t[k]),
-                                    loc(W[k]), loc(IC[k]), loc(lv[k]))
+            step = lambda bt: jnp.stack(
+                [lw.predict_tree_lw_t(bt, loc(S[k]), loc(f[k]), loc(t[k]),
+                                      loc(W[k]), loc(IC[k]), loc(lv[k]),
+                                      has_cats=bool(cat_arr.any()))
                  for k in range(K)], axis=1)
             train_step_fn = lambda: _gather_tree_contrib(lv, node_tr)
         else:
@@ -1020,9 +1052,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             thrs.append(t)
             leaves.append(lv)
             loc = (lambda a: np.asarray(a)) if nproc > 1 else (lambda a: a)
-            step = lambda b: jnp.stack(
-                [_predict_tree(b, loc(f[k]), loc(t[k]), loc(lv[k]),
-                               depth=p.max_depth)
+            step = lambda bt: jnp.stack(
+                [_predict_tree_t(bt, loc(f[k]), loc(t[k]), loc(lv[k]),
+                                 depth=p.max_depth)
                  for k in range(K)], axis=1)
             # training rows' leaves came back from the build: the raw
             # update is a tiny-table gather, no tree replay (same trick
@@ -1033,7 +1065,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             raw = raw + train_step_fn()
 
         if p.early_stopping_round > 0:
-            raw_val = raw_val + step(bins_val)
+            raw_val = raw_val + step(bins_val_t)
             cur = float(_loss(raw_val, y_val, p.objective, p.alpha))
             if nproc > 1:
                 # the stop decision must be identical fleet-wide: average
@@ -1088,10 +1120,11 @@ def predict_raw(ens, x: np.ndarray,
 
     @jax.jit
     def run(bins, feature, threshold, leaf):
+        bins_t = bins.T              # once per scoring call, not per tree
         def body(raw, tree):
             f, t, lv = tree
             contrib = jnp.stack(
-                [_predict_tree(bins, f[k], t[k], lv[k], depth=depth)
+                [_predict_tree_t(bins_t, f[k], t[k], lv[k], depth=depth)
                  for k in range(K)], axis=1)
             return raw + contrib, None
         init = jnp.broadcast_to(jnp.asarray(ens.base)[None, :],
