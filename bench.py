@@ -24,6 +24,7 @@ import numpy as np
 def main():
     import jax
     import optax
+    from mmlspark_tpu import telemetry
     from mmlspark_tpu.models import build_model
     from mmlspark_tpu.models.trainer import (_make_scan_epoch_fn, make_loss)
     from mmlspark_tpu.parallel import mesh as meshlib
@@ -67,9 +68,14 @@ def main():
     float(loss)
 
     t0 = time.perf_counter()
-    for d in range(n_dispatch):
-        params, opt_state, loss = scan_fn(params, opt_state, x_dev, y_dev,
-                                          w_dev, plan(2 + d))
+    with telemetry.trace.span("fit", model="resnet20", path="scan") as fsp:
+        for d in range(n_dispatch):
+            with telemetry.trace.span("fit/step", dispatch=d,
+                                      steps=k_steps) as sp:
+                params, opt_state, loss = scan_fn(params, opt_state, x_dev,
+                                                  y_dev, w_dev, plan(2 + d))
+                sp.set_sync(loss)
+        fsp.set_sync(loss)
     float(loss)  # hard sync: forces the whole chain to complete
     dt = time.perf_counter() - t0
 
@@ -81,6 +87,15 @@ def main():
         "unit": "imgs/sec/chip",
         "vs_baseline": None,
     }))
+    if telemetry.enabled():
+        # second line: the step-breakdown context future BENCH_*.json
+        # rounds carry (never emitted in the default disabled mode, so the
+        # one-metric-line contract is unchanged there)
+        print(json.dumps({"telemetry": telemetry.snapshot()}))
+        from mmlspark_tpu.core.env import telemetry_trace_path
+        path = telemetry_trace_path() or "bench_trace.jsonl"
+        n_ev = telemetry.trace.export_chrome_trace(path)
+        print(json.dumps({"trace_file": path, "events": n_ev}))
 
 
 if __name__ == "__main__":

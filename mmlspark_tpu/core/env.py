@@ -6,8 +6,25 @@ mmlspark_tpu.native)."""
 from __future__ import annotations
 
 import contextlib
+import os
 import subprocess
-from typing import Iterator, Sequence
+from typing import Iterator, Optional, Sequence
+
+
+def telemetry_enabled() -> bool:
+    """The MMLSPARK_TPU_TELEMETRY=1 global switch: when truthy, the
+    telemetry package enables its process-global metrics registry and span
+    tracer at import (mmlspark_tpu.telemetry). Default off — a disabled
+    registry costs one attribute lookup per call site."""
+    return os.environ.get("MMLSPARK_TPU_TELEMETRY", "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def telemetry_trace_path() -> Optional[str]:
+    """MMLSPARK_TPU_TRACE=/path/file.jsonl: export the span buffer as
+    Chrome-trace JSON-lines at interpreter exit (telemetry must also be
+    enabled for spans to record)."""
+    return os.environ.get("MMLSPARK_TPU_TRACE") or None
 
 
 def accelerator_count() -> int:

@@ -30,9 +30,22 @@ import numpy as np
 
 from ...core.dataframe import DataFrame
 from ...core.utils import get_logger, object_column
-from .server import HTTPSink
+from ... import telemetry
+from .server import HTTPSink, _m_batch_rows
 
 log = get_logger("http.fleet")
+
+# driver-side fleet metrics (the workers' own request latency / queue depth
+# live in each worker process, scraped at its GET /metrics)
+_m_worker_errors = telemetry.registry.counter(
+    "mmlspark_fleet_worker_errors",
+    "failed control round-trips to a worker, by worker index and phase",
+    labels=("worker", "phase"))
+_m_workers_alive = telemetry.registry.gauge(
+    "mmlspark_fleet_workers_alive", "live worker processes in the fleet")
+_m_uncommitted = telemetry.registry.gauge(
+    "mmlspark_fleet_uncommitted_rows",
+    "rows in the replayable offset log awaiting commit")
 
 
 class _Worker:
@@ -156,6 +169,7 @@ class ProcessHTTPSource:
         self._committed = 0       # offsets <= this are gone
         self._reply_buf: dict[int, list] = {}
         self._lock = threading.Lock()
+        _m_workers_alive.set(self.aliveCount())
         log.info("fleet of %d worker processes on ports %s",
                  n_workers, [w.port for w in self.workers])
 
@@ -185,10 +199,12 @@ class ProcessHTTPSource:
                 # failed health check (or process exit) is a death verdict.
                 # A dead worker loses ONLY its own in-flight clients (their
                 # sockets died with it); the fleet serves on.
+                _m_worker_errors.labels(worker=str(wi), phase="poll").inc()
                 if w.probably_dead():
                     log.warning("worker %d (%s) dead, marking: %s",
                                 wi, w.url, e)
                     w.alive = False
+                    _m_workers_alive.set(self.aliveCount())
                 else:
                     log.warning("worker %d poll failed (still healthy, "
                                 "retrying next round): %s", wi, e)
@@ -202,6 +218,7 @@ class ProcessHTTPSource:
                     self._offset += 1
                     self._log.append((self._offset, qid, value))
                     self._log_ids.add(qid)
+        _m_uncommitted.set(len(self._log))
         return self._offset
 
     def committedOffset(self) -> int:
@@ -247,10 +264,13 @@ class ProcessHTTPSource:
             except Exception as e:
                 # same slow-vs-dead policy as the poll path: only a failed
                 # health check (or process exit) is a death verdict
+                _m_worker_errors.labels(worker=str(wi),
+                                        phase="respond").inc()
                 if w.probably_dead():
                     log.warning("worker %d dead during reply delivery: %s",
                                 wi, e)
                     w.alive = False
+                    _m_workers_alive.set(self.aliveCount())
                 else:
                     log.warning("worker %d reply delivery failed (worker "
                                 "healthy; its clients will see their "
@@ -290,9 +310,13 @@ class ReplayServingLoop:
                 continue
             for attempt in range(self.max_retries + 1):
                 batch = self.source.getBatch(start, end)  # replay-stable
+                _m_batch_rows.observe(batch.count())
                 try:
-                    out = self.transformer.transform(batch)
-                    self.sink.addBatch(out)
+                    with telemetry.trace.span("fleet/batch",
+                                              rows=batch.count(),
+                                              attempt=attempt):
+                        out = self.transformer.transform(batch)
+                        self.sink.addBatch(out)
                     break
                 except Exception as e:
                     log.warning("batch (%d, %d] attempt %d failed: %s",

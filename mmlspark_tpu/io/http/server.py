@@ -30,8 +30,25 @@ import numpy as np
 
 from ...core.dataframe import DataFrame
 from ...core.utils import get_logger, object_column
+from ... import telemetry
 
 log = get_logger("io.http")
+
+# serving metrics (shared by the single-process loop and the fleet workers;
+# each OS process exposes its own registry at GET /metrics)
+_m_req_latency = telemetry.registry.histogram(
+    "mmlspark_http_request_seconds",
+    "client request latency: arrival to reply written")
+_m_queue_depth = telemetry.registry.gauge(
+    "mmlspark_http_queue_depth",
+    "requests pending batch pickup in this server")
+_m_batch_rows = telemetry.registry.histogram(
+    "mmlspark_serving_batch_rows",
+    "rows per serving micro-batch (continuous batching)",
+    buckets=telemetry.pow2_buckets(1, 4096))
+_m_replies = telemetry.registry.counter(
+    "mmlspark_http_replies", "replies sent by status class",
+    labels=("code",))
 
 
 class _BurstyHTTPServer(ThreadingHTTPServer):
@@ -86,22 +103,42 @@ class HTTPSource:
                 if api_path not in ("/", self.path):
                     self.send_error(404)
                     return
+                t0 = time.perf_counter()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode("utf-8")
                 ex = _Exchange(body)
                 with source._lock:
                     source._inflight[ex.id] = ex
                 source._pending.put(ex)
+                _m_queue_depth.set(source._pending.qsize())
                 if not ex.event.wait(timeout=source.reply_timeout):
                     self.send_error(504, "batch processing timed out")
                     with source._lock:
                         source._inflight.pop(ex.id, None)
+                    _m_replies.labels(code="504").inc()
                     return
                 self.send_response(ex.code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(ex.body)))
                 self.end_headers()
                 self.wfile.write(ex.body)
+                _m_req_latency.observe(time.perf_counter() - t0)
+                _m_replies.labels(code=str(ex.code)).inc()
+
+            def do_GET(self):
+                # Prometheus scrape surface: every serving process (the
+                # single-process loop AND each fleet worker) answers
+                # GET /metrics with its own registry's exposition
+                if self.path == "/metrics":
+                    payload = telemetry.prometheus_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self.send_error(404)
 
             def log_message(self, *a):
                 pass
@@ -137,6 +174,7 @@ class HTTPSource:
                     rows.append(ex)
         except queue.Empty:
             pass
+        _m_queue_depth.set(self._pending.qsize())
         if not rows:
             return DataFrame({"id": np.array([], dtype=object),
                               "value": np.array([], dtype=object)})
@@ -196,9 +234,11 @@ class ServingLoop:
             batch = self.source.getBatch(self.max_batch)
             if batch.count() == 0:
                 continue
+            _m_batch_rows.observe(batch.count())
             try:
-                out = self.transformer.transform(batch)
-                self.sink.addBatch(out)
+                with telemetry.trace.span("serve/batch", rows=batch.count()):
+                    out = self.transformer.transform(batch)
+                    self.sink.addBatch(out)
             except Exception as e:  # reply 500s rather than hanging clients
                 log.warning("serving batch failed: %s", e)
                 for ex_id in batch.col("id"):

@@ -57,6 +57,18 @@ class WorkerServer:
                 if self.path == "/health":
                     self._json(200, {"ok": True,
                                      "port": worker.source.port})
+                elif self.path == "/metrics":
+                    # same exposition as the public port's GET /metrics, so
+                    # a scraper confined to the control plane still sees
+                    # this worker's registry
+                    from ... import telemetry
+                    body = telemetry.prometheus_text().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 

@@ -23,11 +23,37 @@ so prediction is `depth` gathers — no pointer chasing, fully vectorized.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ... import telemetry
+
+# boosting-loop telemetry (no-ops unless MMLSPARK_TPU_TELEMETRY=1). The
+# hist+split work runs inside ONE jitted program per iteration, so the
+# host-visible breakdown is grad / build / apply (+ the early-stop eval);
+# spans carry block_until_ready sync points so enabled traces show real
+# device time, not enqueue time.
+_m_iters = telemetry.registry.counter(
+    "mmlspark_gbdt_iterations", "boosting iterations dispatched")
+_m_iter_time = telemetry.registry.histogram(
+    "mmlspark_gbdt_iter_seconds",
+    "wall time per boosting iteration (excl. early-stop eval)")
+_m_eval_time = telemetry.registry.histogram(
+    "mmlspark_gbdt_eval_seconds",
+    "wall time per early-stopping validation eval")
+_m_bin_time = telemetry.registry.histogram(
+    "mmlspark_gbdt_bin_seconds", "feature binning wall time per fit")
+_m_predict_table_bytes = telemetry.registry.gauge(
+    "mmlspark_gbdt_predict_table_bytes",
+    "estimated peak bytes of the per-chunk node-test table during the "
+    "last ensemble predict")
+_m_auto_depthwise = telemetry.registry.counter(
+    "mmlspark_gbdt_auto_depthwise_reroutes",
+    "fits the growthPolicy='auto' heuristic rerouted to depthwise growth")
 
 
 class GBDTParams(NamedTuple):
@@ -660,20 +686,37 @@ def _boost_step_leafwise(bins, raw, y, row_mask, feat_mask, cat_feats, lr,
     return raw, S, f, t, W, IC, lv, node
 
 
+#: full precomputed node-test tables stop at this many internal nodes
+#: (depth 7): past it a deep tree's (2^depth-1, n) table plus the gathered
+#: rows scales geometrically — max_depth 15 at 10M rows would stage tens of
+#: GB — so deeper trees compute each level's tests on the fly instead
+#: (ADVICE r5). Mirrors the cnt<=64 where-chain guard below.
+_TEST_TABLE_MAX_NODES = 127
+
+
 @functools.partial(jax.jit, static_argnames=("depth",))
 def _predict_tree_t(bins_t, feature, threshold, leaf, depth: int):
     """One level-wise tree from the TRANSPOSED bin matrix (d, n).
 
-    All 2^depth-1 node tests are precomputed with one row-DMA
-    (``jnp.take`` over rows of bins_t) + compare; the level walk then
-    selects from the small (2^depth-1, n) bool table instead of doing a
-    per-row feature gather against the full (n, d) matrix per level —
-    the same round-5 scoring fix as the leaf-wise replay
-    (leafwise._tree_tests_lw). rows stay uint8 (the int32 promote fuses
-    into the compare; thresholds carry the 256 no-split sentinel)."""
-    rows = jnp.take(bins_t, feature, axis=0)
-    tests = rows > threshold[:, None]                  # (2^depth-1, n)
+    Shallow trees (<= _TEST_TABLE_MAX_NODES internal nodes) precompute all
+    node tests with one row-DMA (``jnp.take`` over rows of bins_t) +
+    compare; the level walk then selects from the small (2^depth-1, n)
+    bool table instead of doing a per-row feature gather against the full
+    (n, d) matrix per level — the same round-5 scoring fix as the
+    leaf-wise replay (leafwise._tree_tests_lw). rows stay uint8 (the int32
+    promote fuses into the compare; thresholds carry the 256 no-split
+    sentinel).
+
+    Deeper trees never materialize the full table: levels up to the
+    where-chain guard gather only THEIR 2^level rows on the fly, and
+    deeper levels fall back to the per-row position gather (O(n) live
+    memory — the pre-round-5 form, whose depth gathers are the memory-safe
+    trade for trees this deep)."""
     n = bins_t.shape[1]
+    full_table = 2 ** depth - 1 <= _TEST_TABLE_MAX_NODES
+    if full_table:
+        rows = jnp.take(bins_t, feature, axis=0)
+        tests = rows > threshold[:, None]              # (2^depth-1, n)
     pos = jnp.zeros(n, dtype=jnp.int32)
     for level in range(depth):
         off = 2 ** level - 1
@@ -683,13 +726,25 @@ def _predict_tree_t(bins_t, feature, threshold, leaf, depth: int):
             # elementwise VPU work; the take_along gather it replaces was
             # ~12 ms per level at 1M rows (5 gathers/tree dominated the
             # 100-tree scoring scan)
-            go_right = tests[off + cnt - 1]
+            if full_table:
+                lv_tests = tests[off:off + cnt]
+            else:   # this level's (cnt, n) slice only, freed next level
+                lv_rows = jnp.take(bins_t, feature[off:off + cnt], axis=0)
+                lv_tests = lv_rows > threshold[off:off + cnt, None]
+            go_right = lv_tests[cnt - 1]
             for k in range(cnt - 2, -1, -1):
-                go_right = jnp.where(pos == k, tests[off + k], go_right)
-        else:   # deep levels: the chain would unroll too far
+                go_right = jnp.where(pos == k, lv_tests[k], go_right)
+        elif full_table:   # deep levels: the chain would unroll too far
             heap = off + pos
             go_right = jnp.take_along_axis(tests, heap[None, :],
                                            axis=0)[0]
+        else:
+            # deep level of a deep tree: per-row gather of each row's own
+            # node test — O(n) memory, no (cnt, n) staging
+            nf = feature[off + pos]
+            nt = threshold[off + pos]
+            vals = jnp.take_along_axis(bins_t, nf[None, :], axis=0)[0]
+            go_right = vals > nt
         pos = pos * 2 + go_right.astype(jnp.int32)
     return leaf[pos]
 
@@ -769,6 +824,18 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     (explicit shard_map — LightGBM's socket-allreduce ring), "feature"
     splits histogram work by feature with all_gather'ed split candidates,
     "auto" shards rows and lets XLA auto-SPMD place the collectives."""
+    with telemetry.trace.span("gbdt/fit", rows=int(x.shape[0]),
+                              features=int(x.shape[1]),
+                              objective=params.objective,
+                              iterations=params.num_iterations):
+        return _fit_gbdt_impl(x, y, params, mesh=mesh,
+                              sample_weight=sample_weight,
+                              eval_set=eval_set)
+
+
+def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
+                   mesh=None, sample_weight: Optional[np.ndarray] = None,
+                   eval_set: Optional[tuple] = None) -> TreeEnsemble:
     # persistent compile cache: a first single-process fit in a fresh
     # interpreter otherwise pays full XLA recompile of cacheable programs
     from ...parallel.distributed import configure_xla_cache
@@ -870,8 +937,10 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     else:
         edges = compute_bin_edges(x[real], p.max_bin)
         base_global = None
-    bins = bin_data_auto(x, edges, cat_arr if cat_arr.any() else None,
-                         p.max_bin)
+    with telemetry.trace.span("gbdt/bin", rows=n, features=d), \
+            _m_bin_time.time():
+        bins = bin_data_auto(x, edges, cat_arr if cat_arr.any() else None,
+                             p.max_bin)
     d_pad = d
     if tree_learner == "feature":
         # pad the feature axis to a device multiple; padded columns carry
@@ -970,6 +1039,7 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
 
     lr_eff = 1.0 if is_rf else p.learning_rate
     for it in range(p.num_iterations):
+        t_iter = time.perf_counter()
         # rf mode (LightGBM boosting=rf): every tree fits the INITIAL
         # gradients on its own bootstrap sample; raw never moves during the
         # fit and leaves are averaged (scaled 1/T) at the end
@@ -978,7 +1048,9 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             # serial paths fuse grad + build + raw update into ONE
             # dispatch per iteration (_boost_step_* — measured perf-equal
             # to the multi-dispatch loop; see its docstring)
-            g, h = _grad_hess(raw, yj, p.objective, K, p.alpha)
+            with telemetry.trace.span("gbdt/iter/grad", tree=it) as _sp:
+                g, h = _grad_hess(raw, yj, p.objective, K, p.alpha)
+                _sp.set_sync(h)
         if bagging:
             if it % p.bagging_freq == 0:
                 bag_mask = (rng.random(n) < p.bagging_fraction).astype(np.float32)
@@ -1004,21 +1076,27 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         if leafwise:
             from . import leafwise as lw
             if builder is not None:
-                tree = builder(bins_j, g, h, rm, fm, cat_j)
+                with telemetry.trace.span("gbdt/iter/build", tree=it,
+                                          mode="leafwise") as _sp:
+                    tree = builder(bins_j, g, h, rm, fm, cat_j)
+                    _sp.set_sync(tree)
                 S, f, t, W, IC, lv, node_tr = tree
                 lv = lv * lr_eff
             else:
-                raw, S, f, t, W, IC, lv, node_tr = _boost_step_leafwise(
-                    bins_j, raw, yj, rm, fm, cat_j,
-                    jnp.float32(lr_eff), p.alpha,
-                    num_leaves=p.num_leaves, n_bins=p.max_bin,
-                    lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
-                    min_child_weight=p.min_child_weight,
-                    min_split_gain=p.min_split_gain,
-                    cat_smooth=p.cat_smooth, max_depth=lw_depth,
-                    hist_impl=hist_impl, has_cats=bool(cat_arr.any()),
-                    objective=p.objective, num_class=K,
-                    update_raw=not is_rf)
+                with telemetry.trace.span("gbdt/iter/step", tree=it,
+                                          mode="leafwise") as _sp:
+                    raw, S, f, t, W, IC, lv, node_tr = _boost_step_leafwise(
+                        bins_j, raw, yj, rm, fm, cat_j,
+                        jnp.float32(lr_eff), p.alpha,
+                        num_leaves=p.num_leaves, n_bins=p.max_bin,
+                        lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                        min_child_weight=p.min_child_weight,
+                        min_split_gain=p.min_split_gain,
+                        cat_smooth=p.cat_smooth, max_depth=lw_depth,
+                        hist_impl=hist_impl, has_cats=bool(cat_arr.any()),
+                        objective=p.objective, num_class=K,
+                        update_raw=not is_rf)
+                    _sp.set_sync(raw)
             feats.append((S, f, t, W, IC))
             leaves.append(lv)
             # training rows' leaves are known from the grow: the raw update
@@ -1035,19 +1113,27 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             train_step_fn = lambda: _gather_tree_contrib(lv, node_tr)
         else:
             if builder is not None:
-                f, t, lv, node_tr = builder(bins_j, g, h, rm, fm)
+                with telemetry.trace.span("gbdt/iter/build", tree=it,
+                                          mode="levelwise") as _sp:
+                    f, t, lv, node_tr = builder(bins_j, g, h, rm, fm)
+                    _sp.set_sync(node_tr)
                 # rf leaves stay unscaled here; the 1/T average is applied
                 # at the end over the ACTUAL forest size
                 lv = lv * lr_eff
             else:
-                raw, f, t, lv, node_tr = _boost_step_level(
-                    bins_j, raw, yj, rm, fm, jnp.float32(lr_eff), p.alpha,
-                    depth=p.max_depth, n_bins=p.max_bin,
-                    lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
-                    min_child_weight=p.min_child_weight,
-                    min_split_gain=p.min_split_gain, hist_impl=hist_impl,
-                    objective=p.objective, num_class=K,
-                    update_raw=not is_rf)
+                with telemetry.trace.span("gbdt/iter/step", tree=it,
+                                          mode="levelwise") as _sp:
+                    raw, f, t, lv, node_tr = _boost_step_level(
+                        bins_j, raw, yj, rm, fm, jnp.float32(lr_eff),
+                        p.alpha,
+                        depth=p.max_depth, n_bins=p.max_bin,
+                        lambda_l2=p.lambda_l2, lambda_l1=p.lambda_l1,
+                        min_child_weight=p.min_child_weight,
+                        min_split_gain=p.min_split_gain,
+                        hist_impl=hist_impl,
+                        objective=p.objective, num_class=K,
+                        update_raw=not is_rf)
+                    _sp.set_sync(raw)
             feats.append(f)
             thrs.append(t)
             leaves.append(lv)
@@ -1062,11 +1148,19 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             train_step_fn = lambda: _gather_tree_contrib(lv, node_tr)
         if not is_rf and builder is not None:
             # serial paths already updated raw inside the fused step
-            raw = raw + train_step_fn()
+            with telemetry.trace.span("gbdt/iter/apply", tree=it) as _sp:
+                raw = raw + train_step_fn()
+                _sp.set_sync(raw)
+        _m_iters.inc()
+        _m_iter_time.observe(time.perf_counter() - t_iter)
 
         if p.early_stopping_round > 0:
-            raw_val = raw_val + step(bins_val_t)
+            t_eval = time.perf_counter()
+            with telemetry.trace.span("gbdt/eval", tree=it) as _sp:
+                raw_val = raw_val + step(bins_val_t)
+                _sp.set_sync(raw_val)
             cur = float(_loss(raw_val, y_val, p.objective, p.alpha))
+            _m_eval_time.observe(time.perf_counter() - t_eval)
             if nproc > 1:
                 # the stop decision must be identical fleet-wide: average
                 # the per-process validation losses (row-weighted)
@@ -1102,18 +1196,54 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         objective=p.objective)
 
 
+#: per-chunk node-test table budget for ensemble scoring: rows batch so
+#: the (table_nodes, chunk) bool staging stays under this many bytes
+#: (ADVICE r5 — unbatched 10M-row deep-tree predicts staged multi-GB)
+_PREDICT_TABLE_BYTES_CAP = 256 << 20
+
+
+def _predict_chunk_rows(n: int, table_nodes: int) -> int:
+    """Rows per scoring chunk keeping the test table under the byte cap
+    (1 byte per node-test per row); small calls stay a single dispatch."""
+    cap = max(4096, _PREDICT_TABLE_BYTES_CAP // max(1, table_nodes))
+    return n if n <= cap else cap
+
+
+def _predict_chunked(bins: np.ndarray, score_chunk, table_nodes: int
+                     ) -> np.ndarray:
+    """Shared row-batching driver: score fixed-size chunks (tail padded so
+    the jitted program compiles for ONE shape), record the peak test-table
+    estimate on the telemetry gauge."""
+    n = bins.shape[0]
+    chunk = _predict_chunk_rows(n, table_nodes)
+    _m_predict_table_bytes.set(table_nodes * min(max(n, 1), chunk))
+    if n <= chunk:
+        return score_chunk(bins)
+    outs = []
+    for lo in range(0, n, chunk):
+        part = bins[lo:lo + chunk]
+        m = len(part)
+        if m < chunk:   # pad the tail: one compiled shape for all chunks
+            part = np.concatenate(
+                [part, np.zeros((chunk - m,) + part.shape[1:], part.dtype)])
+        outs.append(score_chunk(part)[:m])
+    return np.concatenate(outs, axis=0)
+
+
 def predict_raw(ens, x: np.ndarray,
                 num_iteration: Optional[int] = None) -> np.ndarray:
     """Raw ensemble scores (n, K). Accepts level-wise TreeEnsemble or
-    leafwise.LeafwiseEnsemble."""
+    leafwise.LeafwiseEnsemble. Rows batch past the test-table byte cap
+    (_PREDICT_TABLE_BYTES_CAP) so deep/wide ensembles score huge inputs
+    at bounded HBM."""
     from .leafwise import LeafwiseEnsemble, predict_raw_lw
     if isinstance(ens, LeafwiseEnsemble):
-        bins = jnp.asarray(bin_data_auto(
+        bins = bin_data_auto(
             x, ens.bin_edges,
             ens.cat_features if ens.cat_features.any() else None,
-            ens.bin_edges.shape[1] + 1))
+            ens.bin_edges.shape[1] + 1)
         return predict_raw_lw(ens, bins, num_iteration)
-    bins = jnp.asarray(bin_data_auto(x, ens.bin_edges))
+    bins = bin_data_auto(x, ens.bin_edges)
     T, K, _ = ens.feature.shape
     depth = int(np.log2(ens.leaf.shape[2]))
     T = min(T, num_iteration) if num_iteration else T
@@ -1132,8 +1262,13 @@ def predict_raw(ens, x: np.ndarray,
         raw, _ = jax.lax.scan(body, init, (feature, threshold, leaf))
         return raw
 
-    return np.asarray(run(bins, ens.feature[:T], ens.threshold[:T],
-                          ens.leaf[:T]))
+    nodes = 2 ** depth - 1
+    table_nodes = nodes if nodes <= _TEST_TABLE_MAX_NODES else 64
+    return _predict_chunked(
+        np.asarray(bins),
+        lambda part: np.asarray(run(jnp.asarray(part), ens.feature[:T],
+                                    ens.threshold[:T], ens.leaf[:T])),
+        table_nodes)
 
 
 def prob_from_raw(objective: str, raw: np.ndarray) -> np.ndarray:

@@ -325,6 +325,13 @@ def make_sharded_builder_lw(mesh, *, num_leaves, n_bins, lambda_l2,
     return jax.jit(fn)
 
 
+#: precomputed (L-1, n) test tables stop at this many splits: a 4096-leaf
+#: tree scoring millions of rows would stage multi-GB tables (ADVICE r5);
+#: wider trees replay with per-round on-the-fly row DMAs instead
+#: (mirrors engine._TEST_TABLE_MAX_NODES).
+_TEST_TABLE_MAX_SPLITS = 255
+
+
 def _tree_tests_lw(bins_t, F, T, W, IC, has_cats: bool = True):
     """All of one tree's split tests in one shot: (L-1, n) bool.
 
@@ -370,9 +377,40 @@ def _replay_lw(tests, S, leaf):
     return leaf[pos]
 
 
+def _replay_lw_streaming(bins_t, S, F, T, W, IC, leaf,
+                         has_cats: bool = True):
+    """Replay WITHOUT the test table: each round DMAs its one split
+    feature's row from bins_t inside the scan — O(n) live memory however
+    many leaves the tree has (the memory guard for trees past
+    _TEST_TABLE_MAX_SPLITS). Still a contiguous row read per round (the
+    round-5 transposed-matrix win), just not batched across rounds."""
+    n = bins_t.shape[1]
+    L1 = S.shape[0]
+
+    def body(pos, xs):
+        new_id, s, f, t, w, ic = xs
+        rb = jnp.take(bins_t, f, axis=0).astype(jnp.int32)     # (n,)
+        test = rb > t
+        if has_cats:
+            word = w[(rb >> 5)]
+            cat_t = ((word >> (rb & 31).astype(jnp.uint32))
+                     & jnp.uint32(1)) == 1
+            test = jnp.where(ic, cat_t, test)
+        right = (pos == s) & (s >= 0) & test
+        return jnp.where(right, new_id, pos), None
+
+    pos, _ = jax.lax.scan(
+        body, jnp.zeros(n, jnp.int32),
+        (jnp.arange(1, L1 + 1, dtype=jnp.int32), S, F, T, W, IC))
+    return leaf[pos]
+
+
 @functools.partial(jax.jit, static_argnames=("has_cats",))
 def predict_tree_lw_t(bins_t, S, F, T, W, IC, leaf, has_cats: bool = True):
     """One tree's predictions from the TRANSPOSED bin matrix (d, n)."""
+    if S.shape[0] > _TEST_TABLE_MAX_SPLITS:
+        return _replay_lw_streaming(bins_t, S, F, T, W, IC, leaf,
+                                    has_cats=has_cats)
     return _replay_lw(_tree_tests_lw(bins_t, F, T, W, IC,
                                      has_cats=has_cats), S, leaf)
 
@@ -388,7 +426,10 @@ def predict_tree_lw(bins, S, F, T, W, IC, leaf, has_cats: bool = True):
 
 def predict_raw_lw(ens: LeafwiseEnsemble, bins,
                    num_iteration: Optional[int] = None) -> np.ndarray:
-    """Raw scores (n, K) for a leaf-wise ensemble from binned features."""
+    """Raw scores (n, K) for a leaf-wise ensemble from binned features.
+    Rows batch past the test-table byte cap (engine._predict_chunked) so
+    wide-leaf ensembles score huge inputs at bounded HBM."""
+    from .engine import _predict_chunked
     T, K = ens.feature.shape[:2]
     T = min(T, num_iteration) if num_iteration else T
 
@@ -409,6 +450,12 @@ def predict_raw_lw(ens: LeafwiseEnsemble, bins,
         raw, _ = jax.lax.scan(body, init, (S, F, Th, W, IC, leaf))
         return raw
 
-    return np.asarray(run(bins, ens.split_leaf[:T], ens.feature[:T],
-                          ens.threshold[:T], ens.cat_bitset[:T],
-                          ens.is_cat[:T], ens.leaf[:T]))
+    splits = int(ens.split_leaf.shape[2])
+    table_nodes = splits if splits <= _TEST_TABLE_MAX_SPLITS else 1
+    return _predict_chunked(
+        np.asarray(bins),
+        lambda part: np.asarray(run(jnp.asarray(part), ens.split_leaf[:T],
+                                    ens.feature[:T], ens.threshold[:T],
+                                    ens.cat_bitset[:T], ens.is_cat[:T],
+                                    ens.leaf[:T])),
+        table_nodes)

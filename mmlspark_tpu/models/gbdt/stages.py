@@ -89,6 +89,20 @@ class _BoosterParams:
                        n_rows: int = None) -> engine.GBDTParams:
         leafwise = self._effective_leafwise(n_rows=n_rows,
                                             categorical=bool(categorical))
+        if (not leafwise and self.getOrDefault("growthPolicy") == "auto"
+                and self._tree_learner() != "feature"):
+            # runtime visibility for the silent policy switch (ADVICE r5,
+            # mirroring the feature-parallel downgrade log): trees will be
+            # balanced 2^depth-leaf, not LightGBM's best-first numLeaves
+            from ...core.utils import get_logger
+            from . import engine as _engine
+            get_logger("gbdt").info(
+                "growthPolicy=auto: routing this %s-row pure-default fit "
+                "to depthwise growth (balanced 2^%d-leaf trees, ~10x "
+                "faster per tree at this scale); set "
+                "growthPolicy='leafwise' for native LightGBM best-first "
+                "trees", n_rows, self._depth())
+            _engine._m_auto_depthwise.inc()
         if not leafwise and self.getOrDefault("growthPolicy") == "leafwise":
             # feature-parallel split candidates are level-wise only
             from ...core.utils import get_logger

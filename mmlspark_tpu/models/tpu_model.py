@@ -22,8 +22,35 @@ from ..core.params import (ComplexParam, DictParam, IntParam, ListParam,
                            StringParam)
 from ..core.pipeline import Transformer
 from ..core.schema import image_to_array, is_image_column
-from ..core.utils import to_float32_matrix
+from ..core.utils import get_logger, to_float32_matrix
 from ..parallel import mesh as meshlib
+from .. import telemetry
+
+log = get_logger("tpu_model")
+
+
+def _coerce_wire_dtype(x: np.ndarray) -> np.ndarray:
+    """Cast an unsupported transfer dtype onto the wire table (int -> int32,
+    else float32) — with a range check and a one-time warning instead of
+    the previous silent cast (ADVICE r5): int64 feature values beyond the
+    int32 range would otherwise be silently corrupted, and float64 inputs
+    lose precision without a trace."""
+    if np.issubdtype(x.dtype, np.integer):
+        info = np.iinfo(np.int32)
+        if x.size and (x.min() < info.min or x.max() > info.max):
+            raise ValueError(
+                f"{x.dtype} feature values exceed the int32 transfer range "
+                f"[{info.min}, {info.max}]; rescale or re-index them "
+                f"before scoring (the device wire format is int32)")
+        tgt = np.int32
+    else:
+        tgt = np.float32
+    telemetry.warn_once(
+        log, "wire-dtype-downcast",
+        "input dtype %s is not a device wire format; casting to %s "
+        "(precision beyond %s is dropped — cast explicitly to silence "
+        "this)", x.dtype, np.dtype(tgt).name, np.dtype(tgt).name)
+    return x.astype(tgt)
 
 
 def _next_pow2(n: int) -> int:
@@ -369,8 +396,8 @@ class TpuModel(Transformer):
                 # the wire table covers the supported transfer dtypes; cast
                 # anything else (f64/i64 reaching transform) like the
                 # single-host path accepts instead of an opaque index error
-                x = x.astype(np.int32 if np.issubdtype(x.dtype, np.integer)
-                             else np.float32)
+                # — range-checked and warned, never silent (ADVICE r5)
+                x = _coerce_wire_dtype(x)
             meta[1] = x.ndim - 1
             meta[2:2 + x.ndim - 1] = x.shape[1:]
             meta[-1] = dtypes.index(np.dtype(x.dtype))

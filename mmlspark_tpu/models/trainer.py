@@ -33,10 +33,41 @@ from ..core.pipeline import Estimator
 from ..core.utils import get_logger, to_float32_matrix
 from ..parallel import mesh as meshlib
 from ..parallel import sequence
+from .. import telemetry
 from .modules import TOKEN_MODELS, build_model
 from .tpu_model import TpuModel, _prep_input
 
 log = get_logger("trainer")
+
+# runtime telemetry (off-by-default no-ops; MMLSPARK_TPU_TELEMETRY=1)
+_m_step_time = telemetry.registry.histogram(
+    "mmlspark_trainer_step_seconds",
+    "wall time per optimizer dispatch (one step on the feed path, a "
+    "stepsPerDispatch window on the scan path)")
+_m_rows_per_sec = telemetry.registry.gauge(
+    "mmlspark_trainer_rows_per_sec",
+    "training throughput over the last epoch (rows == imgs for image fits)")
+_m_recompiles = telemetry.registry.counter(
+    "mmlspark_trainer_recompiles",
+    "train-step dispatches whose abstract (shape, dtype) signature was "
+    "not seen before in this process — each is an XLA compile")
+_m_transfer_bytes = telemetry.registry.counter(
+    "mmlspark_trainer_transfer_bytes",
+    "host->device bytes shipped by the trainer (epoch uploads + per-step "
+    "batch feeds)")
+
+#: abstract-shape signatures already dispatched (recompile detection)
+_seen_step_sigs: set = set()
+
+
+def _note_step_signature(tag: str, *arrays):
+    """Count a recompile when this (tag, shapes, dtypes) signature is new —
+    the same key jit uses for its compilation cache, observed host-side."""
+    sig = (tag,) + tuple((np.shape(a), str(getattr(a, "dtype", type(a))))
+                         for a in arrays)
+    if sig not in _seen_step_sigs:
+        _seen_step_sigs.add(sig)
+        _m_recompiles.inc()
 
 
 def make_optimizer(name: str, lr: float, momentum: float = 0.9,
@@ -613,7 +644,9 @@ class TpuLearner(Estimator):
         import contextlib
         guard = (meshlib.collective_fit_lock if mesh.size > 1
                  else contextlib.nullcontext())
-        with guard:
+        with guard, telemetry.trace.span(
+                "fit", model=cfg.get("type"), rows=n,
+                path="scan" if scan_fn is not None else "feed"):
             params, opt_state, last_loss = self._run_epochs(
                 start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
                 nproc=nproc, train_step=train_step, params=params,
@@ -757,11 +790,16 @@ class TpuLearner(Estimator):
                             [yb, np.zeros(target - n, yb.dtype)])
                     wb = np.zeros(target, dtype=np.float32)
                     wb[:n] = 1.0
-                    params, opt_state, loss = train_step(
-                        params, opt_state,
-                        meshlib.put_global_batch(xb, mesh),
-                        meshlib.put_global_batch(yb, mesh),
-                        meshlib.put_global_batch(wb, mesh))
+                    if telemetry.enabled():
+                        _note_step_signature("stream", xb, yb, wb)
+                        _m_transfer_bytes.inc(xb.nbytes + yb.nbytes
+                                              + wb.nbytes)
+                    with _m_step_time.time():
+                        params, opt_state, loss = train_step(
+                            params, opt_state,
+                            meshlib.put_global_batch(xb, mesh),
+                            meshlib.put_global_batch(yb, mesh),
+                            meshlib.put_global_batch(wb, mesh))
                     steps_run += 1
                     if n:
                         n_batches += 1
@@ -788,8 +826,10 @@ class TpuLearner(Estimator):
                                          order_rng=order_rng, mesh=mesh,
                                          scan_fn=scan_fn, params=params,
                                          opt_state=opt_state)
+        import time
         last_loss = None
         for epoch in range(start_epoch, self.getEpochs()):
+            t_epoch = time.perf_counter()
             order = (order_rng.permutation(n) if self.getShuffle()
                      else np.arange(n))
             micro = self.getPipelineParallel()
@@ -815,12 +855,22 @@ class TpuLearner(Estimator):
                     yb = _wrap_rows(yb, tgt)
                 wb = np.zeros(len(xb), dtype=np.float32)
                 wb[:nb] = 1.0
+                if telemetry.enabled():
+                    _note_step_signature("feed", xb, yb, wb)
+                    _m_transfer_bytes.inc(xb.nbytes + yb.nbytes + wb.nbytes)
                 xb = meshlib.put_global_batch(xb, mesh)
                 yb = meshlib.put_global_batch(yb, mesh)
                 wb = meshlib.put_global_batch(wb, mesh)
-                params, opt_state, loss = train_step(params, opt_state,
-                                                     xb, yb, wb)
+                t_step = time.perf_counter()
+                with telemetry.trace.span("fit/step", epoch=epoch,
+                                          step=s) as sp:
+                    params, opt_state, loss = train_step(params, opt_state,
+                                                         xb, yb, wb)
+                    sp.set_sync(loss)
+                _m_step_time.observe(time.perf_counter() - t_step)
             last_loss = float(loss)
+            _m_rows_per_sec.set(steps * bs
+                                / max(time.perf_counter() - t_epoch, 1e-9))
             log.info("epoch %d loss %.4f", epoch, last_loss)
             if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
                 last_good = self._latest_checkpoint() \
@@ -871,14 +921,20 @@ class TpuLearner(Estimator):
             return np.concatenate([ap, ap[:bs_pad]], axis=0)
 
         def upload(xa, ya):
-            return (meshlib.shard_batch(margin(xa), mesh),
-                    meshlib.shard_batch(margin(ya), mesh))
+            if telemetry.enabled():
+                _m_transfer_bytes.inc(xa.nbytes + ya.nbytes)
+            with telemetry.trace.span("fit/upload",
+                                      bytes=int(xa.nbytes + ya.nbytes)):
+                return (meshlib.shard_batch(margin(xa), mesh),
+                        meshlib.shard_batch(margin(ya), mesh))
         x_dev, y_dev = (None, None) if reshuffle else upload(x, y)
         w_dev = meshlib.shard_batch(margin(w_all), mesh)
         kpd = self.getStepsPerDispatch() or steps
         base = np.arange(steps, dtype=np.int32) * bs_pad
         last_loss = None
+        import time
         for epoch in range(start_epoch, self.getEpochs()):
+            t_epoch = time.perf_counter()
             if reshuffle:
                 perm = order_rng.permutation(n)
                 x_dev, y_dev = upload(x[perm], y[perm])
@@ -889,11 +945,22 @@ class TpuLearner(Estimator):
                     .astype(np.int32)
             else:
                 starts = base
-            for lo in range(0, steps, kpd):
-                params, opt_state, loss = scan_fn(
-                    params, opt_state, x_dev, y_dev, w_dev,
-                    starts[lo:lo + kpd])
+            with telemetry.trace.span("fit/epoch", epoch=epoch,
+                                      path="scan") as ep_sp:
+                for lo in range(0, steps, kpd):
+                    t_disp = time.perf_counter()
+                    with telemetry.trace.span(
+                            "fit/step", epoch=epoch, first_step=lo,
+                            steps=min(kpd, steps - lo)) as sp:
+                        params, opt_state, loss = scan_fn(
+                            params, opt_state, x_dev, y_dev, w_dev,
+                            starts[lo:lo + kpd])
+                        sp.set_sync(loss)
+                    _m_step_time.observe(time.perf_counter() - t_disp)
+                ep_sp.set_sync(loss)
             last_loss = float(loss)
+            _m_rows_per_sec.set(steps * bs_pad
+                                / max(time.perf_counter() - t_epoch, 1e-9))
             log.info("epoch %d loss %.4f (%d-step dispatches)",
                      epoch, last_loss, min(kpd, steps))
             if self.getHaltOnNonFinite() and not np.isfinite(last_loss):

@@ -578,11 +578,14 @@ def node_sums(node, g, h, n_ids: int, impl: str = "auto"):
     to segment_sum when the (N, n_ids) f32 one-hot staging would exceed
     ~2 GB of HBM (e.g. 10M rows x 256 leaves = 10 GB — the budget keeps
     the 10M x 32-leaf BASELINE shape on the matmul path) — correct either
-    way. ``impl="segment"`` forces segment_sum so hist_impl="segment"
-    fits keep bit-reproducing pre-round-5 ensembles (summation order
-    differs between the two reductions).
+    way. Every PINNED hist_impl ("segment", "compare", "pallas") forces
+    segment_sum: those knobs select the histogram build, and their
+    pre-round-5 leaf sums were all segment_sum — pinning exists to
+    bit-reproduce older ensembles, so the leaf reduction order must not
+    drift under them (ADVICE r5; only "auto"/"mxu" ride the matmul).
     node (N,) int32; returns (lg, lh), each (n_ids,) f32."""
-    if impl == "segment" or node.shape[0] * n_ids * 4 > (2 << 30):
+    if impl in ("segment", "compare", "pallas") \
+            or node.shape[0] * n_ids * 4 > (2 << 30):
         return (jax.ops.segment_sum(g, node, num_segments=n_ids),
                 jax.ops.segment_sum(h, node, num_segments=n_ids))
     oh = (node[:, None] == jnp.arange(n_ids, dtype=node.dtype)

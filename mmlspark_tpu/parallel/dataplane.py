@@ -36,8 +36,18 @@ import numpy as np
 from ..core.dataframe import (DataFrame, GroupedData, _NULL_SENTINEL,
                               _copy_meta, _gather_with_nulls, _hashable)
 from ..core.utils import get_logger, object_column
+from .. import telemetry
 
 log = get_logger("dataplane")
+
+# fleet-collective telemetry: every host-side allgather/allreduce the data
+# plane runs (pooled statistics, distinct/groupBy merges, stream lockstep)
+_m_collective_bytes = telemetry.registry.counter(
+    "mmlspark_dataplane_collective_bytes",
+    "payload bytes this process contributed to host collectives")
+_m_collectives = telemetry.registry.counter(
+    "mmlspark_dataplane_collectives",
+    "host collective operations issued (allgather_bytes calls)")
 
 
 def nprocs() -> int:
@@ -66,14 +76,17 @@ def allgather_bytes(payload: bytes) -> list[bytes]:
     collectives: lengths, then right-padded buffers)."""
     if nprocs() == 1:
         return [payload]
+    _m_collectives.inc()
+    _m_collective_bytes.inc(len(payload))
     from jax.experimental import multihost_utils
-    lens = multihost_utils.process_allgather(
-        np.asarray(len(payload), np.int64))
-    buf = np.frombuffer(payload, dtype=np.uint8)
-    pad = int(lens.max()) - len(buf)
-    if pad:
-        buf = np.concatenate([buf, np.zeros(pad, np.uint8)])
-    bufs = multihost_utils.process_allgather(buf)
+    with telemetry.trace.span("dataplane/allgather", bytes=len(payload)):
+        lens = multihost_utils.process_allgather(
+            np.asarray(len(payload), np.int64))
+        buf = np.frombuffer(payload, dtype=np.uint8)
+        pad = int(lens.max()) - len(buf)
+        if pad:
+            buf = np.concatenate([buf, np.zeros(pad, np.uint8)])
+        bufs = multihost_utils.process_allgather(buf)
     return [bufs[i, :int(lens[i])].tobytes() for i in range(len(lens))]
 
 

@@ -19,11 +19,31 @@ additional axes (pipeline/sequence/expert) compose the same way.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import telemetry
+
+# host->HBM placement telemetry: every sharded batch/replicated-tree put
+# made through this module (trainer feeds, GBDT bin uploads, serving
+# batches). No-ops unless MMLSPARK_TPU_TELEMETRY=1.
+_m_put_bytes = telemetry.registry.counter(
+    "mmlspark_mesh_put_bytes",
+    "host bytes handed to device placement (shard_batch/put_global_batch)")
+_m_put_seconds = telemetry.registry.histogram(
+    "mmlspark_mesh_put_seconds",
+    "wall time of one device placement call (dispatch side — transfers "
+    "may complete asynchronously)")
+
+
+def _observe_put(t0: float, tree):
+    _m_put_seconds.observe(time.perf_counter() - t0)
+    _m_put_bytes.inc(sum(getattr(a, "nbytes", 0)
+                         for a in jax.tree_util.tree_leaves(tree)))
 
 # Collectives issued concurrently from multiple host threads can interleave
 # across the same devices and deadlock (each device waits on a different
@@ -147,11 +167,23 @@ def shard_batch(arrays, mesh: Mesh, batch_axis: str = "data"):
     re-ships committed buffers per dispatch, and NamedShardings force jit
     through the SPMD partitioner) — and a 1-device sharding is
     semantically a no-op anyway."""
+    if not telemetry.enabled():
+        if mesh.size == 1:
+            import jax.numpy as jnp
+            return jax.tree_util.tree_map(jnp.asarray, arrays)
+        sh = batch_sharding(mesh, batch_axis)
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh),
+                                      arrays)
+    t0 = time.perf_counter()
     if mesh.size == 1:
         import jax.numpy as jnp
-        return jax.tree_util.tree_map(jnp.asarray, arrays)
-    sh = batch_sharding(mesh, batch_axis)
-    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), arrays)
+        out = jax.tree_util.tree_map(jnp.asarray, arrays)
+    else:
+        sh = batch_sharding(mesh, batch_axis)
+        out = jax.tree_util.tree_map(lambda a: jax.device_put(a, sh),
+                                     arrays)
+    _observe_put(t0, arrays)
+    return out
 
 
 def _pad_rows_to_multiple(arr: np.ndarray, mult: int) -> tuple[np.ndarray, int]:
@@ -201,13 +233,26 @@ def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
     array is assembled from every process's shard (the reference has no
     analog — its data stays in Spark partitions and is shipped per-worker
     over scp/JNI, CommandBuilders.scala:200-228)."""
+    if not telemetry.enabled():
+        if effective_process_count() == 1:
+            if mesh.size == 1:  # trivial mesh: stay off the SPMD path
+                import jax.numpy as jnp
+                return jnp.asarray(arr)
+            return jax.device_put(arr, batch_sharding(mesh, batch_axis))
+        return jax.make_array_from_process_local_data(
+            batch_sharding(mesh, batch_axis), np.asarray(arr))
+    t0 = time.perf_counter()
     if effective_process_count() == 1:
-        if mesh.size == 1:  # trivial mesh: stay off the SPMD path
+        if mesh.size == 1:
             import jax.numpy as jnp
-            return jnp.asarray(arr)
-        return jax.device_put(arr, batch_sharding(mesh, batch_axis))
-    return jax.make_array_from_process_local_data(
-        batch_sharding(mesh, batch_axis), np.asarray(arr))
+            out = jnp.asarray(arr)
+        else:
+            out = jax.device_put(arr, batch_sharding(mesh, batch_axis))
+    else:
+        out = jax.make_array_from_process_local_data(
+            batch_sharding(mesh, batch_axis), np.asarray(arr))
+    _observe_put(t0, arr)
+    return out
 
 
 def put_replicated(tree, mesh: Mesh):

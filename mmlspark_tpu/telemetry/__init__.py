@@ -1,0 +1,91 @@
+"""Runtime telemetry: metrics registry, span tracing, exposition.
+
+The observability backbone (reference: the MMLSpark ``core/metrics`` layer,
+PAPER.md §1) every subsystem reports through — trainer step timing, GBDT
+iteration breakdowns, dataplane transfer volume, serving fleet latency.
+
+Usage::
+
+    from mmlspark_tpu import telemetry
+    _steps = telemetry.registry.counter("mmlspark_trainer_steps_total")
+    ...
+    _steps.inc()
+    with telemetry.trace.span("fit/step", step=i, sync=loss):
+        ...
+
+Off by default: a disabled metric mutator is one attribute lookup + return,
+a disabled span is a shared no-op context manager. Enable globally with the
+``MMLSPARK_TPU_TELEMETRY=1`` environment switch (read via
+``core.env.telemetry_enabled`` at import) or ``telemetry.enable()`` at
+runtime. ``MMLSPARK_TPU_TRACE=/path/file.jsonl`` additionally exports the
+span buffer as Chrome-trace JSON-lines at interpreter exit.
+
+Scraping: the HTTP serving layer (io/http) exposes this process's registry
+at ``GET /metrics`` in Prometheus text format; ``snapshot()`` returns the
+JSON form bench tooling embeds next to its metric lines.
+"""
+
+from __future__ import annotations
+
+from .registry import (DEFAULT_TIME_BUCKETS, REGISTRY, Counter, Gauge,
+                       Histogram, MetricsRegistry, pow2_buckets, _state)
+from .tracer import TRACER, Tracer
+
+#: process-global singletons — the module-level API
+registry = REGISTRY
+trace = TRACER
+
+__all__ = ["registry", "trace", "enabled", "enable", "disable",
+           "snapshot", "prometheus_text", "warn_once",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
+           "DEFAULT_TIME_BUCKETS", "pow2_buckets"]
+
+
+def enabled() -> bool:
+    return _state.enabled
+
+
+def enable():
+    _state.enabled = True
+
+
+def disable():
+    _state.enabled = False
+
+
+def snapshot() -> dict:
+    return registry.snapshot()
+
+
+def prometheus_text() -> str:
+    return registry.prometheus_text()
+
+
+_warned_keys: set = set()
+_warnings = registry.counter(
+    "mmlspark_warnings_total",
+    "one-time-logged warning occurrences by key", labels=("key",))
+
+
+def warn_once(logger, key: str, msg: str, *args):
+    """Log ``msg`` at WARNING once per ``key`` per process; bump the
+    ``mmlspark_warnings_total{key=...}`` counter on EVERY occurrence (the
+    log dedupes, the metric keeps counting — silent-after-first events
+    stay visible on a dashboard)."""
+    _warnings.labels(key=key).inc()
+    if key not in _warned_keys:
+        _warned_keys.add(key)
+        logger.warning(msg, *args)
+
+
+def _init_from_env():
+    from ..core.env import telemetry_enabled, telemetry_trace_path
+    if telemetry_enabled():
+        enable()
+    path = telemetry_trace_path()
+    if path:
+        import atexit
+        atexit.register(lambda: trace.export_chrome_trace(path))
+
+
+_init_from_env()
