@@ -15,10 +15,31 @@ was measured at ~3x a train step on v5e (near-scalar for 1-byte rows), so
 shuffling is rotation+window-permutation instead — see ROOFLINE.md.
 """
 
+import glob
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _baseline_value(metric: str):
+    """Most recent prior measurement of ``metric`` from the BENCH_r*.json
+    trajectory next to this script (None when no round has recorded it) —
+    lets every run print its ratio vs. the last round."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        try:
+            doc = json.loads(open(path).read())
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") or {}
+        if parsed.get("metric") == metric and parsed.get("value"):
+            key = int(doc.get("n", 0))
+            if best is None or key > best[0]:
+                best = (key, float(parsed["value"]))
+    return best[1] if best else None
 
 
 def main():
@@ -81,11 +102,14 @@ def main():
 
     # the batch shards over every attached chip -> divide for per-chip
     imgs_per_sec = n_dispatch * k_steps * batch / dt / mesh.size
+    metric = "cifar10_resnet20_train_imgs_per_sec_per_chip"
+    base = _baseline_value(metric)
     print(json.dumps({
-        "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
+        "metric": metric,
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/sec/chip",
-        "vs_baseline": None,
+        "vs_baseline": (round(imgs_per_sec / base, 3)
+                        if base else None),
     }))
     if telemetry.enabled():
         # second line: the step-breakdown context future BENCH_*.json

@@ -17,7 +17,12 @@ import numpy as np
 
 
 class _ImageScorer:
-    """(id, value) -> reply: decode base64 uint8 image batch, score."""
+    """(id, value) -> reply: decode base64 uint8 image batch, score.
+
+    ``prepare`` (the per-row base64 decode + feature assembly) is split
+    from ``transform`` (the pjit score) so the serving loop's prefetch
+    thread decodes the NEXT micro-batch while the current one runs on
+    device."""
 
     def __init__(self):
         import jax
@@ -35,13 +40,16 @@ class _ImageScorer:
             [np.zeros(32 * 32 * 3, np.float32)])})
         self.model.warmup(ex, max_rows=256)  # no request pays a compile
 
-    def transform(self, df):
+    def prepare(self, df):
         from mmlspark_tpu.core.utils import object_column
         imgs = [np.frombuffer(base64.b64decode(v), dtype=np.uint8)
                 .reshape(32, 32, 3).astype(np.float32).ravel()
                 for v in df.col("value")]
-        scored = self.model.transform(
-            df.withColumn("features", object_column(imgs)))
+        return df.withColumn("features", object_column(imgs))
+
+    def transform(self, df):
+        from mmlspark_tpu.core.utils import object_column
+        scored = self.model.transform(df)
         replies = [json.dumps({"label": int(np.argmax(s))})
                    for s in scored.col("scores")]
         return scored.withColumn("reply", object_column(replies))
@@ -55,7 +63,9 @@ def main():
     payload = base64.b64encode(
         rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
 
-    source, loop = serve_pipeline(_ImageScorer(), max_batch=256)
+    scorer = _ImageScorer()
+    source, loop = serve_pipeline(scorer, max_batch=256,
+                                  prepare=scorer.prepare)
     try:
         # warmup (compile)
         r = requests.post(source.url, data=payload, timeout=120)

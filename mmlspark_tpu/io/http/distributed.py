@@ -138,11 +138,15 @@ class DistributedServingLoop(ServingLoop):
 
 def serve_distributed(transformer, n_workers: int = 2,
                       host: str = "127.0.0.1", base_port: int = 0,
-                      max_batch: int = 1024):
+                      max_batch: int = 1024, prefetch_depth: int = 2,
+                      prepare=None):
     """Spin up the worker fleet + loop; returns (source, loop). One
     transformer call (one pjit dispatch) serves every worker's in-flight
-    requests per micro-batch."""
+    requests per micro-batch; the next micro-batch drains (and optionally
+    ``prepare``s) on the loop's prefetch thread meanwhile."""
     source = DistributedHTTPSource(n_workers=n_workers, host=host,
                                    base_port=base_port)
-    loop = DistributedServingLoop(source, transformer, max_batch).start()
+    loop = DistributedServingLoop(source, transformer, max_batch,
+                                  prefetch_depth=prefetch_depth,
+                                  prepare=prepare).start()
     return source, loop

@@ -290,44 +290,69 @@ class ReplayServingLoop:
     offset range: poll -> getBatch -> transform -> reply -> commit. A
     transform failure REPLAYS the same batch once (same rows, by the source
     contract) before failing the clients with 500s — crash recovery the
-    single-process loop can't offer."""
+    single-process loop can't offer.
+
+    With ``prefetch_depth >= 1`` (default 2) the worker polling (one
+    control round-trip per live worker) and the offset-range batch
+    assembly run on a prefetch thread WHILE the current batch's transform
+    (the pjit step) executes — the fleet's slowest host phase moves off
+    the critical path. Replay semantics are unchanged: the prefetched
+    ranges are disjoint and only committed by the consumer after
+    processing, and a retry re-reads its range from the replay-stable
+    offset log."""
 
     def __init__(self, source: ProcessHTTPSource, transformer,
-                 max_retries: int = 1):
+                 max_retries: int = 1, prefetch_depth: int = 2):
         self.source = source
         self.sink = HTTPSink(source)
         self.transformer = transformer
         self.max_retries = max_retries
+        self.prefetch_depth = prefetch_depth
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
-    def _run(self):
+    def _polled(self):
+        """Producer: advance the offset log and assemble each new range's
+        batch ahead of the consumer. Ranges are disjoint and monotonic;
+        the consumer commits them in the same order."""
+        start = self.source.committedOffset()
         while not self._stop.is_set():
-            start = self.source.committedOffset()
             end = self.source.getOffset()
             if end == start:
                 time.sleep(0.005)
                 continue
-            for attempt in range(self.max_retries + 1):
-                batch = self.source.getBatch(start, end)  # replay-stable
-                _m_batch_rows.observe(batch.count())
-                try:
-                    with telemetry.trace.span("fleet/batch",
-                                              rows=batch.count(),
-                                              attempt=attempt):
-                        out = self.transformer.transform(batch)
-                        self.sink.addBatch(out)
-                    break
-                except Exception as e:
-                    log.warning("batch (%d, %d] attempt %d failed: %s",
-                                start, end, attempt, e)
-                    if attempt == self.max_retries:
-                        for ex_id in batch.col("id"):
-                            self.source.respond(
-                                str(ex_id), 500,
-                                json.dumps({"error": str(e)}))
-            self.source.flush()
-            self.source.commit(end)
+            yield start, end, self.source.getBatch(start, end)
+            start = end
+
+    def _run(self):
+        from ...parallel import prefetch as prefetchlib
+        it = prefetchlib.prefetched(self._polled, depth=self.prefetch_depth,
+                                    name="fleet", span="fleet/prefetch")
+        try:
+            for start, end, batch in it:
+                for attempt in range(self.max_retries + 1):
+                    if attempt:  # replay-stable re-read until commit
+                        batch = self.source.getBatch(start, end)
+                    _m_batch_rows.observe(batch.count())
+                    try:
+                        with telemetry.trace.span("fleet/batch",
+                                                  rows=batch.count(),
+                                                  attempt=attempt):
+                            out = self.transformer.transform(batch)
+                            self.sink.addBatch(out)
+                        break
+                    except Exception as e:
+                        log.warning("batch (%d, %d] attempt %d failed: %s",
+                                    start, end, attempt, e)
+                        if attempt == self.max_retries:
+                            for ex_id in batch.col("id"):
+                                self.source.respond(
+                                    str(ex_id), 500,
+                                    json.dumps({"error": str(e)}))
+                self.source.flush()
+                self.source.commit(end)
+        finally:
+            it.close()
 
     def start(self):
         self._thread.start()
@@ -340,11 +365,12 @@ class ReplayServingLoop:
 
 
 def serve_fleet(transformer, n_workers: int = 2, host: str = "127.0.0.1",
-                base_port: int = 0):
+                base_port: int = 0, prefetch_depth: int = 2):
     """Spawn the worker fleet + replay loop; returns (source, loop). One
     transformer call per micro-batch serves every worker process's
     in-flight requests."""
     source = ProcessHTTPSource(n_workers=n_workers, host=host,
                                base_port=base_port)
-    loop = ReplayServingLoop(source, transformer).start()
+    loop = ReplayServingLoop(source, transformer,
+                             prefetch_depth=prefetch_depth).start()
     return source, loop

@@ -77,7 +77,7 @@ def bind_with_probing(host: str, port: int, handler,
 class _Exchange:
     """One in-flight request awaiting a reply (the HttpExchange analog)."""
 
-    __slots__ = ("id", "value", "event", "code", "body")
+    __slots__ = ("id", "value", "event", "code", "body", "picked")
 
     def __init__(self, value: str):
         self.id = uuid.uuid4().hex
@@ -85,6 +85,7 @@ class _Exchange:
         self.event = threading.Event()
         self.code = 500
         self.body = b""
+        self.picked = False    # drained by getBatch (queue-depth bookkeeping)
 
 
 class HTTPSource:
@@ -96,6 +97,13 @@ class HTTPSource:
         self._pending: "queue.Queue[_Exchange]" = queue.Queue()
         self._inflight: dict[str, _Exchange] = {}
         self._lock = threading.Lock()
+        # live requests awaiting batch pickup. NOT _pending.qsize(): a
+        # timed-out client's exchange lingers in the queue until a later
+        # drain discards it, and qsize would keep reporting that dead work
+        # as depth. Incremented on enqueue, decremented exactly once —
+        # either when getBatch picks the exchange or when its client's
+        # wait times out unpicked.
+        self._n_pending = 0
         source = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -109,12 +117,16 @@ class HTTPSource:
                 ex = _Exchange(body)
                 with source._lock:
                     source._inflight[ex.id] = ex
+                    source._n_pending += 1
+                    _m_queue_depth.set(source._n_pending)
                 source._pending.put(ex)
-                _m_queue_depth.set(source._pending.qsize())
                 if not ex.event.wait(timeout=source.reply_timeout):
                     self.send_error(504, "batch processing timed out")
                     with source._lock:
                         source._inflight.pop(ex.id, None)
+                        if not ex.picked:   # abandoned while still queued
+                            source._n_pending -= 1
+                        _m_queue_depth.set(source._n_pending)
                     _m_replies.labels(code="504").inc()
                     return
                 self.send_response(ex.code)
@@ -168,13 +180,18 @@ class HTTPSource:
                 ex = self._pending.get(timeout=wait)
                 # a client whose wait timed out was dropped from _inflight;
                 # its exchange is dead — don't hand it to the pipeline
+                # (its pending-depth slot was released at abandon time)
                 with self._lock:
                     alive = ex.id in self._inflight
+                    if alive:
+                        ex.picked = True
+                        self._n_pending -= 1
                 if alive:
                     rows.append(ex)
         except queue.Empty:
             pass
-        _m_queue_depth.set(self._pending.qsize())
+        with self._lock:
+            _m_queue_depth.set(self._n_pending)
         if not rows:
             return DataFrame({"id": np.array([], dtype=object),
                               "value": np.array([], dtype=object)})
@@ -218,32 +235,68 @@ class HTTPSink:
 
 class ServingLoop:
     """source -> pipeline -> sink continuous-batching loop. The transformer
-    sees a DataFrame with columns (id, value); it must produce `reply`."""
+    sees a DataFrame with columns (id, value); it must produce `reply`.
+
+    With ``prefetch_depth >= 1`` (default 2) the next micro-batch is
+    drained and assembled on a prefetch thread WHILE the current batch's
+    transform (the pjit step) runs — continuous batching with the drain
+    wait off the critical path. An optional ``prepare`` callable
+    (DataFrame -> DataFrame, e.g. payload decode + feature padding) also
+    runs on the prefetch thread, so per-row host decode overlaps device
+    compute too; it must keep the ``id`` column. Prepare failures reply
+    500 to that batch's clients without stopping the loop."""
 
     def __init__(self, source: HTTPSource, transformer,
-                 max_batch: int = 1024):
+                 max_batch: int = 1024, prefetch_depth: int = 2,
+                 prepare: Optional[Callable[[DataFrame], DataFrame]] = None):
         self.source = source
         self.sink = HTTPSink(source)
         self.transformer = transformer
         self.max_batch = max_batch
+        self.prefetch_depth = prefetch_depth
+        self.prepare = prepare
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
-    def _run(self):
+    def _fail_batch(self, batch: DataFrame, e: Exception):
+        log.warning("serving batch failed: %s", e)
+        for ex_id in batch.col("id"):
+            self.source.respond(str(ex_id), 500,
+                                json.dumps({"error": str(e)}))
+
+    def _drained(self):
+        """Producer: drain + (optionally) prepare micro-batches until
+        stopped. getBatch's bounded wait keeps this responsive to stop()."""
         while not self._stop.is_set():
             batch = self.source.getBatch(self.max_batch)
             if batch.count() == 0:
                 continue
             _m_batch_rows.observe(batch.count())
-            try:
-                with telemetry.trace.span("serve/batch", rows=batch.count()):
-                    out = self.transformer.transform(batch)
-                    self.sink.addBatch(out)
-            except Exception as e:  # reply 500s rather than hanging clients
-                log.warning("serving batch failed: %s", e)
-                for ex_id in batch.col("id"):
-                    self.source.respond(str(ex_id), 500,
-                                        json.dumps({"error": str(e)}))
+            if self.prepare is not None:
+                try:
+                    with telemetry.trace.span("serve/prepare",
+                                              rows=batch.count()):
+                        batch = self.prepare(batch)
+                except Exception as e:
+                    self._fail_batch(batch, e)
+                    continue
+            yield batch
+
+    def _run(self):
+        from ...parallel import prefetch as prefetchlib
+        it = prefetchlib.prefetched(self._drained, depth=self.prefetch_depth,
+                                    name="serving", span="serve/prefetch")
+        try:
+            for batch in it:
+                try:
+                    with telemetry.trace.span("serve/batch",
+                                              rows=batch.count()):
+                        out = self.transformer.transform(batch)
+                        self.sink.addBatch(out)
+                except Exception as e:  # reply 500s, don't hang clients
+                    self._fail_batch(batch, e)
+        finally:
+            it.close()
 
     def start(self):
         self._thread.start()
@@ -255,8 +308,11 @@ class ServingLoop:
 
 
 def serve_pipeline(transformer, host: str = "127.0.0.1", port: int = 0,
-                   max_batch: int = 1024) -> tuple[HTTPSource, ServingLoop]:
+                   max_batch: int = 1024, prefetch_depth: int = 2,
+                   prepare=None) -> tuple[HTTPSource, ServingLoop]:
     """Convenience: spin up source + loop for a fitted transformer."""
     source = HTTPSource(host=host, port=port)
-    loop = ServingLoop(source, transformer, max_batch).start()
+    loop = ServingLoop(source, transformer, max_batch,
+                       prefetch_depth=prefetch_depth,
+                       prepare=prepare).start()
     return source, loop

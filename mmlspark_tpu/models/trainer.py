@@ -293,9 +293,16 @@ def _make_pp_step_body(cfg: dict, mesh, tx, loss_fn, n_micro: int):
 
 def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
                      step_body=None):
-    """One jitted optimizer step (fitStream / multi-host feed path)."""
+    """One jitted optimizer step (fitStream / multi-host feed path).
+
+    The batch buffers (xb, yb) are DONATED: the feed path uploads a fresh
+    batch every step and never reads it back, so XLA reuses their HBM for
+    the step's outputs instead of allocating alongside. The weight mask wb
+    is NOT donated — the feed path caches one placed mask per (rows,
+    n_real) signature and reuses it across steps."""
     return jax.jit(step_body or
-                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux))
+                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux),
+                   donate_argnums=(2, 3))
 
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
@@ -402,6 +409,12 @@ class TpuLearner(Estimator):
         "permutation every epoch on the scan path; larger ones rotate + "
         "window-permute a once-permuted upload; 0 = the 32 MiB default",
         default=0, min=0)
+    prefetchDepth = IntParam(
+        "host batches prepared + placed on device ahead of the step "
+        "consuming them (feed/stream paths; the scan path is already "
+        "device-resident). 2 = double buffering; 0 = synchronous. The "
+        "prefetched loss trajectory is bit-identical to the synchronous "
+        "one — only the overlap changes", default=2, min=0)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     def _ckpt_path(self, epoch: int) -> str:
@@ -736,8 +749,7 @@ class TpuLearner(Estimator):
         params, opt_state, start_epoch = self._resume_training_state(
             params, opt_state, nproc)
 
-        from .tpu_model import _next_pow2
-        from jax.experimental import multihost_utils
+        from ..parallel import prefetch as prefetchlib
         axis = mesh.shape["data"]
         import contextlib
         guard = (meshlib.collective_fit_lock if mesh.size > 1
@@ -756,53 +768,27 @@ class TpuLearner(Estimator):
                 share = max(1, axis // nproc)
                 n_batches = 0
                 steps_run = 0
-                while True:
-                    b = next(stream, None)
-                    if b is None:
-                        xb = yb = None
-                        n = local_target = 0
-                    else:
-                        xb, yb = _stream_batch(b, cfg, self.getLoss())
-                        n = len(xb)
-                        # pow2 bucket, rounded up to a share multiple (a
-                        # 6-device axis doesn't divide pow2 buckets)
-                        local_target = (-(-max(_next_pow2(n), share)
-                                          // share) * share)
-                    if nproc > 1:
-                        # host-side lockstep: the fleet agrees on the bucket
-                        # size each step; a drained stream reports 0 and
-                        # keeps feeding zero-weight dummies until the
-                        # longest stream finishes — no deadlock on unequal
-                        # shards
-                        target = int(multihost_utils.process_allgather(
-                            np.asarray([local_target])).max())
-                    else:
-                        target = local_target
-                    if target == 0:
-                        break
-                    if xb is None:
-                        xb = np.zeros((target,) + x0.shape[1:], x0.dtype)
-                        yb = np.zeros(target, y0.dtype)
-                    elif n < target:
-                        fx = np.zeros((target - n,) + xb.shape[1:], xb.dtype)
-                        xb = np.concatenate([xb, fx])
-                        yb = np.concatenate(
-                            [yb, np.zeros(target - n, yb.dtype)])
-                    wb = np.zeros(target, dtype=np.float32)
-                    wb[:n] = 1.0
-                    if telemetry.enabled():
-                        _note_step_signature("stream", xb, yb, wb)
-                        _m_transfer_bytes.inc(xb.nbytes + yb.nbytes
-                                              + wb.nbytes)
-                    with _m_step_time.time():
-                        params, opt_state, loss = train_step(
-                            params, opt_state,
-                            meshlib.put_global_batch(xb, mesh),
-                            meshlib.put_global_batch(yb, mesh),
-                            meshlib.put_global_batch(wb, mesh))
-                    steps_run += 1
-                    if n:
-                        n_batches += 1
+                # single-process streams prefetch the normalize/bucket/pad/
+                # upload work behind the device step; multi-host stays
+                # synchronous — the per-step bucket-size allgather is a host
+                # collective, and issuing it from a prefetch thread while
+                # the main thread dispatches train steps could interleave
+                # collective order differently across processes (deadlock)
+                depth = self.getPrefetchDepth() if nproc == 1 else 0
+                steps_it = prefetchlib.prefetched(
+                    lambda s=stream: self._stream_epoch_steps(
+                        s, cfg, x0, y0, share, nproc, mesh),
+                    depth=depth, name="fit-stream", span="fit/prefetch")
+                try:
+                    for n, xb, yb, wb in steps_it:
+                        with _m_step_time.time():
+                            params, opt_state, loss = train_step(
+                                params, opt_state, xb, yb, wb)
+                        steps_run += 1
+                        if n:
+                            n_batches += 1
+                finally:
+                    steps_it.close()
                 if steps_run == 0:
                     raise ValueError(f"batches_fn() yielded no batches in "
                                      f"epoch {epoch}")
@@ -818,6 +804,58 @@ class TpuLearner(Estimator):
 
         return self._package_model(cfg, params, last_loss)
 
+    def _stream_epoch_steps(self, stream, cfg, x0, y0, share, nproc, mesh):
+        """One epoch of fitStream's per-step host work as a generator:
+        normalize -> pow2 bucket -> (multi-host size lockstep) -> pad ->
+        weight mask -> device placement. Yields ``(n_real, xb, yb, wb)``
+        with the batch already placed, so the consuming loop (optionally a
+        DevicePrefetcher running this ahead of the device step) only
+        dispatches ``train_step``."""
+        from .tpu_model import _next_pow2
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+        while True:
+            b = next(stream, None)
+            if b is None:
+                xb = yb = None
+                n = local_target = 0
+            else:
+                xb, yb = _stream_batch(b, cfg, self.getLoss())
+                n = len(xb)
+                # pow2 bucket, rounded up to a share multiple (a
+                # 6-device axis doesn't divide pow2 buckets)
+                local_target = (-(-max(_next_pow2(n), share)
+                                  // share) * share)
+            if nproc > 1:
+                # host-side lockstep: the fleet agrees on the bucket
+                # size each step; a drained stream reports 0 and
+                # keeps feeding zero-weight dummies until the
+                # longest stream finishes — no deadlock on unequal
+                # shards
+                target = int(multihost_utils.process_allgather(
+                    np.asarray([local_target])).max())
+            else:
+                target = local_target
+            if target == 0:
+                return
+            if xb is None:
+                xb = np.zeros((target,) + x0.shape[1:], x0.dtype)
+                yb = np.zeros(target, y0.dtype)
+            elif n < target:
+                fx = np.zeros((target - n,) + xb.shape[1:], xb.dtype)
+                xb = np.concatenate([xb, fx])
+                yb = np.concatenate(
+                    [yb, np.zeros(target - n, yb.dtype)])
+            wb = np.zeros(target, dtype=np.float32)
+            wb[:n] = 1.0
+            if telemetry.enabled():
+                _note_step_signature("stream", xb, yb, wb)
+                _m_transfer_bytes.inc(xb.nbytes + yb.nbytes + wb.nbytes)
+            yield (n,
+                   meshlib.put_global_batch(xb, mesh),
+                   meshlib.put_global_batch(yb, mesh),
+                   meshlib.put_global_batch(wb, mesh))
+
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state,
                     scan_fn=None):
@@ -827,40 +865,76 @@ class TpuLearner(Estimator):
                                          scan_fn=scan_fn, params=params,
                                          opt_state=opt_state)
         import time
-        last_loss = None
-        for epoch in range(start_epoch, self.getEpochs()):
-            t_epoch = time.perf_counter()
-            order = (order_rng.permutation(n) if self.getShuffle()
-                     else np.arange(n))
-            micro = self.getPipelineParallel()
-            for s in range(steps):
-                # cyclic slice: a process whose shard is shorter than its
-                # share of the global batch wraps (repeats) its rows so every
-                # process contributes exactly bs rows — identical shapes
-                idx = order[(s * bs + np.arange(bs)) % n]
-                pad = (meshlib.pad_batch_to_local_devices if nproc > 1
-                       else meshlib.pad_batch_to_devices)
-                xb, nb = pad(x[idx], mesh)
-                yb, _ = pad(y[idx], mesh)
-                if micro > 1:
-                    # pipeline steps also need microbatch divisibility —
-                    # per PROCESS: each feeds its 1/nproc slice of the
-                    # global batch, so rounding local rows to the GLOBAL
-                    # data*micro multiple would inflate the assembled batch
-                    # nproc-fold (the dp axis size is nproc-divisible by
-                    # the inner-block locality rule, so this is integral)
-                    mult = (mesh.shape["data"] // nproc) * micro
-                    tgt = -(-len(xb) // mult) * mult
-                    xb = _wrap_rows(xb, tgt)
-                    yb = _wrap_rows(yb, tgt)
-                wb = np.zeros(len(xb), dtype=np.float32)
-                wb[:nb] = 1.0
+        from ..parallel import prefetch as prefetchlib
+        if steps <= 0:
+            # an epoch with no steps would leave the loss unbound; there is
+            # nothing to train on, so skip the epoch loop entirely
+            log.warning("zero steps per epoch (n=%d, bs=%d) — skipping "
+                        "training loop", n, bs)
+            return params, opt_state, None
+        micro = self.getPipelineParallel()
+        pad = (meshlib.pad_batch_to_local_devices if nproc > 1
+               else meshlib.pad_batch_to_devices)
+        # the weight mask is identical for every (rows, n_real) signature —
+        # on the feed path that is EVERY full batch — so build + upload it
+        # once per signature and reuse the placed array instead of shipping
+        # bs float32s again each step. Reuse is why _make_train_step does
+        # not donate wb.
+        wb_cache: dict = {}
+
+        def placed_mask(rows: int, nb: int):
+            wb = wb_cache.get((rows, nb))
+            if wb is None:
+                host = np.zeros(rows, dtype=np.float32)
+                host[:nb] = 1.0
                 if telemetry.enabled():
-                    _note_step_signature("feed", xb, yb, wb)
-                    _m_transfer_bytes.inc(xb.nbytes + yb.nbytes + wb.nbytes)
-                xb = meshlib.put_global_batch(xb, mesh)
-                yb = meshlib.put_global_batch(yb, mesh)
-                wb = meshlib.put_global_batch(wb, mesh)
+                    _m_transfer_bytes.inc(host.nbytes)
+                wb = wb_cache[(rows, nb)] = meshlib.put_global_batch(
+                    host, mesh)
+            return wb
+
+        def produce():
+            """Per-step host work + H2D placement, run `prefetchDepth`
+            steps ahead of the consuming loop on the prefetch thread
+            (device placement is per-process work — no collectives — so
+            producing from a thread is safe even multi-host)."""
+            for epoch in range(start_epoch, self.getEpochs()):
+                order = (order_rng.permutation(n) if self.getShuffle()
+                         else np.arange(n))
+                for s in range(steps):
+                    # cyclic slice: a process whose shard is shorter than
+                    # its share of the global batch wraps (repeats) its rows
+                    # so every process contributes exactly bs rows —
+                    # identical shapes
+                    idx = order[(s * bs + np.arange(bs)) % n]
+                    xb, nb = pad(x[idx], mesh)
+                    yb, _ = pad(y[idx], mesh)
+                    if micro > 1:
+                        # pipeline steps also need microbatch divisibility —
+                        # per PROCESS: each feeds its 1/nproc slice of the
+                        # global batch, so rounding local rows to the GLOBAL
+                        # data*micro multiple would inflate the assembled
+                        # batch nproc-fold (the dp axis size is
+                        # nproc-divisible by the inner-block locality rule,
+                        # so this is integral)
+                        mult = (mesh.shape["data"] // nproc) * micro
+                        tgt = -(-len(xb) // mult) * mult
+                        xb = _wrap_rows(xb, tgt)
+                        yb = _wrap_rows(yb, tgt)
+                    wb = placed_mask(len(xb), nb)
+                    if telemetry.enabled():
+                        _note_step_signature("feed", xb, yb)
+                        _m_transfer_bytes.inc(xb.nbytes + yb.nbytes)
+                    yield (epoch, s,
+                           meshlib.put_global_batch(xb, mesh),
+                           meshlib.put_global_batch(yb, mesh), wb)
+
+        last_loss = None
+        t_epoch = time.perf_counter()
+        it = prefetchlib.prefetched(produce, depth=self.getPrefetchDepth(),
+                                    name="fit-feed", span="fit/prefetch")
+        try:
+            for epoch, s, xb, yb, wb in it:
                 t_step = time.perf_counter()
                 with telemetry.trace.span("fit/step", epoch=epoch,
                                           step=s) as sp:
@@ -868,22 +942,30 @@ class TpuLearner(Estimator):
                                                          xb, yb, wb)
                     sp.set_sync(loss)
                 _m_step_time.observe(time.perf_counter() - t_step)
-            last_loss = float(loss)
-            _m_rows_per_sec.set(steps * bs
-                                / max(time.perf_counter() - t_epoch, 1e-9))
-            log.info("epoch %d loss %.4f", epoch, last_loss)
-            if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
-                last_good = self._latest_checkpoint() \
-                    if self.getCheckpointDir() else None
-                raise RuntimeError(
-                    f"training diverged: epoch {epoch} loss is {last_loss} "
-                    f"(lr={self.getLearningRate()}). "
-                    + (f"Last good checkpoint: epoch {last_good} in "
-                       f"{self.getCheckpointDir()!r}; refit resumes there."
-                       if last_good is not None
-                       else "Set checkpointDir to make divergence resumable."))
-            if self.getCheckpointDir() and jax.process_index() == 0:
-                self._save_checkpoint(epoch, params, opt_state)
+                if s < steps - 1:
+                    continue
+                # ---- epoch finalize (an early exit below must stop the
+                # producer promptly: the finally closes the prefetcher) ----
+                last_loss = float(loss)
+                _m_rows_per_sec.set(
+                    steps * bs / max(time.perf_counter() - t_epoch, 1e-9))
+                t_epoch = time.perf_counter()
+                log.info("epoch %d loss %.4f", epoch, last_loss)
+                if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
+                    last_good = self._latest_checkpoint() \
+                        if self.getCheckpointDir() else None
+                    raise RuntimeError(
+                        f"training diverged: epoch {epoch} loss is "
+                        f"{last_loss} (lr={self.getLearningRate()}). "
+                        + (f"Last good checkpoint: epoch {last_good} in "
+                           f"{self.getCheckpointDir()!r}; refit resumes "
+                           f"there." if last_good is not None
+                           else "Set checkpointDir to make divergence "
+                                "resumable."))
+                if self.getCheckpointDir() and jax.process_index() == 0:
+                    self._save_checkpoint(epoch, params, opt_state)
+        finally:
+            it.close()
         return params, opt_state, last_loss
 
     def _run_epochs_scan(self, start_epoch, x, y, n, bs, steps, *,
