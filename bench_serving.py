@@ -6,8 +6,16 @@ Measures end-to-end client-observed latency (p50/p99) and sustained
 throughput for a ResNet-20 scorer behind `serve_pipeline`, with uint8 image
 payloads (the wire format TpuModel.transferDtype optimizes). Prints one
 JSON line per load level; the last line is the headline.
+
+``--chaos`` runs the resilience scenario instead: the PROCESS fleet
+(`serve_fleet` + FleetSupervisor) under a 10% injected `fleet.poll` error
+rate plus one mid-run worker kill. Clients post through a RetryPolicy (the
+documented client contract under worker loss) and the report adds
+`recovery_s` — wall time from the kill until the restarted worker's URL
+serves a request again — plus the retry/restart counters.
 """
 
+import argparse
 import base64
 import json
 import threading
@@ -53,6 +61,116 @@ class _ImageScorer:
         replies = [json.dumps({"label": int(np.argmax(s))})
                    for s in scored.col("scores")]
         return scored.withColumn("reply", object_column(replies))
+
+
+class _ChaosScorer(_ImageScorer):
+    """Fleet transformer: prepare + transform fused (the ReplayServingLoop
+    has no separate prepare stage)."""
+
+    def transform(self, df):
+        return super().transform(self.prepare(df))
+
+
+def chaos_main(fault_rate: float = 0.1, clients: int = 8,
+               per_client: int = 30):
+    """Fleet chaos run: injected poll faults + one worker kill mid-run."""
+    from mmlspark_tpu import telemetry
+    from mmlspark_tpu.io.http.fleet import serve_fleet
+    from mmlspark_tpu.resilience import faults
+    from mmlspark_tpu.resilience.policy import RetryPolicy
+    import urllib.request
+
+    telemetry.enable()
+    faults.configure(f"fleet.poll:error:{fault_rate}", seed=0)
+    rng = np.random.default_rng(0)
+    payload = base64.b64encode(
+        rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
+
+    source, loop = serve_fleet(_ChaosScorer(), n_workers=2, supervise=True,
+                               probe_interval=0.1)
+    urls = [w.url for w in source.workers]
+
+    def post(url, timeout=30.0):
+        req = urllib.request.Request(url, data=payload)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            assert r.status == 200, r.status
+            return r.read()
+
+    try:
+        post(urls[0], timeout=180)       # warmup: compile on worker 0
+        post(urls[1], timeout=60)
+
+        lat: list = []
+        failures: list = []
+        lock = threading.Lock()
+
+        def worker(ci):
+            policy = RetryPolicy(name="bench.client", max_attempts=60,
+                                 base_delay=0.05, max_delay=0.5,
+                                 deadline=60.0, seed=ci)
+            mine, bad = [], []
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    policy.run(lambda _a: post(urls[ci % 2], timeout=5.0))
+                    mine.append(time.perf_counter() - t0)
+                except Exception as e:
+                    bad.append(repr(e))
+            with lock:
+                lat.extend(mine)
+                failures.extend(bad)
+
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(1.0)
+        t_kill = time.perf_counter()
+        source.killWorker(0)             # the mid-run worker kill
+        # recovery = kill -> the same URL serves again (supervisor restart)
+        recovery = None
+        deadline = time.monotonic() + 60
+        while recovery is None and time.monotonic() < deadline:
+            try:
+                post(urls[0], timeout=2.0)
+                recovery = time.perf_counter() - t_kill
+            except Exception:
+                time.sleep(0.05)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if failures:
+            raise RuntimeError(f"{len(failures)} lost requests under "
+                               f"chaos, e.g. {failures[0]}")
+        snap = telemetry.snapshot()
+
+        def total(name):
+            return sum(s["value"]
+                       for s in snap.get(name, {}).get("series", []))
+
+        lat_ms = np.sort(np.array(lat)) * 1e3
+        result = {
+            "metric": "serving_resnet20_fleet_chaos",
+            "fault_rate": fault_rate,
+            "clients": clients,
+            "requests": len(lat),
+            "lost": 0,
+            "throughput_rps": round(len(lat) / wall, 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
+            "recovery_s": None if recovery is None else round(recovery, 2),
+            "faults_injected": total("mmlspark_faults_injected_total"),
+            "retries": total("mmlspark_retry_attempts_total"),
+            "worker_restarts": total(
+                "mmlspark_supervisor_worker_restarts_total"),
+        }
+        print(json.dumps(result))
+        return result
+    finally:
+        loop.stop()
+        faults.clear()
+        telemetry.disable()
 
 
 def main():
@@ -118,4 +236,14 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--chaos", action="store_true",
+                    help="fleet chaos mode: 10%% injected poll faults + "
+                         "one mid-run worker kill; reports p50/p99 and "
+                         "recovery time")
+    ap.add_argument("--fault-rate", type=float, default=0.1)
+    args = ap.parse_args()
+    if args.chaos:
+        chaos_main(fault_rate=args.fault_rate)
+    else:
+        main()

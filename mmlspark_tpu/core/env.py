@@ -27,6 +27,23 @@ def telemetry_trace_path() -> Optional[str]:
     return os.environ.get("MMLSPARK_TPU_TRACE") or None
 
 
+def fault_spec() -> Optional[str]:
+    """MMLSPARK_TPU_FAULTS="site:kind:rate[:arg];...": arm the seeded
+    fault-injection registry (mmlspark_tpu.resilience.faults) at import.
+    Default unset — injection sites are a module-bool check, nothing
+    more."""
+    return os.environ.get("MMLSPARK_TPU_FAULTS") or None
+
+
+def fault_seed() -> int:
+    """MMLSPARK_TPU_FAULTS_SEED=<int>: the base seed every fault site's
+    RNG derives from (seed ^ crc32(site)) — reruns replay identically."""
+    try:
+        return int(os.environ.get("MMLSPARK_TPU_FAULTS_SEED", "0"))
+    except ValueError:
+        return 0
+
+
 def accelerator_count() -> int:
     """Attached accelerator chips (the GPUCount analog — no nvidia-smi
     subprocess: the JAX runtime already knows)."""

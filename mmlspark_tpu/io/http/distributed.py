@@ -79,10 +79,11 @@ class DistributedHTTPSource:
     """
 
     def __init__(self, n_workers: int = 2, host: str = "127.0.0.1",
-                 base_port: int = 0):
+                 base_port: int = 0, max_queue_depth: int = 0):
         self.workers: list[HTTPSource] = []
         for _ in range(n_workers):
-            self.workers.append(HTTPSource(host=host, port=base_port))
+            self.workers.append(HTTPSource(host=host, port=base_port,
+                                           max_queue_depth=max_queue_depth))
             if base_port:
                 base_port = self.workers[-1].port + 1
         log.info("distributed source on ports %s",
@@ -139,13 +140,14 @@ class DistributedServingLoop(ServingLoop):
 def serve_distributed(transformer, n_workers: int = 2,
                       host: str = "127.0.0.1", base_port: int = 0,
                       max_batch: int = 1024, prefetch_depth: int = 2,
-                      prepare=None):
+                      prepare=None, max_queue_depth: int = 0):
     """Spin up the worker fleet + loop; returns (source, loop). One
     transformer call (one pjit dispatch) serves every worker's in-flight
     requests per micro-batch; the next micro-batch drains (and optionally
     ``prepare``s) on the loop's prefetch thread meanwhile."""
     source = DistributedHTTPSource(n_workers=n_workers, host=host,
-                                   base_port=base_port)
+                                   base_port=base_port,
+                                   max_queue_depth=max_queue_depth)
     loop = DistributedServingLoop(source, transformer, max_batch,
                                   prefetch_depth=prefetch_depth,
                                   prepare=prepare).start()

@@ -31,6 +31,8 @@ import numpy as np
 from ...core.dataframe import DataFrame
 from ...core.utils import get_logger, object_column
 from ... import telemetry
+from ...resilience import faults
+from ...resilience.policy import CircuitBreaker, RetryPolicy
 from .server import HTTPSink, _m_batch_rows
 
 log = get_logger("http.fleet")
@@ -46,6 +48,20 @@ _m_workers_alive = telemetry.registry.gauge(
 _m_uncommitted = telemetry.registry.gauge(
     "mmlspark_fleet_uncommitted_rows",
     "rows in the replayable offset log awaiting commit")
+_m_rows_parked = telemetry.registry.counter(
+    "mmlspark_fleet_rows_parked",
+    "uncommitted rows parked when their worker was marked dead")
+_m_rows_redispatched = telemetry.registry.counter(
+    "mmlspark_fleet_rows_redispatched",
+    "parked rows returned to the offset log after their worker was "
+    "resurrected (spurious death verdict)")
+_m_rows_dropped = telemetry.registry.counter(
+    "mmlspark_fleet_rows_dropped",
+    "parked rows dropped after a worker RESTART: the old incarnation's "
+    "client sockets died with it, so no reply path exists")
+_m_replies_parked = telemetry.registry.counter(
+    "mmlspark_fleet_replies_parked",
+    "computed replies parked because their worker was marked dead")
 
 
 class _Worker:
@@ -54,7 +70,7 @@ class _Worker:
     SPAWN_TIMEOUT = 30.0
 
     def __init__(self, host: str, port: int, control_port: int,
-                 spawn: bool = True):
+                 spawn: bool = True, max_queue_depth: int = 0):
         self.host = host
         self.alive = True
         self.proc = None
@@ -65,7 +81,8 @@ class _Worker:
             self.proc = subprocess.Popen(
                 [sys.executable, "-m", "mmlspark_tpu.io.http.worker",
                  "--host", host, "--port", str(port),
-                 "--control-port", str(control_port)],
+                 "--control-port", str(control_port),
+                 "--max-queue-depth", str(max_queue_depth)],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 text=True)
             # bounded startup: a child that dies (or hangs) before printing
@@ -106,6 +123,7 @@ class _Worker:
     def poll(self, max_rows: int, timeout: float) -> list:
         """Poll new rows, acknowledging the previously received ones (the
         at-least-once handoff: unacked rows re-deliver)."""
+        faults.inject("fleet.poll")
         ack, self.pending_ack = self.pending_ack, []
         try:
             return self._call("/poll", {"max": max_rows, "timeout": timeout,
@@ -115,6 +133,7 @@ class _Worker:
             raise
 
     def respond(self, replies: list) -> None:
+        faults.inject("fleet.respond")
         self._call("/respond", {"replies": replies})
 
     def probably_dead(self) -> bool:
@@ -148,30 +167,50 @@ class ProcessHTTPSource:
     control round-trip per worker per batch)."""
 
     def __init__(self, n_workers: int = 2, host: str = "127.0.0.1",
-                 base_port: int = 0, poll_timeout: float = 0.02):
-        self.workers: list[_Worker] = []
-        port = base_port
-        try:
-            for _ in range(n_workers):
-                w = _Worker(host, port, 0)
-                self.workers.append(w)
-                if base_port:
-                    port = w.port + 1
-        except Exception:
-            # a failed spawn must not orphan the already-running workers
-            for w in self.workers:
-                w.kill()
-            raise
+                 base_port: int = 0, poll_timeout: float = 0.02,
+                 max_queue_depth: int = 0, workers: list = None):
+        if workers is not None:
+            # pre-built handles (in-process chaos tests, custom spawners)
+            self.workers: list[_Worker] = list(workers)
+        else:
+            self.workers = []
+            port = base_port
+            try:
+                for _ in range(n_workers):
+                    w = _Worker(host, port, 0,
+                                max_queue_depth=max_queue_depth)
+                    self.workers.append(w)
+                    if base_port:
+                        port = w.port + 1
+            except Exception:
+                # a failed spawn must not orphan already-running workers
+                for w in self.workers:
+                    w.kill()
+                raise
         self.poll_timeout = poll_timeout
         self._log: list[tuple[int, str, str]] = []  # (offset, id, value)
         self._log_ids: set[str] = set()   # uncommitted ids (re-delivery dedupe)
         self._offset = 0          # highest offset assigned
         self._committed = 0       # offsets <= this are gone
         self._reply_buf: dict[int, list] = {}
+        # rows/replies parked on a worker's death verdict, keyed by worker
+        # index; restoreWorker redispatches (resurrection) or drops
+        # (restart) them — see markWorkerDead
+        self._parked_rows: dict[int, list] = {}
+        self._parked_replies: dict[int, list] = {}
+        # a flapping worker is skipped (circuit open) instead of paying a
+        # doomed round-trip + timeout on every poll round
+        self.breaker = CircuitBreaker("fleet.control", failure_threshold=3,
+                                      reset_timeout=0.5)
+        # reply delivery retries transient blips in-line; worker death is
+        # decided by probably_dead, never by one failed call
+        self._respond_retry = RetryPolicy(name="fleet.respond",
+                                          max_attempts=2, base_delay=0.02,
+                                          max_delay=0.1)
         self._lock = threading.Lock()
         _m_workers_alive.set(self.aliveCount())
         log.info("fleet of %d worker processes on ports %s",
-                 n_workers, [w.port for w in self.workers])
+                 len(self.workers), [w.port for w in self.workers])
 
     @property
     def urls(self) -> list[str]:
@@ -188,8 +227,11 @@ class ProcessHTTPSource:
         for wi, w in enumerate(self.workers):
             if not w.alive:
                 continue
+            if not self.breaker.allow(str(wi)):
+                continue    # circuit open: skip this worker this round
             try:
                 rows = w.poll(256, self.poll_timeout)
+                self.breaker.record(str(wi), ok=True)
             except Exception as e:
                 # catch-all: a worker dying MID-RESPONSE raises
                 # http.client.IncompleteRead / JSONDecodeError, not just
@@ -199,12 +241,10 @@ class ProcessHTTPSource:
                 # failed health check (or process exit) is a death verdict.
                 # A dead worker loses ONLY its own in-flight clients (their
                 # sockets died with it); the fleet serves on.
+                self.breaker.record(str(wi), ok=False)
                 _m_worker_errors.labels(worker=str(wi), phase="poll").inc()
                 if w.probably_dead():
-                    log.warning("worker %d (%s) dead, marking: %s",
-                                wi, w.url, e)
-                    w.alive = False
-                    _m_workers_alive.set(self.aliveCount())
+                    self.markWorkerDead(wi, reason=f"poll: {e}")
                 else:
                     log.warning("worker %d poll failed (still healthy, "
                                 "retrying next round): %s", wi, e)
@@ -246,38 +286,135 @@ class ProcessHTTPSource:
             self._log = [e for e in self._log if e[0] > self._committed]
             self._log_ids -= {qid for _, qid, _ in done}
 
+    # ---- death / recovery (the FleetSupervisor surface) ----
+    def markWorkerDead(self, wi: int, reason: str = "") -> None:
+        """Record a death verdict for worker ``wi`` and PARK its state
+        instead of dropping it: its uncommitted offset-log rows and any
+        buffered replies move to per-worker parking. If the verdict turns
+        out spurious (the supervisor's probe finds the process alive and
+        answering), ``restoreWorker(resurrected=True)`` redispatches all
+        of it and the worker's blocked clients get their replies — the
+        seed dropped both, stranding those clients until reply_timeout."""
+        w = self.workers[wi]
+        prefix = f"{wi}:"
+        with self._lock:
+            if not w.alive:
+                return
+            w.alive = False
+            parked = [(qid, v) for _, qid, v in self._log
+                      if qid.startswith(prefix)]
+            if parked:
+                self._log = [e for e in self._log
+                             if not e[1].startswith(prefix)]
+                self._parked_rows.setdefault(wi, []).extend(parked)
+                _m_rows_parked.inc(len(parked))
+            replies = self._reply_buf.pop(wi, [])
+            if replies:
+                self._parked_replies.setdefault(wi, []).extend(replies)
+                _m_replies_parked.inc(len(replies))
+            n_log = len(self._log)
+        log.warning("worker %d (%s) marked dead (%s): parked %d rows, "
+                    "%d replies pending recovery", wi, w.url, reason,
+                    len(parked), len(replies))
+        _m_workers_alive.set(self.aliveCount())
+        _m_uncommitted.set(n_log)
+
+    def restoreWorker(self, wi: int, worker=None,
+                      resurrected: bool = False) -> None:
+        """Bring worker ``wi`` back into rotation.
+
+        ``resurrected=True``: the SAME process is alive (spurious death
+        verdict) — its in-flight exchanges survived, so parked replies
+        re-enter the delivery buffer and parked rows not yet answered
+        re-enter the offset log under fresh offsets (same qid: the
+        at-least-once dedupe still holds).
+
+        ``worker=<new handle>``: a fresh process replaced a dead one. The
+        old incarnation's client sockets died with it, so parked state is
+        dropped (counted) — client retries hit the same URL and are served
+        by the new incarnation."""
+        with self._lock:
+            if worker is not None:
+                self.workers[wi] = worker
+            w = self.workers[wi]
+            w.alive = True
+            rows = self._parked_rows.pop(wi, [])
+            replies = self._parked_replies.pop(wi, [])
+            if resurrected:
+                replied = {f"{wi}:{r[0]}" for r in replies}
+                n_red = 0
+                for qid, v in rows:
+                    if qid in replied:   # its reply is parked: lifecycle
+                        self._log_ids.discard(qid)   # ends at delivery
+                        continue
+                    self._offset += 1
+                    self._log.append((self._offset, qid, v))
+                    n_red += 1
+                _m_rows_redispatched.inc(n_red)
+                if replies:
+                    self._reply_buf.setdefault(wi, []).extend(replies)
+            else:
+                for qid, _v in rows:
+                    self._log_ids.discard(qid)
+                _m_rows_dropped.inc(len(rows) + len(replies))
+            n_log = len(self._log)
+        self.breaker.reset(str(wi))
+        log.info("worker %d restored (%s): %d parked rows %s, %d replies "
+                 "%s", wi, "resurrected" if resurrected else "restarted",
+                 len(rows), "redispatched" if resurrected else "dropped",
+                 len(replies),
+                 "requeued" if resurrected else "dropped")
+        _m_workers_alive.set(self.aliveCount())
+        _m_uncommitted.set(n_log)
+
     # ---- reply path (HTTPSink surface) ----
     def respond(self, ex_id: str, code: int, body) -> None:
         wi, raw = str(ex_id).split(":", 1)
-        self._reply_buf.setdefault(int(wi), []).append(
-            [raw, int(code), body if isinstance(body, str)
-             else body.decode("utf-8")])
+        with self._lock:
+            self._reply_buf.setdefault(int(wi), []).append(
+                [raw, int(code), body if isinstance(body, str)
+                 else body.decode("utf-8")])
 
     def flush(self) -> None:
-        buf, self._reply_buf = self._reply_buf, {}
+        with self._lock:
+            if not self._reply_buf:
+                return
+            buf, self._reply_buf = self._reply_buf, {}
         for wi, replies in buf.items():
             w = self.workers[wi]
             if not w.alive:
+                # park for the supervisor's recovery instead of dropping
+                with self._lock:
+                    self._parked_replies.setdefault(wi, []).extend(replies)
+                _m_replies_parked.inc(len(replies))
                 continue
             try:
-                w.respond(replies)
+                self._respond_retry.run(
+                    lambda _a, w=w, r=replies: w.respond(r))
             except Exception as e:
                 # same slow-vs-dead policy as the poll path: only a failed
                 # health check (or process exit) is a death verdict
                 _m_worker_errors.labels(worker=str(wi),
                                         phase="respond").inc()
                 if w.probably_dead():
-                    log.warning("worker %d dead during reply delivery: %s",
-                                wi, e)
-                    w.alive = False
-                    _m_workers_alive.set(self.aliveCount())
+                    self.markWorkerDead(wi, reason=f"reply delivery: {e}")
+                    with self._lock:
+                        self._parked_replies.setdefault(
+                            wi, []).extend(replies)
+                    _m_replies_parked.inc(len(replies))
                 else:
+                    # transient failure on a HEALTHY worker: the seed
+                    # dropped these replies (stranding their clients until
+                    # reply_timeout) — re-buffer them for the next flush
+                    with self._lock:
+                        self._reply_buf.setdefault(wi, []).extend(replies)
                     log.warning("worker %d reply delivery failed (worker "
-                                "healthy; its clients will see their "
-                                "reply_timeout): %s", wi, e)
+                                "healthy; %d replies re-buffered for the "
+                                "next flush): %s", wi, len(replies), e)
 
     def killWorker(self, i: int) -> None:
-        """Hard-kill one worker process (failure-injection hook)."""
+        """Hard-kill one worker process (failure-injection hook; the
+        chaos path back is FleetSupervisor restart + client retry)."""
         self.workers[i].kill()
 
     def close(self) -> None:
@@ -302,12 +439,21 @@ class ReplayServingLoop:
     offset log."""
 
     def __init__(self, source: ProcessHTTPSource, transformer,
-                 max_retries: int = 1, prefetch_depth: int = 2):
+                 max_retries: int = 1, prefetch_depth: int = 2,
+                 supervisor=None):
         self.source = source
         self.sink = HTTPSink(source)
         self.transformer = transformer
         self.max_retries = max_retries
         self.prefetch_depth = prefetch_depth
+        self.supervisor = supervisor
+        # the replay retry: ANY transform error gets max_retries replays
+        # of the same offset range (the source contract guarantees the
+        # same rows) before the batch fails with 500s
+        self._retry = RetryPolicy(name="fleet.batch",
+                                  max_attempts=max_retries + 1,
+                                  base_delay=0.02, max_delay=0.2,
+                                  retryable=lambda e: True)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -330,25 +476,32 @@ class ReplayServingLoop:
                                     name="fleet", span="fleet/prefetch")
         try:
             for start, end, batch in it:
-                for attempt in range(self.max_retries + 1):
-                    if attempt:  # replay-stable re-read until commit
-                        batch = self.source.getBatch(start, end)
-                    _m_batch_rows.observe(batch.count())
-                    try:
-                        with telemetry.trace.span("fleet/batch",
-                                                  rows=batch.count(),
-                                                  attempt=attempt):
-                            out = self.transformer.transform(batch)
-                            self.sink.addBatch(out)
-                        break
-                    except Exception as e:
-                        log.warning("batch (%d, %d] attempt %d failed: %s",
-                                    start, end, attempt, e)
-                        if attempt == self.max_retries:
-                            for ex_id in batch.col("id"):
-                                self.source.respond(
-                                    str(ex_id), 500,
-                                    json.dumps({"error": str(e)}))
+                def attempt_fn(attempt, start=start, end=end, batch=batch):
+                    # replay-stable re-read until commit (retries also
+                    # shed rows whose worker died since the first read)
+                    b = (batch if attempt == 0
+                         else self.source.getBatch(start, end))
+                    _m_batch_rows.observe(b.count())
+                    with telemetry.trace.span("fleet/batch",
+                                              rows=b.count(),
+                                              attempt=attempt):
+                        faults.inject("fleet.transform")
+                        out = self.transformer.transform(b)
+                        self.sink.addBatch(out)
+
+                try:
+                    self._retry.run(
+                        attempt_fn,
+                        on_retry=lambda a, e, s=start, n=end: log.warning(
+                            "batch (%d, %d] attempt %d failed: %s",
+                            s, n, a, e))
+                except Exception as e:
+                    log.warning("batch (%d, %d] failed after %d attempts: "
+                                "%s", start, end, self.max_retries + 1, e)
+                    for ex_id in self.source.getBatch(start,
+                                                      end).col("id"):
+                        self.source.respond(str(ex_id), 500,
+                                            json.dumps({"error": str(e)}))
                 self.source.flush()
                 self.source.commit(end)
         finally:
@@ -360,17 +513,30 @@ class ReplayServingLoop:
 
     def stop(self):
         self._stop.set()
+        if self.supervisor is not None:
+            self.supervisor.stop()
         self._thread.join(timeout=5)
         self.source.close()
 
 
 def serve_fleet(transformer, n_workers: int = 2, host: str = "127.0.0.1",
-                base_port: int = 0, prefetch_depth: int = 2):
+                base_port: int = 0, prefetch_depth: int = 2,
+                max_queue_depth: int = 0, supervise: bool = False,
+                probe_interval: float = 0.25):
     """Spawn the worker fleet + replay loop; returns (source, loop). One
     transformer call per micro-batch serves every worker process's
-    in-flight requests."""
+    in-flight requests. ``supervise=True`` attaches a
+    :class:`~mmlspark_tpu.resilience.FleetSupervisor` (health probing +
+    automatic restart of dead workers), stopped by ``loop.stop()``."""
     source = ProcessHTTPSource(n_workers=n_workers, host=host,
-                               base_port=base_port)
+                               base_port=base_port,
+                               max_queue_depth=max_queue_depth)
+    supervisor = None
+    if supervise:
+        from ...resilience.supervisor import FleetSupervisor
+        supervisor = FleetSupervisor(
+            source, probe_interval=probe_interval).start()
     loop = ReplayServingLoop(source, transformer,
-                             prefetch_depth=prefetch_depth).start()
+                             prefetch_depth=prefetch_depth,
+                             supervisor=supervisor).start()
     return source, loop

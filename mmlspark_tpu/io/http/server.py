@@ -31,6 +31,8 @@ import numpy as np
 from ...core.dataframe import DataFrame
 from ...core.utils import get_logger, object_column
 from ... import telemetry
+from ...resilience import faults
+from ...resilience.policy import CircuitBreaker, RetryPolicy
 
 log = get_logger("io.http")
 
@@ -49,6 +51,10 @@ _m_batch_rows = telemetry.registry.histogram(
 _m_replies = telemetry.registry.counter(
     "mmlspark_http_replies", "replies sent by status class",
     labels=("code",))
+_m_shed = telemetry.registry.counter(
+    "mmlspark_http_shed_requests",
+    "requests rejected with 503 + Retry-After by queue-depth load "
+    "shedding (max_queue_depth exceeded)")
 
 
 class _BurstyHTTPServer(ThreadingHTTPServer):
@@ -63,15 +69,17 @@ def bind_with_probing(host: str, port: int, handler,
                       max_probes: int = 20) -> _BurstyHTTPServer:
     """Bind a server on ``port`` or the next free port above it (port 0 =
     kernel-assigned). The reference's probing loop,
-    DistributedHTTPSource.scala:237-250."""
-    last_err = None
-    for probe in range(max_probes):
-        try:
-            return _BurstyHTTPServer((host, port + probe if port else 0),
-                                     handler)
-        except OSError as e:
-            last_err = e
-    raise OSError(f"no free port after {max_probes} probes: {last_err}")
+    DistributedHTTPSource.scala:237-250 — expressed as a shared
+    RetryPolicy attempt budget (zero backoff: the 'retry' is the next
+    port, not the same one later)."""
+    policy = RetryPolicy(name="http.bind", max_attempts=max_probes,
+                         base_delay=0.0, max_delay=0.0,
+                         retryable=(OSError,))
+    try:
+        return policy.run(lambda probe: _BurstyHTTPServer(
+            (host, port + probe if port else 0), handler))
+    except OSError as e:
+        raise OSError(f"no free port after {max_probes} probes: {e}")
 
 
 class _Exchange:
@@ -89,14 +97,22 @@ class _Exchange:
 
 
 class HTTPSource:
-    """Threaded HTTP server collecting requests for batch processing."""
+    """Threaded HTTP server collecting requests for batch processing.
+
+    ``max_queue_depth`` > 0 enables load shedding: a request arriving
+    while that many are already awaiting batch pickup is rejected
+    immediately with ``503 + Retry-After`` instead of being queued — at
+    overload, a fast honest rejection (the client retries elsewhere /
+    later) beats a 30s reply_timeout nobody will wait out."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", name: str = "source",
-                 max_port_probes: int = 20):
+                 max_port_probes: int = 20, max_queue_depth: int = 0):
         self._pending: "queue.Queue[_Exchange]" = queue.Queue()
         self._inflight: dict[str, _Exchange] = {}
         self._lock = threading.Lock()
+        self.max_queue_depth = max_queue_depth
+        self._t0 = time.monotonic()
         # live requests awaiting batch pickup. NOT _pending.qsize(): a
         # timed-out client's exchange lingers in the queue until a later
         # drain discards it, and qsize would keep reporting that dead work
@@ -111,6 +127,21 @@ class HTTPSource:
                 if api_path not in ("/", self.path):
                     self.send_error(404)
                     return
+                if source.max_queue_depth:
+                    with source._lock:
+                        shed = source._n_pending >= source.max_queue_depth
+                    if shed:
+                        _m_shed.inc()
+                        _m_replies.labels(code="503").inc()
+                        payload = b'{"error": "overloaded, retry later"}'
+                        self.send_response(503)
+                        self.send_header("Retry-After", "1")
+                        self.send_header("Content-Type", "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return
                 t0 = time.perf_counter()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode("utf-8")
@@ -149,6 +180,15 @@ class HTTPSource:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif self.path == "/healthz":
+                    # liveness + load surface for the fleet supervisor and
+                    # external orchestrators (k8s-style probes)
+                    payload = json.dumps(source.health()).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self.send_error(404)
 
@@ -166,6 +206,18 @@ class HTTPSource:
     @property
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/"
+
+    def health(self) -> dict:
+        """The ``GET /healthz`` payload: queue depth, shedding bound,
+        uptime, and every circuit breaker's per-target state in this
+        process."""
+        with self._lock:
+            depth = self._n_pending
+        return {"ok": True,
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "queue_depth": depth,
+                "max_queue_depth": self.max_queue_depth,
+                "breakers": CircuitBreaker.snapshot_all()}
 
     def getBatch(self, max_rows: int = 1024,
                  timeout: float = 0.05) -> DataFrame:
@@ -255,6 +307,11 @@ class ServingLoop:
         self.max_batch = max_batch
         self.prefetch_depth = prefetch_depth
         self.prepare = prepare
+        # transient errors (network blips inside a transformer that calls
+        # out, injected faults) get one in-memory retry before the batch
+        # fails with 500s; model/code errors classify fatal and fail fast
+        self._retry = RetryPolicy(name="serving.batch", max_attempts=2,
+                                  base_delay=0.02, max_delay=0.1)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -288,11 +345,14 @@ class ServingLoop:
                                     name="serving", span="serve/prefetch")
         try:
             for batch in it:
-                try:
+                def attempt(_a, batch=batch):
                     with telemetry.trace.span("serve/batch",
                                               rows=batch.count()):
+                        faults.inject("serving.transform")
                         out = self.transformer.transform(batch)
                         self.sink.addBatch(out)
+                try:
+                    self._retry.run(attempt)
                 except Exception as e:  # reply 500s, don't hang clients
                     self._fail_batch(batch, e)
         finally:
@@ -309,9 +369,12 @@ class ServingLoop:
 
 def serve_pipeline(transformer, host: str = "127.0.0.1", port: int = 0,
                    max_batch: int = 1024, prefetch_depth: int = 2,
-                   prepare=None) -> tuple[HTTPSource, ServingLoop]:
+                   prepare=None,
+                   max_queue_depth: int = 0) -> tuple[HTTPSource,
+                                                      ServingLoop]:
     """Convenience: spin up source + loop for a fitted transformer."""
-    source = HTTPSource(host=host, port=port)
+    source = HTTPSource(host=host, port=port,
+                        max_queue_depth=max_queue_depth)
     loop = ServingLoop(source, transformer, max_batch,
                        prefetch_depth=prefetch_depth,
                        prepare=prepare).start()

@@ -15,6 +15,8 @@ from ...core.params import (ComplexParam, HasInputCol, HasOutputCol, IntParam,
                             FloatParam, StringParam)
 from ...core.pipeline import Transformer
 from ...core.utils import object_column
+from ...resilience import faults
+from ...resilience.policy import RetryPolicy
 
 
 # ------------------------------------------------------------------ parsers
@@ -90,24 +92,51 @@ class CustomOutputParser(Transformer, HasInputCol, HasOutputCol):
 
 class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     """Execute request dicts concurrently (reference HTTPTransformer.scala:20
-    — async client with `concurrency`; Clients.scala:186-189)."""
+    — async client with `concurrency`; Clients.scala:186-189).
+    ``retries`` > 0 re-attempts transient per-row failures (connection
+    errors, timeouts, 5xx/429 responses) through the shared RetryPolicy;
+    the default 0 keeps the single-shot contract."""
     concurrency = IntParam("parallel in-flight requests", default=8, min=1)
     timeout = FloatParam("per-request timeout seconds", default=30.0)
+    retries = IntParam("transient-failure retries per request (exponential "
+                       "backoff, full jitter)", default=0, min=0)
 
     def transform(self, df: DataFrame) -> DataFrame:
         reqs = df.col(self.getInputCol())
+        policy = (RetryPolicy(name="http.transformer",
+                              max_attempts=self.getRetries() + 1,
+                              base_delay=0.1, max_delay=2.0)
+                  if self.getRetries() else None)
+
+        def attempt(r: dict) -> dict:
+            faults.inject("http.request")
+            resp = requests.request(
+                r.get("method", "POST"), r["url"],
+                data=r.get("body"), headers=r.get("headers"),
+                timeout=self.getTimeout())
+            if policy is not None and (resp.status_code >= 500
+                                       or resp.status_code == 429):
+                err = IOError(f"HTTP {resp.status_code}")
+                err.transient = True
+                err.response = resp
+                raise err
+            return {"statusCode": resp.status_code, "body": resp.text,
+                    "headers": dict(resp.headers)}
 
         def run(r: dict) -> dict:
             try:
-                resp = requests.request(
-                    r.get("method", "POST"), r["url"],
-                    data=r.get("body"), headers=r.get("headers"),
-                    timeout=self.getTimeout())
-                return {"statusCode": resp.status_code, "body": resp.text,
-                        "headers": dict(resp.headers)}
+                if policy is None:
+                    return attempt(r)
+                return policy.run(lambda _a: attempt(r))
             except Exception as e:  # malformed request dicts (e.g. no
                 # 'url') must fail their row, not the whole batch — same
                 # per-row contract as a network error
+                resp = getattr(e, "response", None)
+                if resp is not None:   # retries exhausted on a 5xx: give
+                    # the caller the real response, not an opaque error
+                    return {"statusCode": resp.status_code,
+                            "body": resp.text,
+                            "headers": dict(resp.headers)}
                 return {"statusCode": 0, "body": None, "error": str(e)}
 
         with ThreadPoolExecutor(self.getConcurrency()) as pool:

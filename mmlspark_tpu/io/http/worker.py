@@ -38,8 +38,9 @@ class WorkerServer:
     stranding their clients (a drain-and-forget handoff would drop them)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 control_port: int = 0):
-        self.source = HTTPSource(host=host, port=port, name="worker")
+                 control_port: int = 0, max_queue_depth: int = 0):
+        self.source = HTTPSource(host=host, port=port, name="worker",
+                                 max_queue_depth=max_queue_depth)
         self._unacked: dict[str, str] = {}   # id -> value, insertion order
         self._lock = threading.Lock()
         worker = self
@@ -57,6 +58,15 @@ class WorkerServer:
                 if self.path == "/health":
                     self._json(200, {"ok": True,
                                      "port": worker.source.port})
+                elif self.path == "/healthz":
+                    # the supervisor's probe surface: liveness + load +
+                    # breaker states (same payload shape as the public
+                    # port's /healthz, plus the unacked poll backlog)
+                    h = worker.source.health()
+                    with worker._lock:
+                        h["unacked"] = len(worker._unacked)
+                    h["port"] = worker.source.port
+                    self._json(200, h)
                 elif self.path == "/metrics":
                     # same exposition as the public port's GET /metrics, so
                     # a scraper confined to the control plane still sees
@@ -127,8 +137,12 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0)
     ap.add_argument("--control-port", type=int, default=0)
+    ap.add_argument("--max-queue-depth", type=int, default=0,
+                    help="load-shed (503 + Retry-After) past this many "
+                         "queued requests; 0 = unbounded")
     args = ap.parse_args(argv)
-    w = WorkerServer(args.host, args.port, args.control_port)
+    w = WorkerServer(args.host, args.port, args.control_port,
+                     max_queue_depth=args.max_queue_depth)
     print(json.dumps({"port": w.source.port, "control": w.control_port}),
           flush=True)
     try:

@@ -4,12 +4,15 @@ JSON POST of row batches per partition to a push-dataset url)."""
 from __future__ import annotations
 
 import json
+from typing import Optional
 
 import numpy as np
 import requests
 
 from ..core.dataframe import DataFrame
 from ..core.utils import get_logger
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
 
 log = get_logger("io.powerbi")
 
@@ -28,20 +31,38 @@ def _jsonable_rows(df: DataFrame) -> list[dict]:
     return rows
 
 
+def _post_batch(url: str, payload: str, timeout: float):
+    """One POST; non-2xx raises IOError tagged ``transient`` for 5xx/429
+    so the shared RetryPolicy classification can tell a rate-limit blip
+    from a 4xx that will never succeed."""
+    faults.inject("powerbi.post")
+    resp = requests.post(url, data=payload,
+                         headers={"Content-Type": "application/json"},
+                         timeout=timeout)
+    if not (200 <= resp.status_code < 300):
+        err = IOError(f"PowerBI POST failed: {resp.status_code} "
+                      f"{resp.text[:200]}")
+        err.transient = resp.status_code >= 500 or resp.status_code == 429
+        raise err
+    return resp
+
+
 def write(df: DataFrame, url: str, batch_size: int = 1000,
-          timeout: float = 30.0) -> int:
+          timeout: float = 30.0, retry: Optional[RetryPolicy] = None) -> int:
     """POST rows as JSON arrays in batches per partition; returns the number
-    of batches sent. Raises on non-2xx like the reference's writer."""
+    of batches sent. Raises on non-2xx like the reference's writer.
+    ``retry`` (a shared RetryPolicy) re-attempts transient failures —
+    connection errors, timeouts, 5xx/429 — per batch; default None keeps
+    the single-attempt contract (StreamWriter supplies its own backoff)."""
     sent = 0
     for part in df.partitions():
         for batch in part.iterBatches(batch_size):
             payload = json.dumps({"rows": _jsonable_rows(batch)})
-            resp = requests.post(
-                url, data=payload,
-                headers={"Content-Type": "application/json"}, timeout=timeout)
-            if not (200 <= resp.status_code < 300):
-                raise IOError(f"PowerBI POST failed: {resp.status_code} "
-                              f"{resp.text[:200]}")
+            if retry is None:
+                _post_batch(url, payload, timeout)
+            else:
+                retry.run(lambda _a, p=payload: _post_batch(url, p,
+                                                            timeout))
             sent += 1
     return sent
 
@@ -53,7 +74,8 @@ class StreamWriter:
     getBatch or a generator over a live table)."""
 
     def __init__(self, get_batch, url: str, interval: float = 1.0,
-                 batch_size: int = 1000, timeout: float = 30.0):
+                 batch_size: int = 1000, timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None):
         import threading
         self._get_batch = get_batch
         self.url = url
@@ -62,6 +84,14 @@ class StreamWriter:
         self.timeout = timeout
         self.batches_sent = 0
         self.errors = 0
+        # the shared backoff schedule (replacing this writer's old
+        # fixed-interval retry): attempts are unbounded — at-least-once
+        # delivery retries forever — but the wait between them grows with
+        # the consecutive-failure streak, full-jitter, capped at 30s
+        self.retry = retry or RetryPolicy(
+            name="powerbi.stream", max_attempts=2 ** 31,
+            base_delay=max(interval, 1e-3), max_delay=30.0)
+        self._fail_streak = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -83,14 +113,20 @@ class StreamWriter:
                                                batch_size=self.batch_size,
                                                timeout=self.timeout)
                     pending = None
+                    self._fail_streak = 0
                 except Exception as e:  # sink failure: retry this batch
                     log.warning("powerbi stream post failed (will retry): %s",
                                 e)
                     self.errors += 1
                     pending = df
-            # throttle EVERY tick — the PowerBI push API is rate-limited and
-            # a down endpoint must not spin the loop hot
-            self._stop.wait(self.interval)
+                    self._fail_streak += 1
+            # throttle EVERY tick — the PowerBI push API is rate-limited
+            # and a down endpoint must not spin the loop hot. A failure
+            # streak stretches the wait by the policy's jittered backoff.
+            wait = self.interval
+            if self._fail_streak:
+                wait = max(wait, self.retry.backoff(self._fail_streak - 1))
+            self._stop.wait(wait)
 
     def start(self) -> "StreamWriter":
         self._thread.start()

@@ -34,6 +34,8 @@ from ..core.utils import get_logger, to_float32_matrix
 from ..parallel import mesh as meshlib
 from ..parallel import sequence
 from .. import telemetry
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
 from .modules import TOKEN_MODELS, build_model
 from .tpu_model import TpuModel, _prep_input
 
@@ -58,6 +60,14 @@ _m_transfer_bytes = telemetry.registry.counter(
 
 #: abstract-shape signatures already dispatched (recompile detection)
 _seen_step_sigs: set = set()
+
+#: retry-once-on-transient around each dispatched optimizer step
+#: (preemption blips, injected ``trainer.step`` faults). The injection
+#: site fires BEFORE the dispatch, so a retried attempt re-enters with
+#: the donated batch buffers still intact; a genuinely fatal error (bad
+#: model code) classifies non-transient and raises immediately.
+_STEP_RETRY = RetryPolicy(name="trainer.step", max_attempts=2,
+                          base_delay=0.05, max_delay=0.25)
 
 
 def _note_step_signature(tag: str, *arrays):
@@ -207,6 +217,15 @@ def _replace_like(host_tree, placed_tree):
 
 
 _require_inner_block_local = meshlib.require_inner_block_local
+
+
+def _fmt_pos(pos: Optional[tuple]) -> str:
+    """Human form of a checkpoint position tuple for error messages."""
+    if pos is None:
+        return "none"
+    epoch, step = pos
+    return (f"epoch {epoch}" if step is None
+            else f"epoch {epoch} step {step}")
 
 
 def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
@@ -375,6 +394,13 @@ class TpuLearner(Estimator):
     shuffle = BooleanParam("shuffle each epoch", default=True)
     checkpointDir = StringParam("per-epoch checkpoint directory ('' = off)",
                                 default="")
+    checkpointEverySteps = IntParam(
+        "also checkpoint every N optimizer steps WITHIN an epoch (0 = "
+        "epoch boundaries only). Step checkpoints make long epochs "
+        "preemption-tolerant: a killed fit resumes from the last step "
+        "interval instead of the last epoch. Applies to the per-step "
+        "feed/stream paths; the scan path's epoch is already one "
+        "dispatch. Requires checkpointDir", default=0, min=0)
     tensorParallel = IntParam("size of the model (TP) mesh axis", default=1,
                               min=1)
     sequenceParallel = IntParam("size of the sequence (SP) mesh axis "
@@ -417,19 +443,45 @@ class TpuLearner(Estimator):
         "one — only the overlap changes", default=2, min=0)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
-    def _ckpt_path(self, epoch: int) -> str:
-        return os.path.join(self.getCheckpointDir(), f"ckpt_{epoch:05d}.msgpack")
+    # Two granularities: ``ckpt_EEEEE.msgpack`` marks epoch E COMPLETE;
+    # ``ckpt_EEEEE_sSSSSSSS.msgpack`` (checkpointEverySteps > 0) marks
+    # step S within epoch E done — preemption tolerance for long epochs.
+    def _ckpt_path(self, epoch: int, step: Optional[int] = None) -> str:
+        name = (f"ckpt_{epoch:05d}.msgpack" if step is None
+                else f"ckpt_{epoch:05d}_s{step:07d}.msgpack")
+        return os.path.join(self.getCheckpointDir(), name)
 
-    def _latest_checkpoint(self) -> Optional[int]:
+    @staticmethod
+    def _parse_ckpt_name(fname: str) -> Optional[tuple]:
+        """'ckpt_00002.msgpack' -> (2, None); 'ckpt_00002_s0000005.msgpack'
+        -> (2, 5); anything else -> None."""
+        if not (fname.startswith("ckpt_") and fname.endswith(".msgpack")):
+            return None
+        stem = fname[len("ckpt_"):-len(".msgpack")]
+        try:
+            if "_s" in stem:
+                e, s = stem.split("_s", 1)
+                return int(e), int(s)
+            return int(stem), None
+        except ValueError:
+            return None
+
+    def _latest_checkpoint(self) -> Optional[tuple]:
+        """The newest training position on disk as ``(epoch, step)`` —
+        ``step is None`` means the epoch completed. An epoch-final
+        checkpoint outranks any step checkpoint of the same epoch."""
         d = self.getCheckpointDir()
         if not d or not os.path.isdir(d):
             return None
-        epochs = [int(f.split("_")[1].split(".")[0])
-                  for f in os.listdir(d)
-                  if f.startswith("ckpt_") and f.endswith(".msgpack")]
-        return max(epochs) if epochs else None
+        found = [p for p in map(self._parse_ckpt_name, os.listdir(d))
+                 if p is not None]
+        if not found:
+            return None
+        return max(found, key=lambda p: (p[0], p[1] is None,
+                                         -1 if p[1] is None else p[1]))
 
-    def _save_checkpoint(self, epoch: int, params, opt_state):
+    def _save_checkpoint(self, epoch: int, params, opt_state,
+                         step: Optional[int] = None):
         os.makedirs(self.getCheckpointDir(), exist_ok=True)
         state = {"params": _host_tree(params),
                  "opt": serialization.to_state_dict(_host_tree(opt_state))}
@@ -439,45 +491,60 @@ class TpuLearner(Estimator):
         # writes the (identical, replicated) state, and a common tmp would
         # let one process truncate another's half-written file before its
         # atomic rename publishes it
-        path = self._ckpt_path(epoch)
+        path = self._ckpt_path(epoch, step)
         tmp = f"{path}.tmp.{jax.process_index()}"
         with open(tmp, "wb") as f:
             f.write(serialization.msgpack_serialize(state))
         os.replace(tmp, path)
+        if step is None:
+            # the epoch-final save supersedes its step checkpoints: prune
+            # them so resumes stay O(1) files per epoch and _latest never
+            # prefers stale mid-epoch state
+            d = self.getCheckpointDir()
+            for f in os.listdir(d):
+                p = self._parse_ckpt_name(f)
+                if p is not None and p[0] == epoch and p[1] is not None:
+                    try:
+                        os.remove(os.path.join(d, f))
+                    except OSError:
+                        pass   # another process pruned it first
 
-    def _restore_checkpoint(self, epoch: int, params_tmpl, opt_tmpl):
-        with open(self._ckpt_path(epoch), "rb") as f:
+    def _restore_checkpoint(self, pos: tuple, params_tmpl, opt_tmpl):
+        with open(self._ckpt_path(*pos), "rb") as f:
             state = serialization.msgpack_restore(f.read())
         params = serialization.from_state_dict(params_tmpl, state["params"])
         opt = serialization.from_state_dict(opt_tmpl, state["opt"])
         return params, opt
 
-    def _consensus_resume(self, resume, nproc: int):
+    def _consensus_resume(self, resume: Optional[tuple], nproc: int):
         """Multi-host: resume only when EVERY process sees the same
-        checkpoint epoch (shared filesystem); otherwise processes would
-        run different epoch counts -> mismatched collectives -> deadlock.
+        checkpoint position (shared filesystem); otherwise processes would
+        run different step counts -> mismatched collectives -> deadlock.
         Shared by fit() and fitStream()."""
         if nproc <= 1 or not self.getCheckpointDir():
             return resume
         from jax.experimental import multihost_utils
-        seen = multihost_utils.process_allgather(
-            np.asarray(-1 if resume is None else resume))
-        if seen.min() == seen.max() and seen.min() >= 0:
-            return int(seen.min())
-        if seen.max() >= 0:
+        enc = ((-1, -1) if resume is None
+               else (resume[0], -1 if resume[1] is None else resume[1]))
+        seen = multihost_utils.process_allgather(np.asarray(enc))
+        if (seen == seen[0]).all() and seen[0][0] >= 0:
+            e, s = int(seen[0][0]), int(seen[0][1])
+            return (e, None if s < 0 else s)
+        if seen[:, 0].max() >= 0:
             log.warning(
-                "checkpoint epochs differ across processes (%s) — "
+                "checkpoint positions differ across processes (%s) — "
                 "checkpointDir is not shared storage; starting fresh on "
                 "all processes", seen.tolist())
         return None
 
     def _resume_training_state(self, params, opt_state, nproc: int):
-        """Consensus-pick the resume epoch and restore (params, opt_state)
-        onto their existing mesh shardings. Returns (params, opt_state,
-        start_epoch). Shared by fit() and fitStream()."""
+        """Consensus-pick the resume position and restore (params,
+        opt_state) onto their existing mesh shardings. Returns (params,
+        opt_state, start_epoch, start_step). Shared by fit() and
+        fitStream()."""
         resume = self._consensus_resume(self._latest_checkpoint(), nproc)
         if resume is None:
-            return params, opt_state, 0
+            return params, opt_state, 0, 0
         placed = (params, opt_state)
         params, opt_state = self._restore_checkpoint(resume, params,
                                                      opt_state)
@@ -486,8 +553,12 @@ class TpuLearner(Estimator):
             # shardings (replicated for dp, model/expert axes for tp/ep)
             params = _replace_like(params, placed[0])
             opt_state = _replace_like(opt_state, placed[1])
-        log.info("resumed from checkpoint epoch %d", resume)
-        return params, opt_state, resume + 1
+        epoch, step = resume
+        if step is None:
+            log.info("resumed from checkpoint epoch %d", epoch)
+            return params, opt_state, epoch + 1, 0
+        log.info("resumed from checkpoint epoch %d step %d", epoch, step)
+        return params, opt_state, epoch, step + 1
 
     # ---- training ----
     def fit(self, df: DataFrame) -> TpuModel:
@@ -648,8 +719,8 @@ class TpuLearner(Estimator):
         rng_np = np.random.default_rng(
             self.getSeed() + (0 if meshlib.in_local_fit()
                               else jax.process_index()))
-        params, opt_state, start_epoch = self._resume_training_state(
-            params, opt_state, nproc)
+        params, opt_state, start_epoch, start_step = \
+            self._resume_training_state(params, opt_state, nproc)
 
         # concurrent fits from a thread pool (TuneHyperparameters) must not
         # interleave collective programs across the same devices — same
@@ -663,7 +734,8 @@ class TpuLearner(Estimator):
             params, opt_state, last_loss = self._run_epochs(
                 start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
                 nproc=nproc, train_step=train_step, params=params,
-                opt_state=opt_state, scan_fn=scan_fn)
+                opt_state=opt_state, scan_fn=scan_fn,
+                start_step=start_step)
 
         return self._package_model(cfg, params, last_loss)
 
@@ -746,8 +818,15 @@ class TpuLearner(Estimator):
             self.getMoeAuxWeight() if is_moe else 0.0)
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
-        params, opt_state, start_epoch = self._resume_training_state(
-            params, opt_state, nproc)
+        params, opt_state, start_epoch, start_step = \
+            self._resume_training_state(params, opt_state, nproc)
+        if start_step:
+            # a stream cannot skip deterministically to step N (the
+            # generator is opaque); restart the epoch — the checkpointed
+            # optimizer state is kept, some stream batches are re-seen
+            log.warning("step checkpoint (epoch %d, step %d) resumes at "
+                        "the epoch start on the stream path", start_epoch,
+                        start_step - 1)
 
         from ..parallel import prefetch as prefetchlib
         axis = mesh.shape["data"]
@@ -779,14 +858,24 @@ class TpuLearner(Estimator):
                     lambda s=stream: self._stream_epoch_steps(
                         s, cfg, x0, y0, share, nproc, mesh),
                     depth=depth, name="fit-stream", span="fit/prefetch")
+                ckpt_every = (self.getCheckpointEverySteps()
+                              if self.getCheckpointDir() else 0)
                 try:
                     for n, xb, yb, wb in steps_it:
                         with _m_step_time.time():
-                            params, opt_state, loss = train_step(
-                                params, opt_state, xb, yb, wb)
+                            def dispatch(_a, p=params, o=opt_state,
+                                         xb=xb, yb=yb, wb=wb):
+                                faults.inject("trainer.step")
+                                return train_step(p, o, xb, yb, wb)
+                            params, opt_state, loss = _STEP_RETRY.run(
+                                dispatch)
                         steps_run += 1
                         if n:
                             n_batches += 1
+                        if ckpt_every and steps_run % ckpt_every == 0 \
+                                and jax.process_index() == 0:
+                            self._save_checkpoint(epoch, params, opt_state,
+                                                  step=steps_run - 1)
                 finally:
                     steps_it.close()
                 if steps_run == 0:
@@ -858,8 +947,16 @@ class TpuLearner(Estimator):
 
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state,
-                    scan_fn=None):
+                    scan_fn=None, start_step=0):
         if scan_fn is not None:
+            if start_step:
+                # the scan path cannot enter an epoch mid-way (one dispatch
+                # covers the whole window set); restart the epoch — params
+                # already contain the checkpointed steps, so nothing is
+                # lost, some rows are just seen again this epoch
+                log.warning("step checkpoint (epoch %d, step %d) resumes "
+                            "at the epoch start on the scan path",
+                            start_epoch, start_step - 1)
             return self._run_epochs_scan(start_epoch, x, y, n, bs, steps,
                                          order_rng=order_rng, mesh=mesh,
                                          scan_fn=scan_fn, params=params,
@@ -901,7 +998,11 @@ class TpuLearner(Estimator):
             for epoch in range(start_epoch, self.getEpochs()):
                 order = (order_rng.permutation(n) if self.getShuffle()
                          else np.arange(n))
-                for s in range(steps):
+                # a step-checkpoint resume re-enters its epoch at the next
+                # step (fresh permutation — best-effort data order, exact
+                # optimizer state)
+                s0 = start_step if epoch == start_epoch else 0
+                for s in range(s0, steps):
                     # cyclic slice: a process whose shard is shorter than
                     # its share of the global batch wraps (repeats) its rows
                     # so every process contributes exactly bs rows —
@@ -934,15 +1035,24 @@ class TpuLearner(Estimator):
         it = prefetchlib.prefetched(produce, depth=self.getPrefetchDepth(),
                                     name="fit-feed", span="fit/prefetch")
         try:
+            ckpt_every = (self.getCheckpointEverySteps()
+                          if self.getCheckpointDir() else 0)
             for epoch, s, xb, yb, wb in it:
                 t_step = time.perf_counter()
                 with telemetry.trace.span("fit/step", epoch=epoch,
                                           step=s) as sp:
-                    params, opt_state, loss = train_step(params, opt_state,
-                                                         xb, yb, wb)
+                    def dispatch(_a, p=params, o=opt_state, xb=xb, yb=yb,
+                                 wb=wb):
+                        faults.inject("trainer.step")
+                        return train_step(p, o, xb, yb, wb)
+                    params, opt_state, loss = _STEP_RETRY.run(dispatch)
                     sp.set_sync(loss)
                 _m_step_time.observe(time.perf_counter() - t_step)
                 if s < steps - 1:
+                    if ckpt_every and (s + 1) % ckpt_every == 0 \
+                            and jax.process_index() == 0:
+                        self._save_checkpoint(epoch, params, opt_state,
+                                              step=s)
                     continue
                 # ---- epoch finalize (an early exit below must stop the
                 # producer promptly: the finally closes the prefetcher) ----
@@ -957,9 +1067,9 @@ class TpuLearner(Estimator):
                     raise RuntimeError(
                         f"training diverged: epoch {epoch} loss is "
                         f"{last_loss} (lr={self.getLearningRate()}). "
-                        + (f"Last good checkpoint: epoch {last_good} in "
-                           f"{self.getCheckpointDir()!r}; refit resumes "
-                           f"there." if last_good is not None
+                        + (f"Last good checkpoint: {_fmt_pos(last_good)} "
+                           f"in {self.getCheckpointDir()!r}; refit "
+                           f"resumes there." if last_good is not None
                            else "Set checkpointDir to make divergence "
                                 "resumable."))
                 if self.getCheckpointDir() and jax.process_index() == 0:
@@ -1034,9 +1144,11 @@ class TpuLearner(Estimator):
                     with telemetry.trace.span(
                             "fit/step", epoch=epoch, first_step=lo,
                             steps=min(kpd, steps - lo)) as sp:
-                        params, opt_state, loss = scan_fn(
-                            params, opt_state, x_dev, y_dev, w_dev,
-                            starts[lo:lo + kpd])
+                        def dispatch(_a, p=params, o=opt_state, lo=lo):
+                            faults.inject("trainer.step")
+                            return scan_fn(p, o, x_dev, y_dev, w_dev,
+                                           starts[lo:lo + kpd])
+                        params, opt_state, loss = _STEP_RETRY.run(dispatch)
                         sp.set_sync(loss)
                     _m_step_time.observe(time.perf_counter() - t_disp)
                 ep_sp.set_sync(loss)
@@ -1051,7 +1163,7 @@ class TpuLearner(Estimator):
                 raise RuntimeError(
                     f"training diverged: epoch {epoch} loss is {last_loss} "
                     f"(lr={self.getLearningRate()}). "
-                    + (f"Last good checkpoint: epoch {last_good} in "
+                    + (f"Last good checkpoint: {_fmt_pos(last_good)} in "
                        f"{self.getCheckpointDir()!r}; refit resumes there."
                        if last_good is not None
                        else "Set checkpointDir to make divergence resumable."))

@@ -37,6 +37,7 @@ from ..core.dataframe import (DataFrame, GroupedData, _NULL_SENTINEL,
                               _copy_meta, _gather_with_nulls, _hashable)
 from ..core.utils import get_logger, object_column
 from .. import telemetry
+from ..resilience import faults
 
 log = get_logger("dataplane")
 
@@ -74,6 +75,7 @@ def shard_paths(paths: Sequence[str]) -> list[str]:
 def allgather_bytes(payload: bytes) -> list[bytes]:
     """Gather one bytes payload from every process (two fixed-shape
     collectives: lengths, then right-padded buffers)."""
+    faults.inject("dataplane.allgather")
     if nprocs() == 1:
         return [payload]
     _m_collectives.inc()

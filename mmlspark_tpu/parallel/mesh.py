@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..resilience import faults
 
 # host->HBM placement telemetry: every sharded batch/replicated-tree put
 # made through this module (trainer feeds, GBDT bin uploads, serving
@@ -233,6 +234,7 @@ def put_global_batch(arr, mesh: Mesh, batch_axis: str = "data"):
     array is assembled from every process's shard (the reference has no
     analog — its data stays in Spark partitions and is shipped per-worker
     over scp/JNI, CommandBuilders.scala:200-228)."""
+    faults.inject("dataplane.put")
     if not telemetry.enabled():
         if effective_process_count() == 1:
             if mesh.size == 1:  # trivial mesh: stay off the SPMD path

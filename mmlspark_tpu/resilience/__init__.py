@@ -1,0 +1,38 @@
+"""Resilience subsystem: unified retry/backoff/circuit-breaking policies,
+deterministic fault injection, and serving-fleet supervision.
+
+The ROADMAP north star is a serving system for "heavy traffic from millions
+of users"; at that scale transient failure is the steady state, not the
+exception. Before this package every network/IO call site hand-rolled its
+own recovery (or had none): the fleet driver dropped undeliverable replies,
+the PowerBI writer retried on a fixed interval, the trainer checkpointed
+only at epoch boundaries, and nothing restarted a dead serving worker.
+
+Three pillars, adopted across io/http, io/powerbi, parallel/dataplane and
+the trainer:
+
+  * :mod:`policy`     — :class:`RetryPolicy` (exponential backoff, full
+                        jitter, deadline budget, transient-vs-fatal error
+                        classification) and :class:`CircuitBreaker`
+                        (closed/open/half-open, per-target);
+  * :mod:`faults`     — seeded, env-gated fault injection
+                        (``MMLSPARK_TPU_FAULTS``) with named sites threaded
+                        through the serving/data/training paths, so every
+                        recovery path is testable on CPU in CI;
+  * :mod:`supervisor` — :class:`FleetSupervisor`: health probing, automatic
+                        worker restart with backoff, and redispatch of a
+                        dead worker's in-flight rows.
+
+Everything reports through :mod:`mmlspark_tpu.telemetry` (retry counters,
+breaker-state gauges, injected-fault counters, restart counters); see
+docs/reliability.md.
+"""
+
+from __future__ import annotations
+
+from . import faults
+from .policy import BreakerOpen, CircuitBreaker, RetryPolicy
+from .supervisor import FleetSupervisor
+
+__all__ = ["faults", "BreakerOpen", "CircuitBreaker", "RetryPolicy",
+           "FleetSupervisor"]

@@ -500,7 +500,8 @@ def test_worker_crash_then_checkpoint_resume(tmp_path):
             "                            'num_classes': 2})\n"
             f"           .setEpochs({epochs}).setBatchSize(16)\n"
             "           .setLearningRate(0.05).setCheckpointDir(ck))\n"
-            "resumed_from = learner._latest_checkpoint()\n"
+            "pos = learner._latest_checkpoint()\n"
+            "resumed_from = -1 if pos is None else pos[0]\n"
             "model = learner.fit(df)\n"
             "assert np.isfinite(model._final_loss)\n"
             "dist.shutdown()\n"

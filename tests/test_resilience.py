@@ -1,0 +1,594 @@
+"""Resilience subsystem: retry/breaker policies, deterministic fault
+injection, and chaos tests driving the serving fleet + trainer recovery
+paths on CPU (fast, seeded, tier-1 — the ``chaos`` marker).
+
+The fleet chaos tests run the worker servers IN-PROCESS (WorkerServer +
+spawn=False handles) so a kill/restart cycle costs milliseconds, not a
+subprocess jax import; the real-subprocess fleet lives in
+test_serving_fleet.py's extended tier.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.core.dataframe import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.io.http.fleet import ProcessHTTPSource, ReplayServingLoop, \
+    _Worker
+from mmlspark_tpu.io.http.worker import WorkerServer
+from mmlspark_tpu.resilience import faults
+from mmlspark_tpu.resilience.policy import (BreakerOpen, CircuitBreaker,
+                                            RetryPolicy, default_transient)
+from mmlspark_tpu.resilience.supervisor import FleetSupervisor
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def telemetry_on():
+    telemetry.enable()
+    telemetry.registry.reset()
+    yield telemetry
+    telemetry.disable()
+
+
+# --------------------------------------------------------------- policies
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        sleeps = []
+        p = RetryPolicy(max_attempts=4, base_delay=0.1, seed=0,
+                        sleep=sleeps.append)
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            if attempt < 2:
+                raise ConnectionError("blip")
+            return "ok"
+
+        assert p.run(fn) == "ok"
+        assert calls == [0, 1, 2]
+        assert len(sleeps) == 2
+
+    def test_fatal_errors_not_retried(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.0)
+        calls = []
+
+        def fn(attempt):
+            calls.append(attempt)
+            raise ValueError("bad input")
+
+        with pytest.raises(ValueError):
+            p.run(fn)
+        assert calls == [0]
+
+    def test_budget_exhaustion_raises_last_error(self):
+        p = RetryPolicy(max_attempts=3, base_delay=0.0)
+        with pytest.raises(TimeoutError):
+            p.run(lambda a: (_ for _ in ()).throw(TimeoutError(str(a))))
+
+    def test_full_jitter_bounds(self):
+        p = RetryPolicy(base_delay=0.1, multiplier=2.0, max_delay=0.5,
+                        seed=7)
+        for attempt in range(8):
+            cap = min(0.5, 0.1 * 2 ** attempt)
+            for _ in range(20):
+                assert 0.0 <= p.backoff(attempt) <= cap
+
+    def test_deadline_budget(self):
+        # base_delay 10s >> deadline: the first retry would blow the
+        # budget, so the policy gives up immediately without sleeping
+        sleeps = []
+        p = RetryPolicy(max_attempts=10, base_delay=10.0, multiplier=1.0,
+                        max_delay=10.0, deadline=0.05, seed=1,
+                        sleep=sleeps.append)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            p.run(lambda a: (_ for _ in ()).throw(ConnectionError()))
+        assert time.monotonic() - t0 < 1.0
+        assert not sleeps
+
+    def test_default_classification(self):
+        assert default_transient(ConnectionError())
+        assert default_transient(TimeoutError())
+        assert default_transient(urllib.error.URLError("x"))
+        assert default_transient(faults.InjectedFault("s"))
+        assert not default_transient(ValueError())
+        assert not default_transient(KeyError())
+        err = ValueError("tagged")
+        err.transient = True
+        assert default_transient(err)
+        http500 = urllib.error.HTTPError("u", 500, "boom", {}, None)
+        http404 = urllib.error.HTTPError("u", 404, "gone", {}, None)
+        assert default_transient(http500)
+        assert not default_transient(http404)
+
+    def test_retry_metrics(self, telemetry_on):
+        p = RetryPolicy(name="t.metrics", max_attempts=2, base_delay=0.0)
+        with pytest.raises(ConnectionError):
+            p.run(lambda a: (_ for _ in ()).throw(ConnectionError()))
+        snap = telemetry.snapshot()
+        series = {tuple(s["labels"].items()): s["value"]
+                  for s in snap["mmlspark_retry_attempts_total"]["series"]}
+        assert series[(("policy", "t.metrics"),)] == 1
+        series = {tuple(s["labels"].items()): s["value"]
+                  for s in snap["mmlspark_retry_exhausted_total"]["series"]}
+        assert series[(("policy", "t.metrics"),)] == 1
+
+
+class TestCircuitBreaker:
+    def _clock(self):
+        t = {"now": 0.0}
+
+        def clock():
+            return t["now"]
+        return t, clock
+
+    def test_state_machine(self):
+        t, clock = self._clock()
+        b = CircuitBreaker("test.sm", failure_threshold=2,
+                           reset_timeout=1.0, clock=clock)
+        assert b.allow("w") and b.state("w") == "closed"
+        b.record("w", ok=False)
+        assert b.state("w") == "closed"     # one failure: still closed
+        b.record("w", ok=False)
+        assert b.state("w") == "open"       # threshold reached
+        assert not b.allow("w")             # short-circuited
+        t["now"] = 1.5                      # reset window elapsed
+        assert b.allow("w")                 # half-open probe admitted
+        assert b.state("w") == "half_open"
+        assert not b.allow("w")             # only one probe in flight
+        b.record("w", ok=True)
+        assert b.state("w") == "closed"     # probe success closes
+
+    def test_half_open_failure_reopens(self):
+        t, clock = self._clock()
+        b = CircuitBreaker("test.ho", failure_threshold=1,
+                           reset_timeout=1.0, clock=clock)
+        b.record("w", ok=False)
+        t["now"] = 1.1
+        assert b.allow("w")
+        b.record("w", ok=False)
+        assert b.state("w") == "open"
+        assert not b.allow("w")
+
+    def test_call_wrapper_and_targets_independent(self):
+        b = CircuitBreaker("test.call", failure_threshold=1,
+                           reset_timeout=60.0)
+        with pytest.raises(RuntimeError):
+            b.call(lambda: (_ for _ in ()).throw(RuntimeError()), "a")
+        with pytest.raises(BreakerOpen):
+            b.call(lambda: "x", "a")
+        assert b.call(lambda: "fine", "b") == "fine"   # target b unharmed
+        b.reset("a")
+        assert b.call(lambda: "back", "a") == "back"
+
+    def test_snapshot_all(self):
+        b = CircuitBreaker("test.snap", failure_threshold=1)
+        b.record("t0", ok=False)
+        snap = CircuitBreaker.snapshot_all()
+        assert snap["test.snap"]["t0"] == "open"
+
+
+# --------------------------------------------------------- fault injection
+
+class TestFaultInjection:
+    def test_spec_parsing_and_validation(self):
+        assert faults.parse("a.b:error:0.5") == [("a.b", "error", 0.5, [])]
+        assert faults.parse("a:delay:1.0:0.02 ; b:error:0.1:3:2") == [
+            ("a", "delay", 1.0, ["0.02"]), ("b", "error", 0.1, ["3", "2"])]
+        with pytest.raises(ValueError):
+            faults.parse("missing-fields")
+        with pytest.raises(ValueError):
+            faults.configure("a:explode:0.5")
+        with pytest.raises(ValueError):
+            faults.configure("a:error:1.5")
+
+    def test_off_by_default_and_clear(self):
+        assert not faults.active()
+        faults.inject("anything")           # no-op, no error
+        faults.configure("x:error:1.0")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("x")
+        faults.clear()
+        faults.inject("x")                  # disarmed again
+
+    def test_seeded_determinism(self):
+        def pattern():
+            faults.configure("d.site:error:0.3", seed=42)
+            hits = []
+            for _ in range(100):
+                try:
+                    faults.inject("d.site")
+                    hits.append(0)
+                except faults.InjectedFault:
+                    hits.append(1)
+            return hits
+
+        a, b = pattern(), pattern()
+        assert a == b                       # same seed -> same pattern
+        assert 10 < sum(a) < 60             # ~30% of 100
+        faults.configure("d.site:error:0.3", seed=43)
+        c = [0] * 100
+        for i in range(100):
+            try:
+                faults.inject("d.site")
+            except faults.InjectedFault:
+                c[i] = 1
+        assert c != a                       # different seed -> different
+
+    def test_error_after_and_budget_args(self):
+        faults.configure("t:error:1.0:2:1")    # arm after 2 calls, 1 total
+        faults.inject("t")
+        faults.inject("t")                     # 2 clean warmup calls
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("t")
+        faults.inject("t")                     # budget spent: clean again
+
+    def test_delay_kind_sleeps(self):
+        faults.configure("slow:delay:1.0:0.02")
+        t0 = time.perf_counter()
+        faults.inject("slow")
+        assert time.perf_counter() - t0 >= 0.02
+
+    def test_env_gating(self, monkeypatch):
+        monkeypatch.setenv("MMLSPARK_TPU_FAULTS", "e.site:error:1.0")
+        monkeypatch.setenv("MMLSPARK_TPU_FAULTS_SEED", "9")
+        faults._init_from_env()
+        assert faults.active()
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("e.site")
+
+    def test_injected_counter(self, telemetry_on):
+        faults.configure("m.site:error:1.0")
+        with pytest.raises(faults.InjectedFault):
+            faults.inject("m.site")
+        snap = telemetry.snapshot()["mmlspark_faults_injected_total"]
+        assert any(s["labels"] == {"site": "m.site", "kind": "error"}
+                   and s["value"] == 1 for s in snap["series"])
+
+
+# ------------------------------------------------------- serving: healthz
+
+class _Echo:
+    def transform(self, df: DataFrame) -> DataFrame:
+        replies = object_column(
+            [json.dumps({"echo": v}) for v in df.col("value")])
+        return df.withColumn("reply", replies)
+
+
+def _post(url, payload, timeout=10.0):
+    req = urllib.request.Request(url, data=payload.encode(),
+                                 headers={"Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_healthz_on_serving_server():
+    from mmlspark_tpu.io.http import serve_pipeline
+    source, loop = serve_pipeline(_Echo())
+    try:
+        code, h = _get_json(source.url.rstrip("/") + "/healthz")
+        assert code == 200 and h["ok"] is True
+        assert h["queue_depth"] == 0
+        assert h["uptime_s"] >= 0
+        assert isinstance(h["breakers"], dict)
+    finally:
+        loop.stop()
+        source.close()
+
+
+def test_healthz_on_worker_control_plane():
+    w = WorkerServer("127.0.0.1")
+    try:
+        code, h = _get_json(f"http://127.0.0.1:{w.control_port}/healthz")
+        assert code == 200 and h["ok"] is True
+        assert h["unacked"] == 0 and h["queue_depth"] == 0
+        assert h["port"] == w.source.port
+        # the public port answers the same probe
+        code, h2 = _get_json(f"http://127.0.0.1:{w.source.port}/healthz")
+        assert code == 200 and h2["ok"] is True
+    finally:
+        w.close()
+
+
+def test_load_shedding_503_with_retry_after(telemetry_on):
+    from mmlspark_tpu.io.http.server import HTTPSource
+    src = HTTPSource(max_queue_depth=1)
+    results = {}
+    try:
+        t = threading.Thread(target=lambda: results.update(
+            first=_post(src.url, "held", timeout=15)))
+        t.start()
+        deadline = time.monotonic() + 5
+        while src._n_pending < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert src._n_pending == 1
+        # queue full: the next request is shed immediately
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(src.url, "shed-me", timeout=5)
+        assert ei.value.code == 503
+        assert ei.value.headers["Retry-After"] == "1"
+        _, h = _get_json(src.url.rstrip("/") + "/healthz")
+        assert h["queue_depth"] == 1 and h["max_queue_depth"] == 1
+        # drain + reply: the held client completes normally
+        batch = src.getBatch(max_rows=4, timeout=1.0)
+        assert batch.count() == 1
+        src.respond(str(batch.col("id")[0]), 200, "done")
+        t.join(timeout=10)
+        assert results["first"][0] == 200
+        snap = telemetry.snapshot()["mmlspark_http_shed_requests"]
+        assert snap["series"][0]["value"] >= 1
+    finally:
+        src.close()
+
+
+# ----------------------------------------------- fleet chaos (in-process)
+
+def _inproc_fleet(n_workers: int):
+    """A real ProcessHTTPSource over IN-PROCESS WorkerServers: the full
+    control protocol (poll/ack/respond/healthz) without subprocess spawn
+    cost. Returns (servers, handles, source)."""
+    servers, handles = [], []
+    for _ in range(n_workers):
+        ws = WorkerServer("127.0.0.1")
+        servers.append(ws)
+        handles.append(_Worker("127.0.0.1", ws.source.port,
+                               ws.control_port, spawn=False))
+    return servers, ProcessHTTPSource(workers=handles)
+
+
+def _client_post(url, payload, deadline=30.0):
+    """A resilient client: retries transport errors / 5xx with backoff —
+    the contract chaos recovery relies on (a killed worker's clients see a
+    fast transport error and retry against the restarted URL)."""
+    policy = RetryPolicy(name="test.client", max_attempts=100,
+                         base_delay=0.05, max_delay=0.3, deadline=deadline,
+                         seed=0)
+    return policy.run(lambda _a: _post(url, payload, timeout=3.0))
+
+
+@pytest.mark.chaos
+def test_fleet_chaos_poll_faults_and_worker_kill(telemetry_on):
+    """The acceptance scenario: 10% injected poll errors plus one mid-run
+    worker kill. Every client request is answered exactly once with the
+    right body, the supervisor restarts the dead worker on its original
+    port, and retry/breaker/restart metrics land in the snapshot."""
+    faults.configure("fleet.poll:error:0.1", seed=0)
+    servers, src = _inproc_fleet(2)
+    ports = [w.port for w in src.workers]
+
+    def respawn(wi, old):
+        ws = WorkerServer(old.host, port=old.port, control_port=old.control)
+        servers.append(ws)
+        return _Worker(old.host, ws.source.port, ws.control_port,
+                       spawn=False)
+
+    sup = FleetSupervisor(src, probe_interval=0.05, probe_timeout=0.5,
+                          restart_backoff=0.05, respawn=respawn).start()
+    loop = ReplayServingLoop(src, _Echo(), supervisor=sup).start()
+    results: dict = {}
+    try:
+        def client(i):
+            url = f"http://127.0.0.1:{ports[i % 2]}/"
+            try:
+                results[i] = _client_post(url, f"chaos-{i}")
+            except Exception as e:       # surfaced in the assert below
+                results[i] = ("error", repr(e))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(12)]
+        for t in threads[:6]:
+            t.start()
+        time.sleep(0.3)                  # traffic flowing through faults
+        servers[0].close()               # hard-kill worker 0 mid-run
+        for t in threads[6:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=40)
+        assert len(results) == 12
+        for i, (code, body) in results.items():
+            assert code == 200, (i, code, body)
+            assert json.loads(body)["echo"] == f"chaos-{i}", (i, body)
+        # the supervisor restarted worker 0 on its original port
+        assert src.workers[0].port == ports[0]
+        assert src.aliveCount() == 2
+        snap = telemetry.snapshot()
+        restarts = sum(
+            s["value"] for s in
+            snap["mmlspark_supervisor_worker_restarts_total"]["series"])
+        assert restarts >= 1
+        injected = sum(
+            s["value"] for s in
+            snap["mmlspark_faults_injected_total"]["series"]
+            if s["labels"].get("site") == "fleet.poll")
+        assert injected >= 1
+        assert "mmlspark_breaker_state" in snap
+        assert "mmlspark_retry_attempts_total" in snap
+    finally:
+        loop.stop()                      # also stops the supervisor
+        for ws in servers:
+            try:
+                ws.close()
+            except Exception:
+                pass
+
+
+@pytest.mark.chaos
+def test_fleet_transform_fault_replays_batch(telemetry_on):
+    """An injected dispatch fault fails the first transform attempt; the
+    replay contract re-reads the same offset range and the clients never
+    see it."""
+    faults.configure("fleet.transform:error:1.0:0:1", seed=0)  # first call
+    servers, src = _inproc_fleet(1)
+    loop = ReplayServingLoop(src, _Echo()).start()
+    try:
+        code, body = _client_post(src.workers[0].url, "replayed")
+        assert code == 200 and json.loads(body)["echo"] == "replayed"
+        snap = telemetry.snapshot()["mmlspark_faults_injected_total"]
+        assert any(s["labels"].get("site") == "fleet.transform"
+                   for s in snap["series"])
+    finally:
+        loop.stop()
+        for ws in servers:
+            ws.close()
+
+
+@pytest.mark.chaos
+def test_spurious_death_verdict_resurrection(telemetry_on):
+    """The stranded-exchange fix: rows polled from a worker that got a
+    WRONG death verdict used to be dropped (their clients hung until
+    reply_timeout). Now they are parked, the supervisor's probe finds the
+    worker alive, and restoreWorker returns them to the offset log — the
+    blocked client gets its reply in milliseconds, not 30s."""
+    servers, src = _inproc_fleet(1)
+    sup = FleetSupervisor(src, probe_timeout=0.5)   # tick()ed manually
+    got: dict = {}
+    try:
+        t = threading.Thread(target=lambda: got.update(
+            r=_post(src.workers[0].url, "stranded?", timeout=20)))
+        t.start()
+        start = src.committedOffset()
+        deadline = time.monotonic() + 10
+        end = start
+        while end == start and time.monotonic() < deadline:
+            end = src.getOffset()           # row enters the offset log
+        assert end > start
+        src.markWorkerDead(0, reason="simulated spurious verdict")
+        assert src.getBatch(start, end).count() == 0   # parked, not lost
+        sup.tick()                          # probe: alive -> resurrect
+        assert src.workers[0].alive
+        end2 = src._offset
+        batch = src.getBatch(start, end2)   # redispatched under new offset
+        assert batch.col("value").tolist() == ["stranded?"]
+        out = _Echo().transform(batch)
+        for i in range(out.count()):
+            src.respond(str(out.col("id")[i]), 200, str(out.col("reply")[i]))
+        src.flush()
+        src.commit(end2)
+        t.join(timeout=10)
+        assert got["r"][0] == 200
+        assert json.loads(got["r"][1])["echo"] == "stranded?"
+        snap = telemetry.snapshot()
+        assert snap["mmlspark_fleet_rows_parked"]["series"][0]["value"] == 1
+        assert snap["mmlspark_fleet_rows_redispatched"]["series"][0][
+            "value"] == 1
+    finally:
+        for ws in servers:
+            ws.close()
+
+
+@pytest.mark.chaos
+def test_reply_delivery_retries_transient_respond_fault(telemetry_on):
+    """The seed DROPPED computed replies when one /respond round-trip
+    failed transiently (clients hung until reply_timeout). The shared
+    RetryPolicy now retries delivery within the flush."""
+    faults.configure("fleet.respond:error:1.0:0:1", seed=0)   # first call
+    servers, src = _inproc_fleet(1)
+    loop = ReplayServingLoop(src, _Echo()).start()
+    try:
+        t0 = time.monotonic()
+        code, body = _client_post(src.workers[0].url, "deliver-me")
+        assert code == 200 and json.loads(body)["echo"] == "deliver-me"
+        # delivered by the in-flush retry, NOT by a 30s reply_timeout 504
+        assert time.monotonic() - t0 < 10
+    finally:
+        loop.stop()
+        for ws in servers:
+            ws.close()
+
+
+# ------------------------------------------------------- trainer recovery
+
+def _toy_learner(ck: str):
+    from mmlspark_tpu.models.trainer import TpuLearner
+    return (TpuLearner()
+            .setModelConfig({"type": "mlp", "hidden": [4],
+                             "num_classes": 2})
+            .setEpochs(1).setBatchSize(8).setLearningRate(0.05)
+            .setDeviceDataCap(1)            # force the per-step feed path
+            .setCheckpointDir(ck).setCheckpointEverySteps(2))
+
+
+def _toy_df(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    return DataFrame({"features": object_column([r for r in x]),
+                      "label": y})
+
+
+@pytest.mark.chaos
+def test_trainer_kill_and_resume_from_step_checkpoint(tmp_path,
+                                                      telemetry_on):
+    """Preemption tolerance: a fit killed mid-epoch (armed trainer.step
+    fault that outlives the retry-once budget) leaves step-interval
+    checkpoints; the refit resumes from the last one and only runs the
+    remaining steps."""
+    ck = str(tmp_path / "ck")
+    df = _toy_df(64)                      # 64 rows / bs 8 -> 8 steps
+    faults.configure("trainer.step:error:1.0:5", seed=0)  # die at step 5
+    with pytest.raises(ConnectionError):
+        _toy_learner(ck).fit(df)
+    names = sorted(os.listdir(ck))
+    assert "ckpt_00000_s0000003.msgpack" in names       # steps 1 and 3
+    assert "ckpt_00000.msgpack" not in names            # epoch incomplete
+    faults.clear()
+
+    telemetry.registry.reset()
+    learner = _toy_learner(ck)
+    assert learner._latest_checkpoint() == (0, 3)
+    model = learner.fit(df)
+    assert np.isfinite(model._final_loss)
+    # resumed at step 4: exactly 4 of the 8 steps dispatched in the refit
+    step_hist = telemetry.snapshot()["mmlspark_trainer_step_seconds"]
+    assert step_hist["series"][0]["count"] == 4
+    # the epoch-final checkpoint pruned its step checkpoints
+    names = sorted(os.listdir(ck))
+    assert names == ["ckpt_00000.msgpack"]
+    assert learner._latest_checkpoint() == (0, None)
+
+
+@pytest.mark.chaos
+def test_trainer_step_retry_absorbs_single_fault(telemetry_on, tmp_path):
+    """One transient step fault costs a retry, not the fit: with a fault
+    budget of 1 the retry-once policy completes training."""
+    faults.configure("trainer.step:error:1.0:2:1", seed=0)
+    model = _toy_learner(str(tmp_path / "ck")).fit(_toy_df(32))
+    assert np.isfinite(model._final_loss)
+    snap = telemetry.snapshot()
+    retried = sum(s["value"]
+                  for s in snap["mmlspark_retry_attempts_total"]["series"]
+                  if s["labels"].get("policy") == "trainer.step")
+    assert retried == 1
+
+
+def test_checkpoint_name_parsing():
+    from mmlspark_tpu.models.trainer import TpuLearner
+    parse = TpuLearner._parse_ckpt_name
+    assert parse("ckpt_00002.msgpack") == (2, None)
+    assert parse("ckpt_00002_s0000005.msgpack") == (2, 5)
+    assert parse("ckpt_00002.msgpack.tmp.0") is None
+    assert parse("other.msgpack") is None
+    # epoch-final outranks same-epoch steps; later steps outrank earlier
+    learner = TpuLearner().setCheckpointDir("")
+    assert learner._latest_checkpoint() is None
