@@ -42,7 +42,7 @@ def _baseline_value(metric: str):
     return best[1] if best else None
 
 
-def main():
+def main(profile: bool = False):
     import jax
     import optax
     from mmlspark_tpu import telemetry
@@ -50,11 +50,22 @@ def main():
     from mmlspark_tpu.models.trainer import (_make_scan_epoch_fn, make_loss)
     from mmlspark_tpu.parallel import mesh as meshlib
 
+    if profile:
+        # device-profiling mode: cost analysis + compile accounting +
+        # live-buffer sampling via telemetry.profiler (adds sync points;
+        # the default no-flag run keeps the plain async dispatch timing)
+        telemetry.profiler.enable()
+
     batch = 12288         # r1 sweep: 1024->110k, 4096->119k, 8192->123k;
     # r3 sweep on the quiet chip: 8192->134k, 12288->136.6k (best),
     # 14336->134k, 16384->119k (HBM pressure)
     k_steps = 20          # optimizer steps (windows) per epoch dispatch
     n_dispatch = 3        # timed dispatches (K*n = 60 steps)
+    if jax.default_backend() == "cpu":
+        # smoke scale: the CPU backend exists to validate the pipeline
+        # (and --profile's cost/compile/HBM accounting), not to publish
+        # numbers — TPU shapes above are untouched
+        batch, k_steps, n_dispatch = 32, 2, 1
     n_rows = k_steps * batch  # device-resident epoch (uint8: ~720 MiB
     # + one margin batch; 16384-batch sweeps already hit HBM pressure)
 
@@ -68,8 +79,9 @@ def main():
     params = meshlib.put_replicated(params, mesh)
     opt_state = jax.jit(tx.init)(params)
     loss_fn = make_loss("cross_entropy", per_example=True)
-    scan_fn = _make_scan_epoch_fn(module, tx, loss_fn, False, 0.0, mesh,
-                                  batch)
+    scan_fn = telemetry.profiler.wrap(
+        _make_scan_epoch_fn(module, tx, loss_fn, False, 0.0, mesh, batch),
+        "bench.scan_epoch")
 
     margin = lambda a: np.concatenate([a, a[:batch]], axis=0)
     x_dev = meshlib.shard_batch(margin(x), mesh)
@@ -111,6 +123,11 @@ def main():
         "vs_baseline": (round(imgs_per_sec / base, 3)
                         if base else None),
     }))
+    if profile:
+        # the device-profile line: per-dispatch FLOPs/bytes, compile
+        # count + seconds + causes, achieved FLOP/s vs roofline peak,
+        # live-buffer HBM peak
+        print(json.dumps({"profile": telemetry.profiler.report()}))
     if telemetry.enabled():
         # second line: the step-breakdown context future BENCH_*.json
         # rounds carry (never emitted in the default disabled mode, so the
@@ -123,4 +140,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", action="store_true",
+                    help="capture XLA cost analysis, compile accounting "
+                         "and live-buffer HBM peaks (telemetry.profiler); "
+                         "prints an extra {\"profile\": ...} JSON line")
+    main(profile=ap.parse_args().profile)

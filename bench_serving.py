@@ -72,8 +72,14 @@ class _ChaosScorer(_ImageScorer):
 
 
 def chaos_main(fault_rate: float = 0.1, clients: int = 8,
-               per_client: int = 30):
-    """Fleet chaos run: injected poll faults + one worker kill mid-run."""
+               per_client: int = 30, trace: bool = False):
+    """Fleet chaos run: injected poll faults + one worker kill mid-run.
+    ``trace=True`` additionally enables distributed tracing in every
+    process (workers inherit MMLSPARK_TPU_TELEMETRY), collects each
+    process's span buffer at the end, and merges them into one
+    per-request Chrome trace (serving_trace.jsonl)."""
+    import os
+    import tempfile
     from mmlspark_tpu import telemetry
     from mmlspark_tpu.io.http.fleet import serve_fleet
     from mmlspark_tpu.resilience import faults
@@ -81,7 +87,12 @@ def chaos_main(fault_rate: float = 0.1, clients: int = 8,
     import urllib.request
 
     telemetry.enable()
-    faults.configure(f"fleet.poll:error:{fault_rate}", seed=0)
+    if trace:
+        # spawned worker processes read the env at import — this is how
+        # their ingress spans (and the traceparent envelope) turn on
+        os.environ["MMLSPARK_TPU_TELEMETRY"] = "1"
+    if fault_rate > 0:
+        faults.configure(f"fleet.poll:error:{fault_rate}", seed=0)
     rng = np.random.default_rng(0)
     payload = base64.b64encode(
         rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
@@ -165,6 +176,19 @@ def chaos_main(fault_rate: float = 0.1, clients: int = 8,
             "worker_restarts": total(
                 "mmlspark_supervisor_worker_restarts_total"),
         }
+        if trace:
+            # one Chrome-trace file per process -> one merged per-request
+            # tree: every hop of a request shares its trace_id, spans
+            # nest via parent_span_id (load in Perfetto)
+            tdir = tempfile.mkdtemp(prefix="fleet_trace_")
+            paths = source.collect_traces(tdir)
+            out = "serving_trace.jsonl"
+            merged = telemetry.merge_traces(paths, out)
+            traced = {(e.get("args") or {}).get("trace_id")
+                      for e in merged} - {None}
+            result.update(trace_file=out, trace_events=len(merged),
+                          trace_processes=len(paths),
+                          requests_traced=len(traced))
         print(json.dumps(result))
         return result
     finally:
@@ -242,8 +266,20 @@ if __name__ == "__main__":
                          "one mid-run worker kill; reports p50/p99 and "
                          "recovery time")
     ap.add_argument("--fault-rate", type=float, default=0.1)
+    ap.add_argument("--clients", type=int, default=8,
+                    help="concurrent chaos/trace clients")
+    ap.add_argument("--per-client", type=int, default=30,
+                    help="requests per chaos/trace client")
+    ap.add_argument("--trace", action="store_true",
+                    help="distributed-tracing mode: runs the fleet "
+                         "scenario with per-process span capture and "
+                         "merges every hop into serving_trace.jsonl "
+                         "(one trace_id per request; combine with "
+                         "--chaos for the fault-injected run)")
     args = ap.parse_args()
-    if args.chaos:
-        chaos_main(fault_rate=args.fault_rate)
+    if args.chaos or args.trace:
+        chaos_main(fault_rate=args.fault_rate if args.chaos else 0.0,
+                   clients=args.clients, per_client=args.per_client,
+                   trace=args.trace)
     else:
         main()

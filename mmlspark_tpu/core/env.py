@@ -23,8 +23,23 @@ def telemetry_enabled() -> bool:
 def telemetry_trace_path() -> Optional[str]:
     """MMLSPARK_TPU_TRACE=/path/file.jsonl: export the span buffer as
     Chrome-trace JSON-lines at interpreter exit (telemetry must also be
-    enabled for spans to record)."""
+    enabled for spans to record). A literal ``{pid}`` in the path is
+    replaced with the process id — spawned fleet workers inherit the env,
+    and per-process files are what ``telemetry.merge_traces`` joins."""
     return os.environ.get("MMLSPARK_TPU_TRACE") or None
+
+
+def flight_path() -> Optional[str]:
+    """MMLSPARK_TPU_FLIGHT: arm the crash flight recorder
+    (telemetry.flight) at import. ``=1`` (or any truthy switch) dumps
+    bundles to the working directory; ``=/path/dir`` dumps there.
+    Returns None (disarmed), "" (armed, default dir) or the directory."""
+    v = os.environ.get("MMLSPARK_TPU_FLIGHT", "").strip()
+    if not v or v.lower() in ("0", "false", "no", "off"):
+        return None
+    if v.lower() in ("1", "true", "yes", "on"):
+        return ""
+    return v
 
 
 def fault_spec() -> Optional[str]:

@@ -119,6 +119,12 @@ class DistributedHTTPSource:
         return DataFrame({"id": object_column(ids),
                           "value": object_column(values)})
 
+    def trace_for(self, ex_id: str):
+        """Ingress traceparent of a worker-qualified exchange (the same
+        envelope surface HTTPSource exposes)."""
+        wi, raw = ex_id.split(":", 1)
+        return self.workers[int(wi)].trace_for(raw)
+
     def respond(self, ex_id: str, code: int, body) -> None:
         wi, raw = ex_id.split(":", 1)
         self.workers[int(wi)].respond(raw, code, body)

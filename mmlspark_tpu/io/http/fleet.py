@@ -75,6 +75,7 @@ class _Worker:
         self.alive = True
         self.proc = None
         self.pending_ack: list[str] = []   # ids appended, not yet acked
+        self.last_trace: dict = {}   # id -> traceparent from the last poll
         if spawn:
             # stderr -> DEVNULL: a PIPE nobody drains would block the
             # worker once 64KB of warnings accumulate
@@ -126,8 +127,12 @@ class _Worker:
         faults.inject("fleet.poll")
         ack, self.pending_ack = self.pending_ack, []
         try:
-            return self._call("/poll", {"max": max_rows, "timeout": timeout,
-                                        "ack": ack})["rows"]
+            r = self._call("/poll", {"max": max_rows, "timeout": timeout,
+                                     "ack": ack})
+            # per-row ingress traceparents ride a side map (rows keep
+            # their [id, value] shape); stashed for getOffset to pick up
+            self.last_trace = r.get("trace", {})
+            return r["rows"]
         except Exception:
             self.pending_ack = ack + self.pending_ack   # re-ack next time
             raise
@@ -190,6 +195,10 @@ class ProcessHTTPSource:
         self.poll_timeout = poll_timeout
         self._log: list[tuple[int, str, str]] = []  # (offset, id, value)
         self._log_ids: set[str] = set()   # uncommitted ids (re-delivery dedupe)
+        # qid -> (ingress traceparent, driver-arrival perf_counter_ns):
+        # the distributed-trace envelope across the control channel;
+        # consumed when the reply is buffered (respond) or the row drops
+        self._traces: dict[str, tuple[str, int]] = {}
         self._offset = 0          # highest offset assigned
         self._committed = 0       # offsets <= this are gone
         self._reply_buf: dict[int, list] = {}
@@ -249,10 +258,14 @@ class ProcessHTTPSource:
                     log.warning("worker %d poll failed (still healthy, "
                                 "retrying next round): %s", wi, e)
                 continue
+            now_ns = time.perf_counter_ns()
             with self._lock:
                 for ex_id, value in rows:
                     qid = f"{wi}:{ex_id}"
                     w.pending_ack.append(ex_id)
+                    tp = w.last_trace.get(str(ex_id))
+                    if tp and qid not in self._traces:
+                        self._traces[qid] = (tp, now_ns)
                     if qid in self._log_ids:
                         continue    # re-delivery of an unacked row
                     self._offset += 1
@@ -356,6 +369,7 @@ class ProcessHTTPSource:
             else:
                 for qid, _v in rows:
                     self._log_ids.discard(qid)
+                    self._traces.pop(qid, None)
                 _m_rows_dropped.inc(len(rows) + len(replies))
             n_log = len(self._log)
         self.breaker.reset(str(wi))
@@ -371,9 +385,15 @@ class ProcessHTTPSource:
     def respond(self, ex_id: str, code: int, body) -> None:
         wi, raw = str(ex_id).split(":", 1)
         with self._lock:
+            tr = self._traces.pop(str(ex_id), None)
             self._reply_buf.setdefault(int(wi), []).append(
                 [raw, int(code), body if isinstance(body, str)
                  else body.decode("utf-8")])
+        if tr is not None:
+            # the driver hop of the per-request tree: poll arrival ->
+            # reply buffered, a child of the worker's ingress span
+            telemetry.trace.complete("fleet/request", tr[1], parent=tr[0],
+                                     code=int(code), worker=wi)
 
     def flush(self) -> None:
         with self._lock:
@@ -411,6 +431,38 @@ class ProcessHTTPSource:
                     log.warning("worker %d reply delivery failed (worker "
                                 "healthy; %d replies re-buffered for the "
                                 "next flush): %s", wi, len(replies), e)
+
+    def collect_traces(self, out_dir: str) -> list[str]:
+        """Write one Chrome-trace file per fleet process — this driver's
+        span buffer plus every live worker's, fetched over the control
+        channel (``GET /trace``; workers die by SIGKILL, so collection
+        can't wait for a clean exit) — and return the paths. Feed them to
+        :func:`mmlspark_tpu.telemetry.merge_traces` for the single
+        per-request tree."""
+        import os
+        os.makedirs(out_dir, exist_ok=True)
+        paths = []
+        driver = os.path.join(out_dir, f"trace_driver_{os.getpid()}.jsonl")
+        telemetry.trace.export_chrome_trace(driver)
+        paths.append(driver)
+        for wi, w in enumerate(self.workers):
+            if not w.alive:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://{w.host}:{w.control}/trace",
+                        timeout=5.0) as r:
+                    doc = json.loads(r.read())
+            except Exception as e:
+                log.warning("worker %d trace collection failed: %s", wi, e)
+                continue
+            path = os.path.join(
+                out_dir, f"trace_worker{wi}_{doc.get('pid', wi)}.jsonl")
+            with open(path, "w") as f:
+                for ev in doc.get("events", ()):
+                    f.write(json.dumps(ev) + "\n")
+            paths.append(path)
+        return paths
 
     def killWorker(self, i: int) -> None:
         """Hard-kill one worker process (failure-injection hook; the

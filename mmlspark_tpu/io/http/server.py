@@ -85,7 +85,8 @@ def bind_with_probing(host: str, port: int, handler,
 class _Exchange:
     """One in-flight request awaiting a reply (the HttpExchange analog)."""
 
-    __slots__ = ("id", "value", "event", "code", "body", "picked")
+    __slots__ = ("id", "value", "event", "code", "body", "picked",
+                 "trace", "t0_ns")
 
     def __init__(self, value: str):
         self.id = uuid.uuid4().hex
@@ -94,6 +95,8 @@ class _Exchange:
         self.code = 500
         self.body = b""
         self.picked = False    # drained by getBatch (queue-depth bookkeeping)
+        self.trace = None      # ingress-span traceparent (telemetry on only)
+        self.t0_ns = time.perf_counter_ns()
 
 
 class HTTPSource:
@@ -127,12 +130,22 @@ class HTTPSource:
                 if api_path not in ("/", self.path):
                     self.send_error(404)
                     return
+                # distributed trace ingress: honor an incoming W3C
+                # traceparent, mint a fresh trace otherwise (telemetry
+                # off: ctx stays None and every context hop is a no-op)
+                ctx = None
+                if telemetry.enabled():
+                    ctx = (telemetry.context.from_headers(self.headers)
+                           or telemetry.context.new_trace())
                 if source.max_queue_depth:
                     with source._lock:
                         shed = source._n_pending >= source.max_queue_depth
                     if shed:
                         _m_shed.inc()
                         _m_replies.labels(code="503").inc()
+                        with telemetry.context.use(ctx):
+                            telemetry.trace.instant(
+                                "http/shed", depth=source.max_queue_depth)
                         payload = b'{"error": "overloaded, retry later"}'
                         self.send_response(503)
                         self.send_header("Retry-After", "1")
@@ -146,27 +159,35 @@ class HTTPSource:
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode("utf-8")
                 ex = _Exchange(body)
-                with source._lock:
-                    source._inflight[ex.id] = ex
-                    source._n_pending += 1
-                    _m_queue_depth.set(source._n_pending)
-                source._pending.put(ex)
-                if not ex.event.wait(timeout=source.reply_timeout):
-                    self.send_error(504, "batch processing timed out")
+                # the ingress span covers enqueue -> reply written; its
+                # context rides the exchange envelope so every downstream
+                # hop (batch pickup, fleet driver, outbound clients)
+                # parents under it across threads AND processes
+                with telemetry.context.use(ctx), \
+                        telemetry.trace.span("http/request",
+                                             bytes=length) as _sp:
+                    ex.trace = telemetry.context.current_traceparent()
                     with source._lock:
-                        source._inflight.pop(ex.id, None)
-                        if not ex.picked:   # abandoned while still queued
-                            source._n_pending -= 1
+                        source._inflight[ex.id] = ex
+                        source._n_pending += 1
                         _m_queue_depth.set(source._n_pending)
-                    _m_replies.labels(code="504").inc()
-                    return
-                self.send_response(ex.code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(ex.body)))
-                self.end_headers()
-                self.wfile.write(ex.body)
-                _m_req_latency.observe(time.perf_counter() - t0)
-                _m_replies.labels(code=str(ex.code)).inc()
+                    source._pending.put(ex)
+                    if not ex.event.wait(timeout=source.reply_timeout):
+                        self.send_error(504, "batch processing timed out")
+                        with source._lock:
+                            source._inflight.pop(ex.id, None)
+                            if not ex.picked:  # abandoned while queued
+                                source._n_pending -= 1
+                            _m_queue_depth.set(source._n_pending)
+                        _m_replies.labels(code="504").inc()
+                        return
+                    self.send_response(ex.code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(ex.body)))
+                    self.end_headers()
+                    self.wfile.write(ex.body)
+                    _m_req_latency.observe(time.perf_counter() - t0)
+                    _m_replies.labels(code=str(ex.code)).inc()
 
             def do_GET(self):
                 # Prometheus scrape surface: every serving process (the
@@ -175,8 +196,23 @@ class HTTPSource:
                 if self.path == "/metrics":
                     payload = telemetry.prometheus_text().encode("utf-8")
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    # the full 0.0.4 exposition content type — Prometheus
+                    # content negotiation wants the charset too
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif self.path == "/debug/flight":
+                    # the flight-recorder bundle on demand: recent span
+                    # events, metric deltas, and the armed fault plan —
+                    # "it hung once" becomes an artifact
+                    payload = json.dumps(
+                        telemetry.flight.bundle("debug-endpoint")) \
+                        .encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
@@ -250,12 +286,26 @@ class HTTPSource:
         return DataFrame({"id": object_column([r.id for r in rows]),
                           "value": object_column([r.value for r in rows])})
 
+    def trace_for(self, ex_id: str):
+        """The ingress-span traceparent of a live exchange (None when the
+        exchange is gone or telemetry was off at arrival) — how the trace
+        context crosses the control channel to the fleet driver."""
+        with self._lock:
+            ex = self._inflight.get(ex_id)
+        return ex.trace if ex is not None else None
+
     def respond(self, ex_id: str, code: int, body: bytes | str):
         with self._lock:
             ex = self._inflight.pop(ex_id, None)
         if ex is None:
             log.warning("respond: unknown or timed-out exchange %s", ex_id)
             return
+        if ex.trace is not None:
+            # per-request processing hop: arrival -> reply computed, a
+            # child of the ingress span (begin/end are on different
+            # threads, so this is an explicit-duration event)
+            telemetry.trace.complete("serve/request", ex.t0_ns,
+                                     parent=ex.trace, code=int(code))
         ex.code = code
         ex.body = body.encode("utf-8") if isinstance(body, str) else body
         ex.event.set()

@@ -11,10 +11,11 @@ import numpy as np
 import requests
 
 from ...core.dataframe import DataFrame
-from ...core.params import (ComplexParam, HasInputCol, HasOutputCol, IntParam,
-                            FloatParam, StringParam)
+from ...core.params import (BooleanParam, ComplexParam, HasInputCol,
+                            HasOutputCol, IntParam, FloatParam, StringParam)
 from ...core.pipeline import Transformer
 from ...core.utils import object_column
+from ... import telemetry
 from ...resilience import faults
 from ...resilience.policy import RetryPolicy
 
@@ -100,6 +101,10 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
     timeout = FloatParam("per-request timeout seconds", default=30.0)
     retries = IntParam("transient-failure retries per request (exponential "
                        "backoff, full jitter)", default=0, min=0)
+    trace = BooleanParam(
+        "propagate the current W3C traceparent on outgoing requests and "
+        "record an http/client child span per row (no-op unless a "
+        "distributed trace context is active)", default=True)
 
     def transform(self, df: DataFrame) -> DataFrame:
         reqs = df.col(self.getInputCol())
@@ -107,12 +112,21 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
                               max_attempts=self.getRetries() + 1,
                               base_delay=0.1, max_delay=2.0)
                   if self.getRetries() else None)
+        # the caller's trace context, captured HERE because the pool
+        # threads below have their own (empty) thread-local context
+        parent_ctx = (telemetry.context.current()
+                      if self.getTrace() else None)
 
         def attempt(r: dict) -> dict:
             faults.inject("http.request")
+            headers = r.get("headers")
+            tp = telemetry.context.current_traceparent()
+            if tp is not None:
+                headers = dict(headers or {})
+                headers.setdefault(telemetry.context.TRACEPARENT, tp)
             resp = requests.request(
                 r.get("method", "POST"), r["url"],
-                data=r.get("body"), headers=r.get("headers"),
+                data=r.get("body"), headers=headers,
                 timeout=self.getTimeout())
             if policy is not None and (resp.status_code >= 500
                                        or resp.status_code == 429):
@@ -125,9 +139,18 @@ class HTTPTransformer(Transformer, HasInputCol, HasOutputCol):
 
         def run(r: dict) -> dict:
             try:
-                if policy is None:
-                    return attempt(r)
-                return policy.run(lambda _a: attempt(r))
+                if parent_ctx is None:
+                    if policy is None:
+                        return attempt(r)
+                    return policy.run(lambda _a: attempt(r))
+                # each row is an http/client hop under the caller's trace;
+                # the span's own context reaches the wire as traceparent
+                with telemetry.context.use(parent_ctx), \
+                        telemetry.trace.span("http/client",
+                                             url=r.get("url", "")):
+                    if policy is None:
+                        return attempt(r)
+                    return policy.run(lambda _a: attempt(r))
             except Exception as e:  # malformed request dicts (e.g. no
                 # 'url') must fail their row, not the whole batch — same
                 # per-row contract as a network error

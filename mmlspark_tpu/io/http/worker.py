@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import sys
 import threading
 from http.server import BaseHTTPRequestHandler
@@ -44,6 +45,7 @@ class WorkerServer:
         self._unacked: dict[str, str] = {}   # id -> value, insertion order
         self._lock = threading.Lock()
         worker = self
+        worker_pid = os.getpid()
 
         class Control(BaseHTTPRequestHandler):
             def _json(self, code: int, obj):
@@ -74,11 +76,25 @@ class WorkerServer:
                     from ... import telemetry
                     body = telemetry.prometheus_text().encode("utf-8")
                     self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "text/plain; version=0.0.4")
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/trace":
+                    # the worker's span buffer as a JSON event array — how
+                    # the driver collects per-process traces for
+                    # telemetry.merge_traces without relying on a clean
+                    # worker exit (workers die by SIGKILL)
+                    from ... import telemetry
+                    self._json(200, {"events": telemetry.trace.events(),
+                                     "dropped": telemetry.trace.dropped(),
+                                     "pid": worker_pid})
+                elif self.path == "/debug/flight":
+                    from ... import telemetry
+                    self._json(200,
+                               telemetry.flight.bundle("debug-endpoint"))
                 else:
                     self.send_error(404)
 
@@ -107,7 +123,18 @@ class WorkerServer:
                     with worker._lock:
                         rows = [[i, v] for i, v in itertools.islice(
                             worker._unacked.items(), cap)]
-                    self._json(200, {"rows": rows})
+                    # trace envelope: the ingress traceparent of each row
+                    # still in flight rides a side map (the rows stay
+                    # [id, value] pairs — the handoff shape is stable)
+                    trace = {}
+                    for i, _v in rows:
+                        tp = worker.source.trace_for(str(i))
+                        if tp:
+                            trace[str(i)] = tp
+                    resp = {"rows": rows}
+                    if trace:
+                        resp["trace"] = trace
+                    self._json(200, resp)
                 elif self.path == "/respond":
                     for ex_id, code, body in req.get("replies", ()):
                         worker.source.respond(str(ex_id), int(code),

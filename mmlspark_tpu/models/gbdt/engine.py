@@ -1153,6 +1153,9 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 _sp.set_sync(raw)
         _m_iters.inc()
         _m_iter_time.observe(time.perf_counter() - t_iter)
+        # per-iteration HBM high-water sample (profiler on only): the
+        # boosting loop's live-buffer growth is where deep/wide fits OOM
+        telemetry.profiler.sample_live_buffers()
 
         if p.early_stopping_round > 0:
             t_eval = time.perf_counter()
@@ -1217,6 +1220,7 @@ def _predict_chunked(bins: np.ndarray, score_chunk, table_nodes: int
     n = bins.shape[0]
     chunk = _predict_chunk_rows(n, table_nodes)
     _m_predict_table_bytes.set(table_nodes * min(max(n, 1), chunk))
+    telemetry.profiler.sample_live_buffers()
     if n <= chunk:
         return score_chunk(bins)
     outs = []

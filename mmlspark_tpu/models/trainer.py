@@ -441,6 +441,13 @@ class TpuLearner(Estimator):
         "device-resident). 2 = double buffering; 0 = synchronous. The "
         "prefetched loss trajectory is bit-identical to the synchronous "
         "one — only the overlap changes", default=2, min=0)
+    profile = BooleanParam(
+        "device-profile this fit: per-dispatch XLA cost analysis (FLOPs, "
+        "bytes), compile accounting with recompile-cause attribution, "
+        "achieved-FLOPs/roofline gauges, and live-buffer HBM sampling "
+        "(telemetry.profiler). Enables telemetry and adds a sync point "
+        "per dispatch — measurement mode, not the production default",
+        default=False)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     # Two granularities: ``ckpt_EEEEE.msgpack`` marks epoch E COMPLETE;
@@ -703,15 +710,20 @@ class TpuLearner(Estimator):
         train_step = None
         scan_fn = None
         data_cap = self.getDeviceDataCap() or _device_data_cap()
+        if self.getProfile():
+            telemetry.profiler.enable()
         if nproc == 1 and x.nbytes + y.nbytes <= data_cap:
-            scan_fn = _make_scan_epoch_fn(
+            scan_fn = telemetry.profiler.wrap(_make_scan_epoch_fn(
                 module, tx, loss_fn, is_moe, moe_aux, mesh,
-                _scan_batch(bs_global, mesh, pp), step_body=pp_body)
+                _scan_batch(bs_global, mesh, pp), step_body=pp_body),
+                "trainer.scan_epoch")
         else:
             # multi-host (per-process shards feed put_global_batch) or a
             # dataset too big for HBM residency: per-step host feed
-            train_step = _make_train_step(module, tx, loss_fn, is_moe,
-                                          moe_aux, step_body=pp_body)
+            train_step = telemetry.profiler.wrap(
+                _make_train_step(module, tx, loss_fn, is_moe,
+                                 moe_aux, step_body=pp_body),
+                "trainer.step")
         # per-process batch orders only matter when processes feed distinct
         # dp shards; in local-fit mode (fleet tuner trials/refits) every
         # process must draw the IDENTICAL order or the replicated-model
@@ -813,9 +825,11 @@ class TpuLearner(Estimator):
         loss_fn = make_loss(self.getLoss(), per_example=True)
         is_moe = (cfg.get("type") == "transformer"
                   and cfg.get("num_experts", 0) > 0)
-        train_step = _make_train_step(
+        if self.getProfile():
+            telemetry.profiler.enable()
+        train_step = telemetry.profiler.wrap(_make_train_step(
             module, tx, loss_fn, is_moe,
-            self.getMoeAuxWeight() if is_moe else 0.0)
+            self.getMoeAuxWeight() if is_moe else 0.0), "trainer.step")
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
         params, opt_state, start_epoch, start_step = \

@@ -171,6 +171,12 @@ def inject(site: str):
                 f.injected += 1
         if hit:
             _m_injected.labels(site=site, kind=f.kind).inc()
+            # fault markers land in the span trace (tagged with the
+            # owning request's context when one is active) AND the
+            # flight-recorder ring — a chaos-run artifact shows exactly
+            # which injections preceded the failure
+            telemetry.trace.instant("fault/injected", site=site,
+                                    kind=f.kind)
             if f.kind == "delay":
                 time.sleep(f.delay)
             else:

@@ -140,8 +140,18 @@ class RetryPolicy:
                 if attempt + 1 >= self.max_attempts or (
                         remaining is not None and remaining <= delay):
                     _m_exhausted.labels(policy=self.name).inc()
+                    # instants auto-tag the current distributed trace
+                    # context, so a request's trace shows WHICH retries
+                    # it owned (telemetry off: one flag check)
+                    telemetry.trace.instant("retry/exhausted",
+                                            policy=self.name,
+                                            attempts=attempt + 1,
+                                            error=type(e).__name__)
                     raise
                 _m_retries.labels(policy=self.name).inc()
+                telemetry.trace.instant("retry", policy=self.name,
+                                        attempt=attempt,
+                                        error=type(e).__name__)
                 if on_retry is not None:
                     on_retry(attempt, e)
                 if delay > 0:
@@ -237,6 +247,9 @@ class CircuitBreaker:
             t = self._get(target)
             if ok:
                 if t.state != "closed":
+                    telemetry.trace.instant("breaker/close",
+                                            breaker=self.name,
+                                            target=target)
                     log.info("breaker %s/%s: probe ok, closing circuit",
                              self.name, target)
                 t.failures = 0
@@ -252,6 +265,9 @@ class CircuitBreaker:
                 if t.state != "open":
                     _m_breaker_opens.labels(breaker=self.name,
                                             target=target).inc()
+                    telemetry.trace.instant("breaker/open",
+                                            breaker=self.name,
+                                            target=target)
                     log.warning("breaker %s/%s: opening circuit for %.2fs",
                                 self.name, target, self.reset_timeout)
                 self._set_state(target, t, "open")

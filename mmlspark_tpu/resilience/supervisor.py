@@ -151,6 +151,7 @@ class FleetSupervisor:
             # restore it and redispatch its parked rows/replies
             self.source.restoreWorker(wi, resurrected=True)
             _m_resurrections.labels(worker=str(wi)).inc()
+            telemetry.flight.note("supervisor/resurrect", worker=wi)
             log.warning("worker %d resurrected (death verdict was "
                         "spurious); parked rows redispatched", wi)
             self._recovery.pop(wi, None)
@@ -173,6 +174,8 @@ class FleetSupervisor:
             return
         self.source.restoreWorker(wi, worker=nw, resurrected=False)
         _m_restarts.labels(worker=str(wi)).inc()
+        telemetry.flight.note("supervisor/restart", worker=wi,
+                              attempt=rec.restarts, port=nw.port)
         log.warning("worker %d restarted (attempt %d) on port %d",
                     wi, rec.restarts, nw.port)
         self._recovery.pop(wi, None)
@@ -187,6 +190,8 @@ class FleetSupervisor:
                 if self._process_exited(w) or (
                         not self._healthy(w) and w.probably_dead()):
                     _m_probe_failures.labels(worker=str(wi)).inc()
+                    telemetry.flight.note("supervisor/death_verdict",
+                                          worker=wi)
                     self.source.markWorkerDead(wi, reason="supervisor probe")
             else:
                 self._recover(wi, w, now)

@@ -29,14 +29,19 @@ from __future__ import annotations
 
 from .registry import (DEFAULT_TIME_BUCKETS, REGISTRY, Counter, Gauge,
                        Histogram, MetricsRegistry, pow2_buckets, _state)
-from .tracer import TRACER, Tracer
+from .tracer import TRACER, Tracer, merge_traces
+from . import context
+from . import profiler
+from .flight import FLIGHT
 
 #: process-global singletons — the module-level API
 registry = REGISTRY
 trace = TRACER
+flight = FLIGHT
 
 __all__ = ["registry", "trace", "enabled", "enable", "disable",
-           "snapshot", "prometheus_text", "warn_once",
+           "snapshot", "prometheus_text", "warn_once", "merge_traces",
+           "context", "profiler", "flight",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "DEFAULT_TIME_BUCKETS", "pow2_buckets"]
 
@@ -79,13 +84,21 @@ def warn_once(logger, key: str, msg: str, *args):
 
 
 def _init_from_env():
-    from ..core.env import telemetry_enabled, telemetry_trace_path
+    from ..core.env import (flight_path, telemetry_enabled,
+                            telemetry_trace_path)
     if telemetry_enabled():
         enable()
     path = telemetry_trace_path()
     if path:
         import atexit
+        import os
+        # "{pid}" templating: fleet worker processes inherit the same
+        # env, so each needs its own export file to merge_traces later
+        path = path.replace("{pid}", str(os.getpid()))
         atexit.register(lambda: trace.export_chrome_trace(path))
+    fpath = flight_path()
+    if fpath is not None:
+        flight.enable(fpath or None)
 
 
 _init_from_env()

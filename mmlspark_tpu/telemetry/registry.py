@@ -65,10 +65,19 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-exposition label-value escaping: backslash, double
+    quote and newline must be escaped or a value like ``path="a\nb"``
+    corrupts every following line of the scrape."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in zip(names, values))
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in zip(names, values))
     return "{" + inner + "}"
 
 
@@ -211,6 +220,9 @@ class Histogram(_Metric):
     def observe(self, value: float):
         if not _state.enabled:
             return
+        # bisect_LEFT: a value equal to a bucket bound lands in the bucket
+        # whose ``le`` it equals (Prometheus <= semantics); bisect_right
+        # would push it one bucket up
         i = bisect.bisect_left(self._bounds, value)
         with self._lock:
             self._counts[i] += 1

@@ -6,6 +6,13 @@ attributes as ``args``. Nesting needs no explicit parent links: the Chrome
 trace viewer (chrome://tracing, Perfetto) nests same-thread events by time
 containment, which the with-statement guarantees.
 
+Distributed requests additionally carry a :mod:`.context` trace identity:
+when a :class:`~mmlspark_tpu.telemetry.context.SpanContext` is current,
+every span/instant records ``trace_id`` / ``span_id`` / ``parent_span_id``
+in its args and pushes a child context for its body — so spans across
+threads AND processes join into one per-request tree once their files are
+merged (:func:`merge_traces`).
+
 Accelerator caveat: JAX dispatch is async, so a span around a dispatch call
 measures enqueue time, not device time. ``span(..., sync=value)`` calls
 ``jax.block_until_ready(value)`` at span exit — an OPT-IN sync point that
@@ -18,7 +25,9 @@ directly; for legacy chrome://tracing pass ``array=True`` to wrap the same
 events in the JSON-array trace format.
 
 The buffer is a bounded deque (oldest spans drop first) so a long-running
-serving fleet can leave tracing on without growing memory.
+serving fleet can leave tracing on without growing memory. Overflow is NOT
+silent: dropped events bump ``mmlspark_telemetry_events_dropped_total``
+and the export carries a ``truncated: true`` metadata event.
 """
 
 from __future__ import annotations
@@ -29,7 +38,17 @@ import os
 import threading
 import time
 
-from .registry import _state
+from . import context as tracectx
+from .registry import REGISTRY, _state
+
+_m_dropped = REGISTRY.counter(
+    "mmlspark_telemetry_events_dropped",
+    "span/instant events dropped from the bounded trace ring (raise "
+    "Tracer max_events or export more often)")
+
+#: set by telemetry.flight when the flight recorder is armed; every
+#: recorded event is forwarded (one None-check when disarmed)
+_flight_hook = None
 
 
 class _NoopSpan:
@@ -51,15 +70,25 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("_tracer", "name", "_sync", "_args", "_t0")
+    __slots__ = ("_tracer", "name", "_sync", "_args", "_t0", "_ctx",
+                 "_parent_id")
 
     def __init__(self, tracer: "Tracer", name: str, sync, args: dict):
         self._tracer = tracer
         self.name = name
         self._sync = sync
         self._args = args
+        self._ctx = None
+        self._parent_id = None
 
     def __enter__(self):
+        parent = tracectx.current()
+        if parent is not None:
+            # active distributed trace: this span becomes a child hop and
+            # its body sees ITS context (grandchildren parent correctly)
+            self._ctx = parent.child()
+            self._parent_id = parent.span_id
+            tracectx._push(self._ctx)
         self._t0 = time.perf_counter_ns()
         return self
 
@@ -73,15 +102,23 @@ class _Span:
             import jax
             jax.block_until_ready(self._sync)
         end = time.perf_counter_ns()
+        if self._ctx is not None:
+            tracectx._pop()
         ev = {"name": self.name, "ph": "X", "ts": self._t0 // 1000,
               "dur": max(0, end - self._t0) // 1000,
               "pid": os.getpid(), "tid": threading.get_ident()}
-        if self._args:
+        args = self._args
+        if self._ctx is not None:
+            args = dict(args)
+            args["trace_id"] = self._ctx.trace_id
+            args["span_id"] = self._ctx.span_id
+            args["parent_span_id"] = self._parent_id
+        if args:
             # attrs must be JSON-able; stringify anything exotic rather
             # than fail a hot path at export time
             ev["args"] = {k: (v if isinstance(v, (int, float, str, bool,
                                                   type(None))) else str(v))
-                          for k, v in self._args.items()}
+                          for k, v in args.items()}
         self._tracer._record(ev)
         return False
 
@@ -91,6 +128,7 @@ class Tracer:
         self._events: collections.deque = collections.deque(
             maxlen=max_events)
         self._lock = threading.Lock()
+        self._dropped = 0
 
     def span(self, name: str, sync=None, **attrs):
         """Context manager timing its body as one Chrome-trace event.
@@ -101,26 +139,74 @@ class Tracer:
         return _Span(self, name, sync, attrs)
 
     def instant(self, name: str, **attrs):
-        """Zero-duration marker event."""
+        """Zero-duration marker event. Tags the current distributed trace
+        context (retry/breaker/fault instants attach to the request that
+        owned them)."""
         if not _state.enabled:
             return
         ev = {"name": name, "ph": "i", "ts": time.perf_counter_ns() // 1000,
               "s": "t", "pid": os.getpid(), "tid": threading.get_ident()}
-        if attrs:
-            ev["args"] = {k: str(v) for k, v in attrs.items()}
+        args = {k: str(v) for k, v in attrs.items()}
+        ctx = tracectx.current()
+        if ctx is not None:
+            args["trace_id"] = ctx.trace_id
+            args["parent_span_id"] = ctx.span_id
+        if args:
+            ev["args"] = args
         self._record(ev)
+
+    def complete(self, name: str, start_ns: int, parent=None, **attrs):
+        """Record a ph "X" event that began at ``start_ns``
+        (``time.perf_counter_ns()``) and ends now — for spans whose begin
+        and end happen on DIFFERENT threads (a request enqueued by the
+        HTTP handler, replied by the batching loop). ``parent`` is the
+        owning hop (a SpanContext or raw traceparent string); the event
+        gets a fresh span_id under it, and the new context is returned so
+        callers can chain further hops."""
+        if not _state.enabled:
+            return None
+        if isinstance(parent, str):
+            parent = tracectx.parse_traceparent(parent)
+        end = time.perf_counter_ns()
+        ev = {"name": name, "ph": "X", "ts": start_ns // 1000,
+              "dur": max(0, end - start_ns) // 1000,
+              "pid": os.getpid(), "tid": threading.get_ident()}
+        args = {k: (v if isinstance(v, (int, float, str, bool, type(None)))
+                    else str(v)) for k, v in attrs.items()}
+        ctx = None
+        if parent is not None:
+            ctx = parent.child()
+            args["trace_id"] = ctx.trace_id
+            args["span_id"] = ctx.span_id
+            args["parent_span_id"] = parent.span_id
+        if args:
+            ev["args"] = args
+        self._record(ev)
+        return ctx
 
     def _record(self, ev: dict):
         with self._lock:
+            if (self._events.maxlen is not None
+                    and len(self._events) == self._events.maxlen):
+                self._dropped += 1
+                _m_dropped.inc()
             self._events.append(ev)
+        if _flight_hook is not None:
+            _flight_hook(ev)
 
     def events(self) -> list[dict]:
         with self._lock:
             return list(self._events)
 
+    def dropped(self) -> int:
+        """Events lost to the bounded ring since the last clear()."""
+        with self._lock:
+            return self._dropped
+
     def clear(self):
         with self._lock:
             self._events.clear()
+            self._dropped = 0
 
     def export_chrome_trace(self, path: str, array: bool = False,
                             clear: bool = False) -> int:
@@ -128,8 +214,17 @@ class Tracer:
 
         Default is JSON-lines (one event per line — Perfetto's JSON reader
         accepts it and tests round-trip it line-wise); ``array=True``
-        writes the chrome://tracing JSON-array form."""
-        evs = self.events()
+        writes the chrome://tracing JSON-array form. A ring that dropped
+        events leads with a metadata event carrying ``truncated: true``
+        and the drop count, so a partial trace is never mistaken for the
+        whole story."""
+        with self._lock:
+            evs = list(self._events)
+            dropped = self._dropped
+        if dropped:
+            evs.insert(0, {"name": "trace_metadata", "ph": "M",
+                           "pid": os.getpid(),
+                           "args": {"truncated": True, "dropped": dropped}})
         with open(path, "w") as f:
             if array:
                 f.write("[\n")
@@ -141,6 +236,47 @@ class Tracer:
         if clear:
             self.clear()
         return len(evs)
+
+
+def _load_events(path: str) -> list[dict]:
+    """Read a Chrome-trace file in either export form (JSONL or array)."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if not stripped:
+        return []
+    if stripped.startswith("["):
+        return json.loads(stripped)
+    return [json.loads(line) for line in text.splitlines() if line.strip()]
+
+
+def merge_traces(paths, out_path=None, trace_id=None) -> list[dict]:
+    """Join per-process Chrome-trace files into one event list.
+
+    Each serving process (driver, every fleet worker) exports its own
+    file; merging concatenates their events — pids keep the processes on
+    separate Perfetto rows — and sorts by timestamp. ``trace_id`` filters
+    to one request's tree (events whose ``args.trace_id`` matches;
+    metadata events are kept). ``out_path`` additionally writes the
+    merged JSON-lines file. Returns the merged events.
+
+    NOTE: ``ts`` is per-process ``perf_counter`` time, so cross-process
+    ordering is approximate (same-host processes share the clock source;
+    the per-request tree is correct regardless, via the span ids).
+    """
+    merged: list[dict] = []
+    for p in paths:
+        merged.extend(_load_events(p))
+    if trace_id is not None:
+        merged = [e for e in merged
+                  if e.get("ph") == "M"
+                  or (e.get("args") or {}).get("trace_id") == trace_id]
+    merged.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    if out_path:
+        with open(out_path, "w") as f:
+            for e in merged:
+                f.write(json.dumps(e) + "\n")
+    return merged
 
 
 #: the process-global tracer (the `trace.span(...)` every subsystem uses)
