@@ -1,0 +1,31 @@
+"""graftlint: framework-aware static analysis for mmlspark_tpu.
+
+Three rule families encode the invariants the test suite cannot see
+(they only bite at TPU scale or under production concurrency):
+
+* **jit-safety** — host syncs / Python control flow on traced values,
+  set-order iteration and jit-in-loop recompile hazards, missing
+  ``donate_argnums`` on documented-donated buffers, unseeded RNGs in
+  library code;
+* **concurrency** — a lock-order graph over every ``with <lock>:`` scope
+  (cycles, same-lock reacquire), blocking calls made while holding a
+  lock, and ``# guarded-by:`` field annotations checked at every
+  mutation site;
+* **consistency** — metric/span names vs the ``docs/observability.md``
+  catalogues, ``faults.inject`` sites vs the ``SITES`` registry, and
+  committed codegen artifacts (stubs / R wrappers / API docs) vs
+  regeneration.
+
+Run it as ``python -m mmlspark_tpu.analysis`` (console script:
+``graftlint``); CI runs it via ``tests/test_analysis.py`` and fails on
+any finding not grandfathered in ``tools/graftlint_baseline.json``.
+Suppress a single site with ``# graftlint: disable=<rule>``. See
+``docs/static-analysis.md``.
+"""
+
+from .core import (Baseline, Finding, Project, SourceFile, all_rules,
+                   load_project, run_analysis)
+from .cli import main
+
+__all__ = ["Baseline", "Finding", "Project", "SourceFile", "all_rules",
+           "load_project", "run_analysis", "main"]

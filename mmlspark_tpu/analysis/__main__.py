@@ -1,0 +1,7 @@
+"""``python -m mmlspark_tpu.analysis`` — the graftlint CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

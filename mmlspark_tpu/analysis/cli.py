@@ -1,0 +1,101 @@
+"""The ``graftlint`` command line (also ``python -m mmlspark_tpu.analysis``).
+
+Exit codes: 0 — clean (every finding baselined or none), 1 — new
+findings, 2 — usage error. ``--format json`` emits a machine-readable
+document (what CI annotations and the flight recorder embed);
+``--write-baseline`` grandfathers the current findings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import Baseline, all_rules, run_analysis
+
+
+def _default_paths_and_baseline() -> tuple[list[str], str]:
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(pkg)
+    baseline = os.path.join(root, "tools", "graftlint_baseline.json")
+    return [pkg], baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="framework-aware static analysis for mmlspark_tpu "
+                    "(jit-safety, concurrency, API consistency)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "installed mmlspark_tpu package)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON of grandfathered findings "
+                         "(default: tools/graftlint_baseline.json next "
+                         "to the package)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report and fail on "
+                         "everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings to the baseline "
+                         "file and exit 0")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule or family names to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--no-codegen", action="store_true",
+                    help="skip the import-based codegen-sync check")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths and docs lookup")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in sorted(all_rules(), key=lambda r: (r.family, r.name)):
+            print(f"{r.name:28s} [{r.family}] {r.doc}")
+        return 0
+
+    paths, default_baseline = _default_paths_and_baseline()
+    if args.paths:
+        paths = args.paths
+        root = args.root
+    else:
+        root = args.root or os.path.dirname(paths[0])
+    baseline = args.baseline or default_baseline
+    if args.no_baseline:
+        baseline = None
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+    options = {"codegen": not args.no_codegen and not args.paths}
+
+    findings = run_analysis(paths, root=root, baseline=baseline,
+                            rules=rules, options=options)
+    new = [f for f in findings if not f.baselined]
+
+    if args.write_baseline:
+        path = args.baseline or default_baseline
+        Baseline.write(path, findings)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in findings],
+            "total": len(findings),
+            "new": len(new),
+            "baselined": len(findings) - len(new),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        print(f"graftlint: {len(new)} finding(s)"
+              + (f" ({n_base} baselined)" if n_base else "")
+              + (" — FAIL" if new else " — ok"))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

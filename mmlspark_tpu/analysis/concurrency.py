@@ -1,0 +1,552 @@
+"""Concurrency rules: lock ordering, blocking under lock, guarded fields.
+
+The framework's threaded subsystems (serving loops, the fleet driver,
+prefetch producers, the supervisor, telemetry) share state behind
+``threading.Lock`` attributes. Three rule groups keep that discipline
+checkable instead of folkloric:
+
+* ``lock-blocking-call`` — a blocking operation (``time.sleep``, HTTP
+  round-trips, ``queue.get``, thread/process joins, socket IO, logging —
+  handlers do file/stream IO) executed while holding a lock: every other
+  thread needing that lock stalls for the duration, and a blocking call
+  that itself needs the lock deadlocks.
+* ``lock-order-cycle`` / ``lock-reacquire`` — a lock-order graph built
+  from lexically nested ``with <lock>:`` scopes plus one-hop
+  ``self.method()`` calls. A cycle (A held while taking B somewhere, B
+  held while taking A elsewhere) is a potential deadlock; re-acquiring
+  the SAME non-reentrant lock is a guaranteed one.
+* ``guarded-by`` — fields declared with a trailing
+  ``# guarded-by: <lock>`` comment must only be mutated inside a
+  ``with self.<lock>:`` block (or in the declaring method / ``__init__``,
+  or in a method annotated ``# requires-lock: <lock>`` — a helper whose
+  contract is "caller holds the lock"). A guard of the form
+  ``!<method>`` declares thread confinement instead: the field must
+  never be touched from the named (worker-thread) method.
+
+Lock identity is ``Class.attr`` for ``self``/``cls`` attributes,
+``<module>.name`` for module globals, and ``*.attr`` for locks reached
+through other objects — close enough for a single-package analysis, and
+the annotations close the gap where inference can't.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*(!?[\w.]+)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([\w.]+)")
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_RLOCK_CTORS = {"threading.RLock", "RLock"}
+
+#: container methods that mutate the receiver in place
+_MUTATORS = {"append", "extend", "insert", "pop", "popitem", "remove",
+             "clear", "update", "setdefault", "add", "discard",
+             "appendleft", "popleft", "sort", "reverse"}
+
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log"}
+_LOGGERISH = {"log", "logger", "logging"}
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "HTTP round-trip",
+    "urlopen": "HTTP round-trip",
+    "requests.get": "HTTP round-trip", "requests.post": "HTTP round-trip",
+    "requests.put": "HTTP round-trip", "requests.delete": "HTTP round-trip",
+    "requests.head": "HTTP round-trip",
+    "requests.request": "HTTP round-trip",
+    "subprocess.run": "subprocess", "subprocess.Popen": "subprocess spawn",
+    "subprocess.call": "subprocess",
+    "subprocess.check_output": "subprocess",
+    "subprocess.check_call": "subprocess",
+    "socket.create_connection": "socket connect",
+    "select.select": "select",
+}
+
+_THREADISH = re.compile(r"(^|_)(thread|proc|process|worker|reader|writer)s?$")
+_EVENTISH = re.compile(r"(^|_)(event|stop|done|ready|started)(_event)?$")
+_QUEUEISH = re.compile(r"(^|_)(q|queue|pending|inbox|outbox)$")
+_SOCKISH = re.compile(r"(^|_)(sock|socket|conn|connection)s?$")
+
+
+def _terminal(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+class _ClassInfo:
+    def __init__(self, name: str):
+        self.name = name
+        self.locks: dict[str, bool] = {}        # attr -> is_reentrant
+        self.guards: dict[str, str] = {}        # field -> guard spec
+        self.guard_decl_method: dict[str, str] = {}   # field -> method name
+        self.methods: dict[str, ast.AST] = {}
+        self.method_requires: dict[str, set] = {}     # method -> lock attrs
+        self.method_acquires: dict[str, set] = {}     # method -> lock keys
+
+
+def _lock_key(expr: ast.AST, cls: Optional[_ClassInfo],
+              module: str) -> Optional[str]:
+    """Identity of the lock object in a ``with <expr>:`` item, or None if
+    the expression isn't lock-shaped."""
+    name = dotted(expr)
+    if name is None:
+        return None
+    term = _terminal(name)
+    lockish = ("lock" in term or "mutex" in term or term == "guard"
+               or term.endswith("_cv") or term == "cond")
+    root = name.split(".", 1)[0]
+    if root in ("self", "cls") and "." in name:
+        attr = name.split(".", 1)[1]
+        if cls is not None and attr in cls.locks:
+            return f"{cls.name}.{attr}"
+        if lockish:
+            return f"{cls.name if cls else '?'}.{attr}"
+        return None
+    if "." not in name:
+        if lockish:
+            return f"{module}.{name}"
+        return None
+    # foreign object (w.lock, meshlib.collective_fit_lock, ...)
+    if lockish:
+        return f"*.{_terminal(name)}"
+    return None
+
+
+def _collect_classes(sf: SourceFile) -> dict[str, _ClassInfo]:
+    module = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+    out: dict[str, _ClassInfo] = {}
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        ci = _ClassInfo(node.name)
+        out[node.name] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ci.methods[item.name] = item
+                # requires-lock annotation on (or right above) the def line
+                for ln in (item.lineno, item.lineno - 1):
+                    c = sf.comments.get(ln, "")
+                    m = _REQUIRES_RE.search(c)
+                    if m:
+                        ci.method_requires.setdefault(item.name,
+                                                      set()).add(m.group(1))
+        # lock + guarded-by declarations anywhere in the class body
+        for sub in ast.walk(node):
+            targets: list = []
+            value = None
+            if isinstance(sub, ast.Assign):
+                targets, value = sub.targets, sub.value
+            elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                targets, value = [sub.target], sub.value
+            else:
+                continue
+            vname = dotted(value) if isinstance(value, ast.Call) \
+                else None
+            ctor = dotted(value.func) if isinstance(value, ast.Call) \
+                else None
+            for t in targets:
+                tn = dotted(t)
+                if tn is None:
+                    continue
+                if tn.startswith(("self.", "cls.")):
+                    attr = tn.split(".", 1)[1]
+                elif "." not in tn:
+                    attr = tn            # class-level attribute
+                else:
+                    continue
+                if ctor in _LOCK_CTORS:
+                    ci.locks[attr] = ctor in _RLOCK_CTORS
+                m = _GUARDED_RE.search(sf.comments.get(sub.lineno, ""))
+                if m:
+                    ci.guards[attr] = m.group(1)
+                    meth = _enclosing_method(node, sub)
+                    ci.guard_decl_method[attr] = meth or "__init__"
+            del vname
+    return out
+
+
+def _enclosing_method(cls_node: ast.ClassDef, stmt) -> Optional[str]:
+    for item in cls_node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(item):
+                if sub is stmt:
+                    return item.name
+    return None
+
+
+def _module_locks(sf: SourceFile) -> set:
+    out = set()
+    for node in sf.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if isinstance(value, ast.Call) and dotted(value.func) in _LOCK_CTORS:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class _FuncWalk:
+    """Walk one function body tracking lexically held locks; collects
+    blocking-call findings, lock-order edges, and guarded-field events."""
+
+    def __init__(self, sf: SourceFile, cls: Optional[_ClassInfo],
+                 qual: str, module: str):
+        self.sf = sf
+        self.cls = cls
+        self.qual = qual
+        self.module = module
+        self.findings: list[Optional[Finding]] = []
+        self.edges: list[tuple[str, str, int]] = []   # (held, taken, line)
+        self.acquired: set[str] = set()
+        #: (field, node, held_locks, is_mutation) events for guarded-by
+        self.field_events: list[tuple[str, ast.AST, tuple]] = []
+        self.self_calls_under: list[tuple[str, tuple, ast.AST]] = []
+
+    # -------------------------------------------------------------- blocking
+    def _blocking_reason(self, call: ast.Call) -> Optional[str]:
+        dn = dotted(call.func)
+        if dn in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[dn]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        recv = _terminal(dotted(call.func.value))
+        if attr in _LOG_METHODS and recv in _LOGGERISH:
+            return "logging (handler IO)"
+        if attr == "join" and _THREADISH.search(recv or ""):
+            return f"{recv}.join"
+        if attr == "wait" and (_THREADISH.search(recv or "")
+                               or _EVENTISH.search(recv or "")):
+            return f"{recv}.wait"
+        if attr in ("get", "put") and _QUEUEISH.search(recv or ""):
+            return f"blocking queue.{attr}"
+        if attr in ("recv", "send", "sendall", "connect", "accept",
+                    "makefile") and _SOCKISH.search(recv or ""):
+            return f"socket {attr}"
+        if attr in ("urlopen",):
+            return "HTTP round-trip"
+        return None
+
+    # ------------------------------------------------------------------ walk
+    def walk(self, stmts, held: tuple):
+        for st in stmts:
+            self.stmt(st, held)
+
+    def stmt(self, st, held: tuple):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return   # nested scopes walked separately
+        if isinstance(st, ast.With):
+            new_held = held
+            for item in st.items:
+                key = _lock_key(item.context_expr, self.cls, self.module)
+                if key is not None:
+                    for h in new_held:
+                        self.edges.append((h, key, st.lineno))
+                    if key in new_held:
+                        reentrant = False
+                        if self.cls and key.startswith(self.cls.name + "."):
+                            attr = key.split(".", 1)[1]
+                            reentrant = self.cls.locks.get(attr, False)
+                        if not reentrant:
+                            self.findings.append(self.sf.finding(
+                                "lock-reacquire", st,
+                                f"`with {dotted(item.context_expr)}:` "
+                                f"re-acquires a lock already held in "
+                                f"`{self.qual}` — non-reentrant "
+                                f"threading.Lock deadlocks here",
+                                hint="restructure so the lock is taken "
+                                     "once, or use an RLock deliberately",
+                                context=self.qual))
+                    self.acquired.add(key)
+                    new_held = new_held + (key,)
+                else:
+                    self.expr(item.context_expr, held)
+            self.walk(st.body, new_held)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self.expr(st.test, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse or [], held)
+            return
+        if isinstance(st, ast.For):
+            self.expr(st.iter, held)
+            self.walk(st.body, held)
+            self.walk(st.orelse or [], held)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, held)
+            for h in st.handlers:
+                self.walk(h.body, held)
+            self.walk(st.orelse or [], held)
+            self.walk(st.finalbody or [], held)
+            return
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                self._field_mutation(t, st, held)
+            if getattr(st, "value", None) is not None:
+                self.expr(st.value, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._field_mutation(t, st, held)
+            return
+        if isinstance(st, ast.Return) and st.value is not None:
+            self.expr(st.value, held)
+            return
+        if isinstance(st, ast.Expr):
+            self.expr(st.value, held)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.expr(child, held)
+
+    def _field_mutation(self, target, st, held: tuple):
+        """Assign/del to self.<field> or self.<field>[...]"""
+        node = target
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._field_mutation(e, st, held)
+            return
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        name = dotted(node)
+        if name and name.startswith("self.") and name.count(".") == 1:
+            self.field_events.append((name.split(".", 1)[1], st, held))
+
+    def expr(self, node, held: tuple):
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if held:
+                reason = self._blocking_reason(sub)
+                if reason is not None:
+                    self.findings.append(self.sf.finding(
+                        "lock-blocking-call", sub,
+                        f"{reason} while holding {', '.join(held)} in "
+                        f"`{self.qual}` — every thread contending that "
+                        f"lock stalls for the call's duration",
+                        hint="move the blocking call outside the lock "
+                             "(collect state under the lock, act after)",
+                        context=self.qual))
+            # mutating-method calls on guarded fields
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _MUTATORS:
+                recv = dotted(sub.func.value)
+                base = recv
+                if base and base.startswith("self.") \
+                        and base.count(".") == 1:
+                    self.field_events.append(
+                        (base.split(".", 1)[1], sub, held))
+            # one-hop self-method call (for lock-order + reacquire)
+            dn = dotted(sub.func)
+            if held and dn and dn.startswith("self.") \
+                    and dn.count(".") == 1:
+                self.self_calls_under.append(
+                    (dn.split(".", 1)[1], held, sub))
+
+
+def _analyze_file(sf: SourceFile):
+    module = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+    classes = _collect_classes(sf)
+    mod_locks = _module_locks(sf)
+    del mod_locks  # identity comes from _lock_key's name heuristics
+    walks: list[tuple[Optional[_ClassInfo], str, ast.AST, _FuncWalk]] = []
+
+    def visit(node, cls: Optional[_ClassInfo], stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, classes.get(child.name), stack + [child])
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = qualname_of(stack + [child])
+                w = _FuncWalk(sf, cls, qual, module)
+                held: tuple = ()
+                req = (cls.method_requires.get(child.name, set())
+                       if cls else set())
+                if req and cls:
+                    held = tuple(f"{cls.name}.{r}" for r in req)
+                w.walk(child.body, held)
+                walks.append((cls, child.name, child, w))
+                visit(child, cls, stack + [child])
+            else:
+                visit(child, cls, stack)
+
+    visit(sf.tree, None, [])
+    return classes, walks
+
+
+@rule("lock-blocking-call", "concurrency",
+      "blocking IO / sleeps / joins executed while holding a lock")
+def check_blocking(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        _cls, walks = _analyze_file(sf)
+        for _c, _m, _node, w in walks:
+            for f in w.findings:
+                if f is not None and f.rule == "lock-blocking-call":
+                    yield f
+
+
+@rule("lock-reacquire", "concurrency",
+      "same non-reentrant lock acquired twice on one path")
+def check_reacquire(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        classes, walks = _analyze_file(sf)
+        for cls, _m, _node, w in walks:
+            for f in w.findings:
+                if f is not None and f.rule == "lock-reacquire":
+                    yield f
+            # one-hop: self.method() under a held lock, where the method
+            # itself acquires that same (non-reentrant) lock
+            for meth, held, call in w.self_calls_under:
+                if cls is None:
+                    continue
+                target = None
+                for c2, m2, node2, w2 in walks:
+                    if c2 is cls and m2 == meth:
+                        target = w2
+                        break
+                if target is None:
+                    continue
+                for key in target.acquired:
+                    if key in held and key.startswith(cls.name + "."):
+                        attr = key.split(".", 1)[1]
+                        if not cls.locks.get(attr, False):
+                            f = sf.finding(
+                                "lock-reacquire", call,
+                                f"`self.{meth}()` called while holding "
+                                f"{key} in `{w.qual}`, and `{meth}` "
+                                f"acquires {key} itself — non-reentrant "
+                                f"deadlock",
+                                hint=f"add a `# requires-lock: {attr}` "
+                                     f"variant of {meth} that assumes the "
+                                     f"lock, or release before calling",
+                                context=w.qual)
+                            if f:
+                                yield f
+
+
+@rule("lock-order-cycle", "concurrency",
+      "lock-order graph cycles (potential ABBA deadlock)")
+def check_lock_order(project: Project) -> Iterable[Finding]:
+    # global edge set across the whole project: cycles usually span files
+    edges: dict[tuple[str, str], tuple[SourceFile, int, str]] = {}
+    for sf in project.files:
+        classes, walks = _analyze_file(sf)
+        for cls, _m, _node, w in walks:
+            for held, taken, line in w.edges:
+                if held != taken and (held, taken) not in edges:
+                    edges[(held, taken)] = (sf, line, w.qual)
+            # one-hop method edges
+            for meth, held, call in w.self_calls_under:
+                if cls is None:
+                    continue
+                for c2, m2, _n2, w2 in walks:
+                    if c2 is cls and m2 == meth:
+                        for key in w2.acquired:
+                            for h in held:
+                                if h != key and (h, key) not in edges:
+                                    edges[(h, key)] = (sf, call.lineno,
+                                                       w.qual)
+    graph: dict[str, set] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    # report every 2-node cycle and longer cycles via DFS back-edge search
+    reported = set()
+    for (a, b), (sf, line, qual) in sorted(
+            edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])):
+        path = _find_path(graph, b, a)
+        if path is None:
+            continue
+        cyc = tuple(sorted(set([a, b] + path)))
+        if cyc in reported:
+            continue
+        reported.add(cyc)
+        f = sf.finding(
+            "lock-order-cycle", _FakeNode(line),
+            f"lock-order cycle: {a} is held while taking {b} (here), and "
+            f"elsewhere the order {' -> '.join([b] + path)} closes the "
+            f"loop — two threads interleaving these paths deadlock",
+            hint="impose one global acquisition order (document it) or "
+                 "collapse to a single lock",
+            context=qual)
+        if f:
+            yield f
+
+
+class _FakeNode:
+    def __init__(self, lineno: int):
+        self.lineno = lineno
+
+
+def _find_path(graph: dict, src: str, dst: str):
+    """Path src -> dst (list of nodes after src), or None."""
+    seen = {src}
+    stack = [(src, [])]
+    while stack:
+        node, path = stack.pop()
+        for nxt in graph.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+@rule("guarded-by", "concurrency",
+      "mutations of `# guarded-by:` fields outside their lock")
+def check_guarded(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        classes, walks = _analyze_file(sf)
+        for cls, meth, node, w in walks:
+            if cls is None or not cls.guards:
+                continue
+            for field, st, held in w.field_events:
+                guard = cls.guards.get(field)
+                if guard is None:
+                    continue
+                if guard.startswith("!"):
+                    # thread confinement: never touched from this method
+                    if meth == guard[1:]:
+                        f = sf.finding(
+                            "guarded-by", st,
+                            f"`self.{field}` is declared thread-confined "
+                            f"(guarded-by: {guard}) but is touched inside "
+                            f"`{w.qual}` — the excluded thread's entry "
+                            f"point",
+                            hint="hand the value through the queue/event "
+                                 "instead of mutating the field from the "
+                                 "worker thread",
+                            context=w.qual)
+                        if f:
+                            yield f
+                    continue
+                if meth in ("__init__", cls.guard_decl_method.get(field)):
+                    continue
+                lock_key = f"{cls.name}.{guard}"
+                if lock_key not in held:
+                    f = sf.finding(
+                        "guarded-by", st,
+                        f"`self.{field}` (guarded-by: {guard}) mutated in "
+                        f"`{w.qual}` without holding self.{guard}",
+                        hint=f"wrap the mutation in `with self.{guard}:` "
+                             f"or annotate the method "
+                             f"`# requires-lock: {guard}`",
+                        context=w.qual)
+                    if f:
+                        yield f

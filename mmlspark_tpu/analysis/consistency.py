@@ -1,0 +1,378 @@
+"""API-consistency rules: code and its catalogues must not drift.
+
+* ``metric-catalogue`` — every metric registered through
+  ``telemetry.registry.counter/gauge/histogram`` must appear (by its
+  EXPOSITION name — counters gain ``_total``) in the metric catalogue
+  table of ``docs/observability.md``, and vice versa. A dashboard built
+  from the docs must never scrape a name that does not exist.
+* ``span-catalogue`` — every literal span/instant name recorded through
+  ``telemetry.trace.span/instant/complete`` (or handed to a prefetcher
+  via a ``span=`` keyword) must appear in the span catalogue table, and
+  vice versa.
+* ``fault-site`` — every ``faults.inject("<site>")`` call site must name
+  a site registered in ``resilience/faults.py``'s ``SITES`` tuple, and
+  every registered site must have at least one injection call — a chaos
+  spec naming a site nobody calls silently injects nothing.
+* ``codegen-sync`` — committed codegen artifacts (``stubs/``,
+  ``R/generated_wrappers.R``, ``docs/api/``) must match regeneration
+  from the live Param registry (Python signatures are the single source
+  of truth). Import-based; disable with ``options={"codegen": False}``
+  (fixture projects) or ``--no-codegen``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+
+_REG_METHODS = {"counter", "gauge", "histogram"}
+_REG_RECEIVERS = {"registry", "REGISTRY"}
+_TRACE_METHODS = {"span", "instant", "complete"}
+_TRACE_RECEIVERS = {"trace", "TRACER"}
+
+
+def _expo_name(name: str, kind: str) -> str:
+    if kind == "counter" and not name.endswith("_total"):
+        return name + "_total"
+    return name
+
+
+def _repo_root(project: Project) -> str:
+    d = project.root
+    for _ in range(5):
+        if os.path.isfile(os.path.join(d, "pyproject.toml")):
+            return d
+        d = os.path.dirname(d)
+    return project.root
+
+
+def _doc_path(project: Project) -> Optional[str]:
+    p = project.options.get("observability_doc")
+    if p:
+        return p
+    p = os.path.join(_repo_root(project), "docs", "observability.md")
+    return p if os.path.isfile(p) else None
+
+
+def _doc_table_names(doc_text: str, heading: str) -> set:
+    """Backticked names from the first cell of every row of the table
+    under ``heading``. Suffix tokens (`_foo`) expand against the
+    previous full name by replacing its trailing underscore segments."""
+    out: set[str] = set()
+    in_section = False
+    prev_full: Optional[str] = None
+    for line in doc_text.splitlines():
+        if line.startswith("## "):
+            in_section = line[3:].strip().lower().startswith(
+                heading.lower())
+            continue
+        if not in_section or not line.strip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells or set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        for tok in re.findall(r"`([^`]+)`", cells[0]):
+            tok = re.sub(r"\{[^}]*\}", "", tok).strip()
+            if not re.fullmatch(r"[A-Za-z_][\w/.\- ]*", tok):
+                continue
+            if tok.startswith("_") and prev_full:
+                sfx = tok.lstrip("_").split("_")
+                base = prev_full.split("_")
+                merged = base[:max(1, len(base) - len(sfx))] + sfx
+                out.add("_".join(merged))
+            else:
+                out.add(tok)
+                prev_full = tok
+    return out
+
+
+def _doc_span_names(doc_text: str) -> set:
+    return _doc_table_names(doc_text, "Span catalogue")
+
+
+# ------------------------------------------------------------- registrations
+
+def _registered_metrics(project: Project):
+    """Yield (SourceFile, node, exposition_name, kind)."""
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _REG_METHODS:
+                continue
+            recv = dotted(node.func.value)
+            if recv is None or recv.rsplit(".", 1)[-1] \
+                    not in _REG_RECEIVERS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue
+            yield (sf, node,
+                   _expo_name(node.args[0].value, node.func.attr),
+                   node.func.attr)
+
+
+def _recorded_spans(project: Project):
+    """Yield (SourceFile, node, span_name)."""
+    for sf in project.files:
+        if "/analysis/" in "/" + sf.rel:
+            continue     # the analyzer's own string tables aren't spans
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name: Optional[str] = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _TRACE_METHODS:
+                recv = dotted(node.func.value)
+                if recv is not None and recv.rsplit(".", 1)[-1] \
+                        in _TRACE_RECEIVERS:
+                    if node.args and isinstance(node.args[0], ast.Constant) \
+                            and isinstance(node.args[0].value, str):
+                        name = node.args[0].value
+            if name is None:
+                for kw in node.keywords:
+                    if kw.arg == "span" and isinstance(kw.value,
+                                                       ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        name = kw.value.value
+            if name is not None:
+                yield sf, node, name
+
+
+@rule("metric-catalogue", "consistency",
+      "registered metric names vs the docs/observability.md catalogue")
+def check_metric_catalogue(project: Project) -> Iterable[Finding]:
+    regs = list(_registered_metrics(project))
+    if not regs:
+        return
+    doc = _doc_path(project)
+    if doc is None:
+        sf = regs[0][0]
+        f = sf.finding(
+            "metric-catalogue", regs[0][1],
+            "metrics are registered but no docs/observability.md metric "
+            "catalogue exists to document them")
+        if f:
+            yield f
+        return
+    with open(doc, "r", encoding="utf-8") as fh:
+        doc_text = fh.read()
+    documented = {n for n in _doc_table_names(doc_text, "Metric catalogue")
+                  if "_" in n and "/" not in n and " " not in n}
+    seen: set[str] = set()
+    for sf, node, name, kind in regs:
+        seen.add(name)
+        if name not in documented:
+            f = sf.finding(
+                "metric-catalogue", node,
+                f"metric `{name}` ({kind}) is registered but missing from "
+                f"the docs/observability.md metric catalogue",
+                hint="add a catalogue row (exposition name, type, where, "
+                     "meaning)",
+                context=qualname_of([]))
+            if f:
+                yield f
+    rel_doc = os.path.relpath(doc, _repo_root(project)).replace(os.sep, "/")
+    for name in sorted(documented - seen):
+        yield Finding(
+            rule="metric-catalogue", path=rel_doc, line=1,
+            message=f"documented metric `{name}` is not registered "
+                    f"anywhere in the analyzed sources (stale catalogue "
+                    f"row or renamed metric)",
+            hint="fix or drop the catalogue row", context="<doc>",
+            code=name)
+
+
+@rule("span-catalogue", "consistency",
+      "recorded span/instant names vs the docs span catalogue")
+def check_span_catalogue(project: Project) -> Iterable[Finding]:
+    spans = list(_recorded_spans(project))
+    if not spans:
+        return
+    doc = _doc_path(project)
+    if doc is None:
+        return
+    with open(doc, "r", encoding="utf-8") as fh:
+        doc_text = fh.read()
+    documented = _doc_span_names(doc_text)
+    if not documented:
+        sf, node, name = spans[0]
+        f = sf.finding(
+            "span-catalogue", node,
+            "spans are recorded but docs/observability.md has no "
+            "`## Span catalogue` table",
+            hint="add the table; every literal span/instant name belongs "
+                 "in it")
+        if f:
+            yield f
+        return
+    seen: set[str] = set()
+    for sf, node, name in spans:
+        if name in seen:
+            continue
+        seen.add(name)
+        if name not in documented:
+            f = sf.finding(
+                "span-catalogue", node,
+                f"span/instant `{name}` is recorded but missing from the "
+                f"docs/observability.md span catalogue",
+                hint="add a span-catalogue row")
+            if f:
+                yield f
+    rel_doc = os.path.relpath(doc, _repo_root(project)).replace(os.sep, "/")
+    for name in sorted(d for d in documented if d not in seen):
+        yield Finding(
+            rule="span-catalogue", path=rel_doc, line=1,
+            message=f"documented span `{name}` is never recorded in the "
+                    f"analyzed sources",
+            hint="fix or drop the span-catalogue row", context="<doc>",
+            code=name)
+
+
+@rule("fault-site", "consistency",
+      "faults.inject sites vs the SITES registry in resilience/faults.py")
+def check_fault_sites(project: Project) -> Iterable[Finding]:
+    # registered sites: the SITES tuple in a module named faults.py
+    registered: set[str] = set()
+    faults_sf: Optional[SourceFile] = None
+    sites_node = None
+    for sf in project.files:
+        if not sf.rel.endswith("faults.py"):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "SITES"
+                    for t in node.targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str):
+                        registered.add(sub.value)
+                faults_sf, sites_node = sf, node
+    injected: dict[str, tuple[SourceFile, ast.AST]] = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted(node.func)
+            if dn is None or dn.rsplit(".", 1)[-1] != "inject":
+                continue
+            if not (dn == "inject" or dn.endswith("faults.inject")):
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                injected.setdefault(node.args[0].value, (sf, node))
+    if not injected and not registered:
+        return
+    if not registered and injected:
+        sf, node = next(iter(injected.values()))
+        f = sf.finding(
+            "fault-site", node,
+            "faults.inject sites exist but resilience/faults.py declares "
+            "no SITES registry tuple",
+            hint="declare SITES = (\"site\", ...) next to the docstring "
+                 "site list")
+        if f:
+            yield f
+        return
+    for site, (sf, node) in sorted(injected.items()):
+        if site not in registered:
+            f = sf.finding(
+                "fault-site", node,
+                f"fault site `{site}` is injected but not registered in "
+                f"resilience/faults.py SITES — chaos specs can't "
+                f"discover it and typos go unnoticed",
+                hint="add it to SITES (and the docstring site list)")
+            if f:
+                yield f
+    for site in sorted(registered - set(injected)):
+        if faults_sf is not None:
+            f = faults_sf.finding(
+                "fault-site", sites_node,
+                f"registered fault site `{site}` has no faults.inject "
+                f"call anywhere — a chaos spec naming it injects nothing",
+                hint="remove it from SITES or add the injection site")
+            if f:
+                yield f
+
+
+@rule("codegen-sync", "consistency",
+      "committed stubs/R/docs-api artifacts vs regeneration")
+def check_codegen(project: Project) -> Iterable[Finding]:
+    if not project.options.get("codegen", False):
+        return
+    root = _repo_root(project)
+    try:
+        import tempfile
+
+        import mmlspark_tpu  # noqa: F401  (populates the stage registry)
+        from mmlspark_tpu import codegen as cg
+    except Exception as e:  # pragma: no cover - import environment issues
+        yield Finding(
+            rule="codegen-sync", path="mmlspark_tpu/codegen/__init__.py",
+            line=1, context="<import>", code="import mmlspark_tpu.codegen",
+            message=f"codegen could not be imported for the sync check: "
+                    f"{e}")
+        return
+
+    def _read_tree(d: str) -> dict:
+        out = {}
+        for base, _dirs, names in os.walk(d):
+            for n in sorted(names):
+                p = os.path.join(base, n)
+                rel = os.path.relpath(p, d)
+                with open(p, "r", encoding="utf-8") as fh:
+                    out[rel] = fh.read()
+        return out
+
+    with tempfile.TemporaryDirectory() as tmp:
+        checks = []
+        try:
+            cg.generate_docs(os.path.join(tmp, "api"))
+            checks.append(("docs/api", os.path.join(tmp, "api"),
+                           os.path.join(root, "docs", "api")))
+            cg.generate_stubs(os.path.join(tmp, "stubs"))
+            checks.append(("stubs", os.path.join(tmp, "stubs"),
+                           os.path.join(root, "stubs")))
+            cg.generate_r_wrappers(os.path.join(tmp, "wrappers.R"))
+            checks.append(("R/generated_wrappers.R",
+                           os.path.join(tmp, "wrappers.R"),
+                           os.path.join(root, "R",
+                                        "generated_wrappers.R")))
+        except Exception as e:  # pragma: no cover
+            yield Finding(
+                rule="codegen-sync",
+                path="mmlspark_tpu/codegen/__init__.py", line=1,
+                context="<generate>", code="generate_all",
+                message=f"codegen regeneration failed: {e}")
+            return
+        for label, fresh_path, committed_path in checks:
+            if not os.path.exists(committed_path):
+                continue    # artifact never generated in this checkout
+            if os.path.isdir(fresh_path):
+                fresh = _read_tree(fresh_path)
+                committed = _read_tree(committed_path)
+            else:
+                with open(fresh_path, "r", encoding="utf-8") as fh:
+                    fresh = {"": fh.read()}
+                with open(committed_path, "r", encoding="utf-8") as fh:
+                    committed = {"": fh.read()}
+            if fresh != committed:
+                stale = sorted(
+                    set(fresh) ^ set(committed)
+                    | {k for k in set(fresh) & set(committed)
+                       if fresh[k] != committed[k]})
+                yield Finding(
+                    rule="codegen-sync", path=label, line=1,
+                    context="<artifact>", code=label,
+                    message=f"committed {label} out of sync with the "
+                            f"Param registry ({len(stale)} file(s) "
+                            f"differ: {', '.join(stale[:5])}"
+                            f"{'...' if len(stale) > 5 else ''})",
+                    hint="run `python -m mmlspark_tpu.codegen` and commit "
+                         "the result")

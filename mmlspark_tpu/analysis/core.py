@@ -1,0 +1,290 @@
+"""graftlint core: findings, source model, suppressions, baseline, rules.
+
+The analyzer is AST-first: every rule family receives a :class:`Project`
+holding the parsed module set (plus comments, because the concurrency
+pass reads ``# guarded-by:`` / ``# requires-lock:`` annotations and every
+rule honors ``# graftlint: disable=<rule>`` suppressions) and yields
+:class:`Finding` records — rule id, file:line, message, fix hint.
+
+Grandfathering: a finding whose :meth:`Finding.fingerprint` appears in
+the checked-in baseline (``tools/graftlint_baseline.json``) is reported
+as baselined and does NOT fail the run; anything new does. Fingerprints
+deliberately exclude line numbers (they key on rule + file + enclosing
+scope + the offending source line) so unrelated edits above a
+grandfathered finding don't resurrect it.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator, Optional
+
+#: suppression comment: ``# graftlint: disable=rule-a,rule-b`` on the
+#: flagged line silences those rules there; ``disable-file=`` in the
+#: module's first comment block silences them for the whole file.
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable(-file)?\s*=\s*"
+                          r"([\w*,\- ]+)")
+
+
+@dataclass
+class Finding:
+    """One analyzer result. ``context`` is the enclosing qualname
+    (``Class.method`` / function / ``<module>``) — part of the stable
+    fingerprint, so baselines survive reflows."""
+
+    rule: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    message: str
+    hint: str = ""
+    context: str = "<module>"
+    code: str = ""       # stripped source of the flagged line
+    baselined: bool = False
+
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.context}|{self.code}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "file": self.path, "line": self.line,
+                "context": self.context, "message": self.message,
+                "hint": self.hint, "code": self.code,
+                "baselined": self.baselined}
+
+    def render(self) -> str:
+        mark = " [baselined]" if self.baselined else ""
+        out = f"{self.path}:{self.line}: {self.rule}{mark}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+class SourceFile:
+    """One parsed module: AST + raw lines + comment map + suppressions."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.comments: dict[int, str] = {}   # line -> comment text
+        self._line_suppress: dict[int, set[str]] = {}
+        self._file_suppress: set[str] = set()
+        self._scan_comments()
+
+    def _scan_comments(self):
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                line = tok.start[0]
+                self.comments[line] = tok.string
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(2).split(",")
+                             if r.strip()}
+                    if m.group(1):          # disable-file
+                        self._file_suppress |= rules
+                    else:
+                        self._line_suppress.setdefault(line,
+                                                       set()).update(rules)
+        except tokenize.TokenError:
+            pass
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for pool in (self._file_suppress,
+                     self._line_suppress.get(line, ()),
+                     # the line ABOVE the statement also counts — long
+                     # statements often have no room on the line itself
+                     self._line_suppress.get(line - 1, ())):
+            if rule in pool or "*" in pool:
+                return True
+        return False
+
+    def finding(self, rule: str, node, message: str, hint: str = "",
+                context: str = "<module>") -> Optional[Finding]:
+        """Build a Finding for ``node`` unless suppressed there."""
+        line = getattr(node, "lineno", 1)
+        if self.suppressed(line, rule):
+            return None
+        return Finding(rule=rule, path=self.rel, line=line,
+                       message=message, hint=hint, context=context,
+                       code=self.line_text(line))
+
+
+class Project:
+    """The unit a rule family analyzes: parsed sources + repo context."""
+
+    def __init__(self, files: list[SourceFile], root: str,
+                 options: Optional[dict] = None):
+        self.files = files
+        self.root = root
+        self.options = options or {}
+
+    def by_rel(self, rel: str) -> Optional[SourceFile]:
+        for f in self.files:
+            if f.rel == rel or f.rel.endswith("/" + rel):
+                return f
+        return None
+
+
+def qualname_of(stack: list) -> str:
+    """Dotted name of an AST scope stack (ClassDef/FunctionDef nodes)."""
+    names = [getattr(n, "name", "?") for n in stack]
+    return ".".join(names) if names else "<module>"
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# --------------------------------------------------------------- rule registry
+
+@dataclass
+class Rule:
+    name: str
+    family: str
+    doc: str
+    run: Callable[[Project], Iterable[Finding]]
+
+
+_RULES: list[Rule] = []
+
+
+def rule(name: str, family: str, doc: str):
+    """Register a rule runner: ``fn(project) -> Iterable[Finding]``."""
+    def deco(fn):
+        _RULES.append(Rule(name, family, doc, fn))
+        return fn
+    return deco
+
+
+def all_rules() -> list[Rule]:
+    # importing the families registers their rules
+    from . import jit_safety, concurrency, consistency  # noqa: F401
+    return list(_RULES)
+
+
+# ------------------------------------------------------------------- discovery
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".graftlint"}
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for base, dirs, names in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for n in sorted(names):
+                if n.endswith(".py"):
+                    yield os.path.join(base, n)
+
+
+def load_project(paths: list[str], root: Optional[str] = None,
+                 options: Optional[dict] = None) -> Project:
+    root = os.path.abspath(root or os.path.commonpath(
+        [os.path.abspath(p) for p in paths]))
+    if os.path.isfile(root):
+        root = os.path.dirname(root)
+    files = []
+    for fp in iter_python_files(paths):
+        ap = os.path.abspath(fp)
+        rel = os.path.relpath(ap, root)
+        try:
+            with open(ap, "r", encoding="utf-8") as f:
+                text = f.read()
+            files.append(SourceFile(ap, rel, text))
+        except (OSError, SyntaxError, ValueError):
+            # unparsable files are someone else's problem (CI syntax
+            # checks); the analyzer must not crash on them
+            continue
+    return Project(files, root, options)
+
+
+# -------------------------------------------------------------------- baseline
+
+class Baseline:
+    """The checked-in grandfather list. Entries are readable dicts —
+    reviewers should see WHAT was grandfathered, not a hash."""
+
+    def __init__(self, entries: Optional[list[dict]] = None):
+        self.entries = entries or []
+        self._keys = {self._key(e) for e in self.entries}
+
+    @staticmethod
+    def _key(e: dict) -> str:
+        return (f"{e.get('rule')}|{e.get('file')}|{e.get('context')}"
+                f"|{e.get('code')}")
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.isfile(path):
+            return cls([])
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        return cls(doc.get("findings", []))
+
+    def matches(self, finding: Finding) -> bool:
+        return finding.fingerprint() in self._keys
+
+    @staticmethod
+    def write(path: str, findings: list[Finding]):
+        doc = {"version": 1,
+               "note": ("grandfathered graftlint findings; fix and remove "
+                        "entries rather than adding new ones"),
+               "findings": [
+                   {"rule": f.rule, "file": f.path, "context": f.context,
+                    "code": f.code, "todo": "grandfathered; fix and remove"}
+                   for f in sorted(findings,
+                                   key=lambda f: (f.rule, f.path, f.line))]}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2)
+            f.write("\n")
+
+
+# ------------------------------------------------------------------ entrypoint
+
+def run_analysis(paths: list[str], root: Optional[str] = None,
+                 baseline: Optional[str] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 options: Optional[dict] = None) -> list[Finding]:
+    """Run every (selected) rule over ``paths``; returns all findings with
+    ``baselined`` marked. Callers decide what a failure is (the CLI and
+    the tier-1 shim fail on any non-baselined finding)."""
+    project = load_project(paths, root=root, options=options)
+    selected = all_rules()
+    if rules is not None:
+        wanted = set(rules)
+        selected = [r for r in selected
+                    if r.name in wanted or r.family in wanted]
+    findings: list[Finding] = []
+    for r in selected:
+        findings.extend(f for f in r.run(project) if f is not None)
+    base = Baseline.load(baseline) if baseline else Baseline([])
+    for f in findings:
+        f.baselined = base.matches(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -1,0 +1,625 @@
+"""jit-safety rules: what must not happen inside a traced function.
+
+A function is *traced* when it is reachable as a ``jax.jit`` / ``pjit`` /
+``shard_map`` / ``lax.scan`` body or a ``telemetry.profiler`` target —
+discovered from decorators (including ``functools.partial(jax.jit, ...)``)
+and from wrapping call sites in the same module. Inside a traced body the
+arguments (minus ``static_argnums``/``static_argnames``) are abstract
+tracers, and a *taint* walk follows them through assignments so the rules
+fire on derived values too. Shape-level attributes (``.shape``, ``.ndim``,
+``.dtype``, ``len()``, ``is None`` checks) are static under tracing and
+break the taint — branching on them is legal and common.
+
+Rules:
+
+* ``jit-host-sync`` — ``float()`` / ``int()`` / ``bool()`` / ``.item()`` /
+  ``.tolist()`` / ``np.asarray()`` / ``np.array()`` on a traced value:
+  a hidden device→host sync (and under jit, a tracer error or a constant
+  baked at trace time).
+* ``jit-traced-branch`` — Python ``if`` / ``while`` / ``assert`` on a
+  traced value: either a tracer error or (via weak typing) a silent
+  host sync per call. Use ``jnp.where`` / ``lax.cond``.
+* ``jit-nondeterministic-iter`` — iterating a ``set`` / ``frozenset``
+  inside a traced body: iteration order varies across processes/runs, so
+  the traced program differs → spurious recompiles and cross-host
+  divergence (the dict/set-order recompile hazard; cross-check with the
+  profiler's recompile-cause counters, docs/observability.md).
+* ``jit-in-loop`` — constructing ``jax.jit(...)`` (call or decorated def)
+  inside a ``for``/``while`` body: a fresh jit cache per iteration, i.e.
+  a compile per iteration.
+* ``jit-missing-donate`` — a jitted update function taking both the
+  ``params`` and ``opt``/``opt_state`` buffers the trainer documents as
+  donated (models/trainer.py) without ``donate_argnums`` — doubles peak
+  HBM for the largest buffers in the program.
+* ``unseeded-random`` — module-level ``random.*`` / unseeded
+  ``np.random.*`` in library (non-test) code: unreproducible behavior and
+  shared global RNG state across threads. Use a seeded
+  ``random.Random(seed)`` / ``np.random.default_rng(seed)`` instance.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+
+#: attributes of a tracer that are Python-static at trace time
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "nbytes", "itemsize"}
+#: builtins whose call on a traced value is a host sync / tracer error
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+#: methods whose call on a traced value is a host sync
+_SYNC_METHODS = {"item", "tolist", "__float__", "__int__", "__bool__"}
+#: numpy entry points that materialize a traced value on host
+_NP_SYNC = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "onp.asarray", "onp.array", "np.copy", "numpy.copy"}
+#: calls producing untainted (static) results regardless of args
+_UNTAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+                  "range", "enumerate", "zip"}
+
+_JIT_WRAPPERS = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_BODY_WRAPPERS = _JIT_WRAPPERS | {
+    "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "lax.scan", "jax.checkpoint", "jax.remat",
+    "profiler.wrap", "telemetry.profiler.wrap", "ProfiledFunction",
+    "jax.vmap", "vmap", "jax.grad", "jax.value_and_grad"}
+
+_PARAMS_NAMES = {"params"}
+_OPT_NAMES = {"opt", "opt_state", "optstate", "optimizer_state"}
+
+_RANDOM_FUNCS = {"random", "randint", "uniform", "choice", "choices",
+                 "shuffle", "sample", "randrange", "gauss", "betavariate",
+                 "expovariate", "normalvariate", "triangular", "randbytes",
+                 "getrandbits"}
+
+
+def _is_test_path(rel: str) -> bool:
+    parts = rel.split("/")
+    return (any(p in ("tests", "testing", "fixtures") for p in parts)
+            or parts[-1].startswith("test_"))
+
+
+# ----------------------------------------------------------- traced discovery
+
+class _TracedDef:
+    __slots__ = ("node", "statics", "reason", "qual")
+
+    def __init__(self, node, statics: set, reason: str, qual: str):
+        self.node = node
+        self.statics = statics
+        self.reason = reason
+        self.qual = qual
+
+
+def _static_names(call: ast.Call, fn_node) -> set:
+    """Param names excluded from tracing by static_argnums/argnames."""
+    out: set[str] = set()
+    args = getattr(fn_node, "args", None)
+    pos = ([a.arg for a in args.posonlyargs + args.args]
+           if args is not None else [])
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out.add(v.value)
+        elif kw.arg == "static_argnums":
+            for v in ast.walk(kw.value):
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    if 0 <= v.value < len(pos):
+                        out.add(pos[v.value])
+    return out
+
+
+def _wrapper_name(call_fn: ast.AST) -> Optional[str]:
+    name = dotted(call_fn)
+    if name is None:
+        return None
+    # functools.partial(jax.jit, ...) resolves to the partial'd target
+    return name
+
+
+def _match_wrapper(name: Optional[str]) -> Optional[str]:
+    if name is None:
+        return None
+    if name in _BODY_WRAPPERS:
+        return name
+    # tolerate aliases like `jnp.jit` never; keep exact-ish matching on
+    # the terminal segments (jax.lax.scan vs lax.scan already listed)
+    return None
+
+
+def _collect_traced(sf: SourceFile) -> list[_TracedDef]:
+    """Every def/lambda in this module that is traced, with its statics."""
+    defs_by_name: dict[str, list] = {}
+    parents: dict[ast.AST, ast.AST] = {}
+    quals: dict[ast.AST, str] = {}
+
+    def walk(node, stack):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(child.name, []).append(child)
+                quals[child] = qualname_of(stack + [child])
+                walk(child, stack + [child])
+            elif isinstance(child, ast.ClassDef):
+                quals[child] = qualname_of(stack + [child])
+                walk(child, stack + [child])
+            else:
+                walk(child, stack)
+
+    walk(sf.tree, [])
+    traced: dict[ast.AST, _TracedDef] = {}
+
+    def mark(fn_node, statics: set, reason: str):
+        if fn_node in traced:
+            traced[fn_node].statics |= statics
+            return
+        traced[fn_node] = _TracedDef(
+            fn_node, statics, reason,
+            quals.get(fn_node, getattr(fn_node, "name", "<lambda>")))
+
+    # 1) decorators
+    for name, nodes in defs_by_name.items():
+        for fn in nodes:
+            for dec in fn.decorator_list:
+                if isinstance(dec, ast.Call):
+                    dn = dotted(dec.func)
+                    if dn in ("functools.partial", "partial"):
+                        if dec.args and _match_wrapper(dotted(dec.args[0])):
+                            mark(fn, _static_names(dec, fn),
+                                 dotted(dec.args[0]))
+                    elif _match_wrapper(dn):
+                        mark(fn, _static_names(dec, fn), dn)
+                else:
+                    dn = dotted(dec)
+                    if _match_wrapper(dn):
+                        mark(fn, set(), dn)
+    # 2) wrapping call sites: jax.jit(f), lax.scan(body, ...), shard_map(f)
+    for call in ast.walk(sf.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        wname = _match_wrapper(dotted(call.func))
+        if wname is None:
+            continue
+        target = call.args[0] if call.args else None
+        if target is None:
+            continue
+        if isinstance(target, ast.Lambda):
+            mark(target, set(), wname)
+            continue
+        # `jit(step_body or default, ...)`-style expressions: every name
+        # inside the wrapped-function expression counts as a body
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                for fn in defs_by_name.get(sub.id, ()):
+                    mark(fn, _static_names(call, fn), wname)
+            elif isinstance(sub, ast.Lambda):
+                mark(sub, set(), wname)
+    return list(traced.values())
+
+
+# --------------------------------------------------------------- taint walker
+
+class _Taint:
+    """Lexical taint over one traced body: names carrying traced values."""
+
+    def __init__(self, tainted: set):
+        self.names = set(tainted)
+
+    def expr(self, node) -> bool:
+        """Does ``node`` evaluate to a traced value?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` is a structural (trace-time)
+            # check, legal under jit
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return (self.expr(node.left)
+                    or any(self.expr(c) for c in node.comparators))
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.body) or self.expr(node.orelse)
+                    or self.expr(node.test))
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func)
+            if fname in _UNTAINT_CALLS:
+                return False
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _STATIC_ATTRS):
+                return False
+            # any call fed a traced value is assumed to return one
+            # (jnp ops, closures); host-sync calls are flagged separately
+            return (any(self.expr(a) for a in node.args)
+                    or any(self.expr(k.value) for k in node.keywords)
+                    or self.expr(node.func))
+        return False
+
+    def assign(self, target, value_tainted: bool):
+        for t in ast.walk(target) if not isinstance(target, ast.Name) \
+                else (target,):
+            if isinstance(t, ast.Name):
+                if value_tainted:
+                    self.names.add(t.id)
+                else:
+                    self.names.discard(t.id)
+
+
+def _traced_params(fn_node, statics: set) -> set:
+    if isinstance(fn_node, ast.Lambda):
+        args = fn_node.args
+    else:
+        args = fn_node.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if names and names[0] in ("self", "cls"):
+        names = names[1:]
+    return {n for n in names if n not in statics}
+
+
+def _check_traced_body(sf: SourceFile, td: _TracedDef) -> Iterable[Finding]:
+    taint = _Taint(_traced_params(td.node, td.statics))
+    body = (td.node.body if isinstance(td.node.body, list)
+            else [ast.Expr(td.node.body)])
+
+    def visit(stmts):
+        for st in stmts:
+            yield from visit_stmt(st)
+
+    def flag_sync_calls(expr_node):
+        """Find host-sync calls anywhere inside an expression."""
+        for node in ast.walk(expr_node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            arg0_tainted = bool(node.args) and taint.expr(node.args[0])
+            if (fname in _SYNC_BUILTINS and arg0_tainted):
+                f = sf.finding(
+                    "jit-host-sync", node,
+                    f"`{fname}()` on a traced value inside traced "
+                    f"function `{td.qual}` — device→host sync / tracer "
+                    f"error at trace time",
+                    hint="keep the value on device (jnp ops) or move the "
+                         "conversion outside the jitted function",
+                    context=td.qual)
+                if f:
+                    yield f
+            elif fname in _NP_SYNC and any(taint.expr(a)
+                                           for a in node.args):
+                f = sf.finding(
+                    "jit-host-sync", node,
+                    f"`{fname}()` materializes a traced value on host "
+                    f"inside traced function `{td.qual}`",
+                    hint="use jnp.asarray / keep the computation in jax",
+                    context=td.qual)
+                if f:
+                    yield f
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _SYNC_METHODS
+                  and taint.expr(node.func.value)):
+                f = sf.finding(
+                    "jit-host-sync", node,
+                    f"`.{node.func.attr}()` on a traced value inside "
+                    f"traced function `{td.qual}` — blocking host sync",
+                    hint="return the array and convert outside the jit "
+                         "boundary",
+                    context=td.qual)
+                if f:
+                    yield f
+
+    def flag_set_iter(for_node):
+        it = for_node.iter
+        is_set = (isinstance(it, ast.Set)
+                  or (isinstance(it, ast.Call)
+                      and dotted(it.func) in ("set", "frozenset")))
+        if is_set:
+            f = sf.finding(
+                "jit-nondeterministic-iter", for_node,
+                f"iteration over a set inside traced function "
+                f"`{td.qual}`: set order varies per process, so the "
+                f"traced program (and its compile cache key) varies too",
+                hint="iterate a sorted() list or a tuple — deterministic "
+                     "order keeps the compiled program stable",
+                context=td.qual)
+            if f:
+                yield f
+
+    def visit_stmt(st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return   # nested defs get their own discovery pass
+        if isinstance(st, ast.Assign):
+            yield from flag_sync_calls(st.value)
+            t = taint.expr(st.value)
+            for target in st.targets:
+                taint.assign(target, t)
+            return
+        if isinstance(st, ast.AugAssign):
+            yield from flag_sync_calls(st.value)
+            if taint.expr(st.value):
+                taint.assign(st.target, True)
+            return
+        if isinstance(st, ast.AnnAssign) and st.value is not None:
+            yield from flag_sync_calls(st.value)
+            taint.assign(st.target, taint.expr(st.value))
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            yield from flag_sync_calls(st.test)
+            if taint.expr(st.test):
+                kind = "if" if isinstance(st, ast.If) else "while"
+                f = sf.finding(
+                    "jit-traced-branch", st,
+                    f"Python `{kind}` on a traced value in traced "
+                    f"function `{td.qual}` — tracer error, or a silent "
+                    f"host sync on every call",
+                    hint="use jnp.where / lax.cond / lax.select, or mark "
+                         "the argument static",
+                    context=td.qual)
+                if f:
+                    yield f
+            yield from visit(st.body)
+            yield from visit(getattr(st, "orelse", []) or [])
+            return
+        if isinstance(st, ast.Assert):
+            if taint.expr(st.test):
+                f = sf.finding(
+                    "jit-traced-branch", st,
+                    f"`assert` on a traced value in traced function "
+                    f"`{td.qual}` — forces a host sync (or tracer error)",
+                    hint="use checkify / debug.check, or assert on "
+                         "static shape attributes only",
+                    context=td.qual)
+                if f:
+                    yield f
+            return
+        if isinstance(st, ast.For):
+            yield from flag_set_iter(st)
+            yield from flag_sync_calls(st.iter)
+            if taint.expr(st.iter):
+                f = sf.finding(
+                    "jit-traced-branch", st,
+                    f"Python `for` over a traced value in traced "
+                    f"function `{td.qual}` — unrolls at trace time only "
+                    f"if the length is static; otherwise a tracer error",
+                    hint="use lax.scan / lax.fori_loop",
+                    context=td.qual)
+                if f:
+                    yield f
+            taint.assign(st.target, taint.expr(st.iter))
+            yield from visit(st.body)
+            yield from visit(st.orelse or [])
+            return
+        if isinstance(st, ast.With):
+            for item in st.items:
+                yield from flag_sync_calls(item.context_expr)
+            yield from visit(st.body)
+            return
+        if isinstance(st, ast.Try):
+            yield from visit(st.body)
+            for h in st.handlers:
+                yield from visit(h.body)
+            yield from visit(st.orelse or [])
+            yield from visit(st.finalbody or [])
+            return
+        if isinstance(st, ast.Return) and st.value is not None:
+            yield from flag_sync_calls(st.value)
+            return
+        if isinstance(st, ast.Expr):
+            yield from flag_sync_calls(st.value)
+            return
+        # other statements: scan expressions for sync calls
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                yield from flag_sync_calls(child)
+
+    yield from visit(body)
+
+
+# ------------------------------------------------------------------ the rules
+
+def _traced_body_findings(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        for td in _collect_traced(sf):
+            yield from _check_traced_body(sf, td)
+
+
+def _traced_rule(name: str, doc: str):
+    @rule(name, "jit-safety", doc)
+    def _run(project: Project, _name=name) -> Iterable[Finding]:
+        return [f for f in _traced_body_findings(project)
+                if f.rule == _name]
+    return _run
+
+
+_traced_rule("jit-host-sync",
+             "host syncs (float()/.item()/np.asarray) on traced values")
+_traced_rule("jit-traced-branch",
+             "Python control flow on traced values")
+_traced_rule("jit-nondeterministic-iter",
+             "set-order iteration inside traced bodies")
+
+
+@rule("jit-in-loop", "jit-safety",
+      "jax.jit constructed inside a for/while body (compile per iteration)")
+def check_jit_in_loop(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+
+        def walk(node, loop_depth, stack):
+            for child in ast.iter_child_nodes(node):
+                in_loop = loop_depth + int(isinstance(
+                    child, (ast.For, ast.While)))
+                if isinstance(child, ast.Call) and loop_depth > 0:
+                    dn = dotted(child.func)
+                    if dn in _JIT_WRAPPERS:
+                        f = sf.finding(
+                            "jit-in-loop", child,
+                            f"`{dn}(...)` constructed inside a loop in "
+                            f"`{qualname_of(stack)}`: a fresh jit wrapper "
+                            f"(and XLA compile) per iteration",
+                            hint="hoist the jit() out of the loop so the "
+                                 "compiled executable is reused",
+                            context=qualname_of(stack))
+                        if f:
+                            yield f
+                new_stack = stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    new_stack = stack + [child]
+                yield from walk(child, in_loop, new_stack)
+
+        yield from walk(sf.tree, 0, [])
+
+
+@rule("jit-missing-donate", "jit-safety",
+      "jitted (params, opt_state) update without donate_argnums")
+def check_missing_donate(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        for td in _collect_traced(sf):
+            if td.reason not in _JIT_WRAPPERS:
+                continue
+            node = td.node
+            if isinstance(node, ast.Lambda):
+                continue
+            pnames = {a.arg for a in node.args.posonlyargs
+                      + node.args.args}
+            if not (pnames & _PARAMS_NAMES and pnames & _OPT_NAMES):
+                continue
+            # donation may ride the decorator or the wrapping call site
+            donated = False
+            for call in ast.walk(sf.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                dn = dotted(call.func)
+                if dn in ("functools.partial", "partial") and call.args \
+                        and dotted(call.args[0]) in _JIT_WRAPPERS:
+                    involves = call in node.decorator_list
+                elif dn in _JIT_WRAPPERS:
+                    involves = (call in node.decorator_list
+                                or (bool(call.args) and any(
+                                    isinstance(s, ast.Name)
+                                    and s.id == node.name
+                                    for s in ast.walk(call.args[0]))))
+                else:
+                    continue
+                if involves and any(kw.arg in ("donate_argnums",
+                                               "donate_argnames")
+                                    for kw in call.keywords):
+                    donated = True
+                    break
+            if donated:
+                continue
+            f = sf.finding(
+                "jit-missing-donate", node,
+                f"jitted update `{td.qual}` takes the documented-donated "
+                f"buffers ({', '.join(sorted(pnames & (_PARAMS_NAMES | _OPT_NAMES)))}) "
+                f"but declares no donate_argnums — peak HBM holds both "
+                f"the old and new copies",
+                hint="jit(..., donate_argnums=(...)) for the params/"
+                     "opt_state positions (see models/trainer.py)",
+                context=td.qual)
+            if f:
+                yield f
+
+
+@rule("unseeded-random", "jit-safety",
+      "module-level random / unseeded np.random in library code")
+def check_unseeded_random(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        # only fire when the stdlib module (not a same-named local) is
+        # what `random` refers to
+        imports_random = any(
+            isinstance(n, ast.Import)
+            and any(a.name == "random" and a.asname is None
+                    for a in n.names)
+            for n in ast.walk(sf.tree))
+        stack: list = []
+
+        def walk(node, stack):
+            for child in ast.iter_child_nodes(node):
+                cur = stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    cur = stack + [child]
+                ctx = qualname_of(stack)
+                if isinstance(child, ast.Call):
+                    dn = dotted(child.func)
+                    if (imports_random and dn is not None
+                            and dn.startswith("random.")
+                            and dn.split(".", 1)[1] in _RANDOM_FUNCS):
+                        f = sf.finding(
+                            "unseeded-random", child,
+                            f"module-level `{dn}()` in library code: "
+                            f"unreproducible and shares global RNG state "
+                            f"across threads",
+                            hint="use a seeded random.Random(seed) "
+                                 "instance (see resilience/faults.py)",
+                            context=ctx)
+                        if f:
+                            yield f
+                    if (dn in ("np.random.default_rng",
+                               "numpy.random.default_rng")
+                            and not child.args and not child.keywords):
+                        f = sf.finding(
+                            "unseeded-random", child,
+                            "unseeded np.random.default_rng() in library "
+                            "code: runs are unreproducible",
+                            hint="thread a seed parameter through "
+                                 "(default_rng(seed))",
+                            context=ctx)
+                        if f:
+                            yield f
+                    if (dn is not None
+                            and (dn.startswith("np.random.")
+                                 or dn.startswith("numpy.random."))
+                            and dn.rsplit(".", 1)[1] in _RANDOM_FUNCS):
+                        f = sf.finding(
+                            "unseeded-random", child,
+                            f"legacy global-state `{dn}()` in library "
+                            f"code",
+                            hint="use np.random.default_rng(seed)",
+                            context=ctx)
+                        if f:
+                            yield f
+                elif (imports_random and isinstance(child, ast.Name)
+                      and child.id == "random"
+                      and isinstance(child.ctx, ast.Load)
+                      and not isinstance(node, (ast.Attribute, ast.Import,
+                                                ast.ImportFrom))):
+                    # the module object used as a value (e.g. stored as an
+                    # RNG): same global-state hazard as calling through it
+                    f = sf.finding(
+                        "unseeded-random", child,
+                        "the global `random` module captured as an RNG "
+                        "value: unseeded, shared across threads",
+                        hint="construct random.Random(seed) instead "
+                             "(Random(None) still isolates state)",
+                        context=ctx)
+                    if f:
+                        yield f
+                yield from walk(child, cur)
+
+        yield from walk(sf.tree, stack)

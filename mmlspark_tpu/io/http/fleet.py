@@ -193,20 +193,24 @@ class ProcessHTTPSource:
                     w.kill()
                 raise
         self.poll_timeout = poll_timeout
-        self._log: list[tuple[int, str, str]] = []  # (offset, id, value)
-        self._log_ids: set[str] = set()   # uncommitted ids (re-delivery dedupe)
+        # the replayable offset log and everything hanging off it is
+        # shared between the serving loop, the supervisor thread, and
+        # HTTPSink callers — all mutations go through self._lock (the
+        # graftlint guarded-by pass enforces this)
+        self._log: list[tuple[int, str, str]] = []  # guarded-by: _lock  (offset, id, value)
+        self._log_ids: set[str] = set()   # guarded-by: _lock  (re-delivery dedupe)
         # qid -> (ingress traceparent, driver-arrival perf_counter_ns):
         # the distributed-trace envelope across the control channel;
         # consumed when the reply is buffered (respond) or the row drops
-        self._traces: dict[str, tuple[str, int]] = {}
-        self._offset = 0          # highest offset assigned
-        self._committed = 0       # offsets <= this are gone
-        self._reply_buf: dict[int, list] = {}
+        self._traces: dict[str, tuple[str, int]] = {}   # guarded-by: _lock
+        self._offset = 0          # guarded-by: _lock  highest offset assigned
+        self._committed = 0       # guarded-by: _lock  offsets <= this are gone
+        self._reply_buf: dict[int, list] = {}   # guarded-by: _lock
         # rows/replies parked on a worker's death verdict, keyed by worker
         # index; restoreWorker redispatches (resurrection) or drops
         # (restart) them — see markWorkerDead
-        self._parked_rows: dict[int, list] = {}
-        self._parked_replies: dict[int, list] = {}
+        self._parked_rows: dict[int, list] = {}      # guarded-by: _lock
+        self._parked_replies: dict[int, list] = {}   # guarded-by: _lock
         # a flapping worker is skipped (circuit open) instead of paying a
         # doomed round-trip + timeout on every poll round
         self.breaker = CircuitBreaker("fleet.control", failure_threshold=3,

@@ -18,6 +18,7 @@ probability/prediction columns like the GBDT stages.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -92,7 +93,10 @@ def _fit_linear(x: np.ndarray, y: np.ndarray, num_out: int, objective: str,
             ll = jnp.mean((z[:, 0] - yj) ** 2)
         return ll + reg_param * jnp.sum(W * W)
 
-    @jax.jit
+    # donate params/opt: the update loop never reuses the previous
+    # iteration's buffers, so XLA may write the new state in place —
+    # same donation contract as the trainer's step (models/trainer.py)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt):
         l, g = jax.value_and_grad(loss)(params)
         up, opt2 = tx.update(g, opt, params)

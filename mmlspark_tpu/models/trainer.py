@@ -896,6 +896,10 @@ class TpuLearner(Estimator):
                     raise ValueError(f"batches_fn() yielded no batches in "
                                      f"epoch {epoch}")
                 last_loss = float(loss)
+                # the enclosing `with guard:` is the fit-serialization
+                # lock, held for the whole fit BY DESIGN (it serializes
+                # collective fits); logging under it is inherent, not a
+                # contention bug  # graftlint: disable=lock-blocking-call
                 log.info("epoch %d loss %.4f (%d stream batches)",
                          epoch, last_loss, n_batches)
                 if self.getHaltOnNonFinite() and not np.isfinite(last_loss):

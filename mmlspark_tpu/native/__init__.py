@@ -27,6 +27,10 @@ _CSRC = os.path.join(os.path.dirname(__file__), "csrc")
 _BUILD = os.path.join(os.path.dirname(__file__), "_build")
 _SO = os.path.join(_BUILD, "libmmltpu.so")
 
+# one-time-init lock: held across the native build + dlopen ON PURPOSE,
+# so exactly one thread compiles while the rest wait for the result —
+# blocking under it is the mechanism, not a contention bug.
+# graftlint: disable-file=lock-blocking-call
 _lock = threading.Lock()
 _lib = None
 _tried = False

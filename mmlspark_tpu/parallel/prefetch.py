@@ -91,7 +91,9 @@ class DevicePrefetcher:
         self._slots = threading.Semaphore(depth)
         self._q: "queue.Queue[tuple[str, object]]" = queue.Queue()
         self._stop = threading.Event()
-        self._finished = False
+        # consumer-side cursor: thread-confined, never touched by the
+        # producer thread (whose entry point is _work)
+        self._finished = False   # guarded-by: !_work
         self._thread = threading.Thread(target=self._work, daemon=True,
                                         name=f"prefetch-{name}")
         self._thread.start()

@@ -53,6 +53,16 @@ _m_injected = telemetry.registry.counter(
 
 KINDS = ("error", "delay")
 
+#: the canonical injection-site registry. graftlint's ``fault-site``
+#: consistency rule keeps this tuple in lockstep with the actual
+#: ``faults.inject(...)`` call sites across the tree, and
+#: :func:`configure` warns when a chaos spec names a site not listed
+#: here — a typo'd site would otherwise inject nothing, silently.
+SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
+         "serving.transform", "http.request", "powerbi.post",
+         "dataplane.put", "dataplane.allgather", "trainer.step",
+         "supervisor.probe")
+
 
 class InjectedFault(ConnectionError):
     """The error kind's exception. ConnectionError subclass: transient
@@ -120,6 +130,11 @@ def configure(spec: str, seed: Optional[int] = None) -> int:
         seed = fault_seed()
     plans: dict[str, list[_Fault]] = {}
     for site, kind, rate, args in parse(spec):
+        if site not in SITES:
+            # warn, don't raise: tests arm ad-hoc sites, but a typo'd
+            # production chaos spec must at least say so in the log
+            log.warning("fault spec names unregistered site %r "
+                        "(registered: %s)", site, ", ".join(SITES))
         plans.setdefault(site, []).append(_Fault(site, kind, rate, args,
                                                  seed))
     _plans.clear()

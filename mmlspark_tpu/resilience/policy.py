@@ -106,7 +106,10 @@ class RetryPolicy:
         self.max_delay = max_delay
         self.deadline = deadline
         self._retryable = retryable
-        self._rng = random.Random(seed) if seed is not None else random
+        # a private Random instance even when unseeded (Random(None) seeds
+        # from OS entropy): jitter draws never contend on — or reseed —
+        # the process-global random state other threads may rely on
+        self._rng = random.Random(seed)
         self._sleep = sleep
 
     def is_transient(self, exc: BaseException) -> bool:
@@ -208,11 +211,11 @@ class CircuitBreaker:
         self.reset_timeout = reset_timeout
         self.half_open_max = max(1, half_open_max)
         self._clock = clock
-        self._targets: dict[str, _Target] = {}
+        self._targets: dict[str, _Target] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
         CircuitBreaker._instances.add(self)
 
-    def _get(self, target: str) -> _Target:
+    def _get(self, target: str) -> _Target:   # requires-lock: _lock
         t = self._targets.get(target)
         if t is None:
             t = self._targets.setdefault(target, _Target())
@@ -243,36 +246,42 @@ class CircuitBreaker:
             return False
 
     def record(self, target: str = "default", ok: bool = True):
+        # the state transition is decided under the lock; log + tracer
+        # emission happens AFTER release — log handlers do stream/file IO
+        # and the tracer takes its own lock, and neither may stall every
+        # thread contending this breaker (graftlint: lock-blocking-call)
+        transition = None
         with self._lock:
             t = self._get(target)
             if ok:
                 if t.state != "closed":
-                    telemetry.trace.instant("breaker/close",
-                                            breaker=self.name,
-                                            target=target)
-                    log.info("breaker %s/%s: probe ok, closing circuit",
-                             self.name, target)
+                    transition = "close"
                 t.failures = 0
                 t.probes = 0
                 self._set_state(target, t, "closed")
-                return
-            if t.state == "half_open" or (
+            elif t.state == "half_open" or (
                     t.state == "closed"
                     and t.failures + 1 >= self.failure_threshold):
                 t.opened_at = self._clock()
                 t.failures = 0
                 t.probes = 0
                 if t.state != "open":
+                    transition = "open"
                     _m_breaker_opens.labels(breaker=self.name,
                                             target=target).inc()
-                    telemetry.trace.instant("breaker/open",
-                                            breaker=self.name,
-                                            target=target)
-                    log.warning("breaker %s/%s: opening circuit for %.2fs",
-                                self.name, target, self.reset_timeout)
                 self._set_state(target, t, "open")
             else:
                 t.failures += 1
+        if transition == "close":
+            telemetry.trace.instant("breaker/close", breaker=self.name,
+                                    target=target)
+            log.info("breaker %s/%s: probe ok, closing circuit",
+                     self.name, target)
+        elif transition == "open":
+            telemetry.trace.instant("breaker/open", breaker=self.name,
+                                    target=target)
+            log.warning("breaker %s/%s: opening circuit for %.2fs",
+                        self.name, target, self.reset_timeout)
 
     def call(self, fn: Callable, target: str = "default"):
         """Run ``fn()`` through the circuit: short-circuit with

@@ -95,7 +95,7 @@ class _Metric:
         self.help = help
         self._label_names = tuple(label_names)
         self._label_values = tuple(label_values)
-        self._children: dict[tuple, _Metric] = {}
+        self._children: dict[tuple, _Metric] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
         self._init_cells()
 
@@ -135,7 +135,7 @@ class Counter(_Metric):
     kind = "counter"
 
     def _init_cells(self):
-        self._value = 0.0
+        self._value = 0.0   # guarded-by: _lock
 
     def inc(self, amount: float = 1.0):
         if not _state.enabled:
@@ -150,8 +150,14 @@ class Counter(_Metric):
         return self._value
 
     def _expose(self, out: list, names):
+        # exposition carries the conventional `_total` suffix; a family
+        # registered WITH the suffix already (several resilience counters)
+        # must not gain a second one — `..._total_total` broke dashboards
+        # built from the docs/observability.md catalogue
+        base = (self.name if self.name.endswith("_total")
+                else f"{self.name}_total")
         for vals, m in self._series():
-            out.append(f"{self.name}_total{_label_str(names, vals)} "
+            out.append(f"{base}{_label_str(names, vals)} "
                        f"{_fmt(m._value)}")
 
     def _snap(self, vals, m):
@@ -164,7 +170,7 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def _init_cells(self):
-        self._value = 0.0
+        self._value = 0.0   # guarded-by: _lock
 
     def set(self, value: float):
         if not _state.enabled:
@@ -213,9 +219,9 @@ class Histogram(_Metric):
 
     def _init_cells(self):
         # per-bound counts + overflow slot; cumulated only at exposition
-        self._counts = [0] * (len(self._bounds) + 1)
-        self._sum = 0.0
-        self._n = 0
+        self._counts = [0] * (len(self._bounds) + 1)  # guarded-by: _lock
+        self._sum = 0.0   # guarded-by: _lock
+        self._n = 0       # guarded-by: _lock
 
     def observe(self, value: float):
         if not _state.enabled:
@@ -287,7 +293,7 @@ class MetricsRegistry:
     family (so module-level handles across subsystems share series)."""
 
     def __init__(self):
-        self._metrics: dict[str, _Metric] = {}
+        self._metrics: dict[str, _Metric] = {}   # guarded-by: _lock
         self._lock = threading.Lock()
 
     def _get(self, cls, name: str, help: str, labels: Sequence[str],

@@ -125,10 +125,10 @@ class _Span:
 
 class Tracer:
     def __init__(self, max_events: int = 200_000):
-        self._events: collections.deque = collections.deque(
+        self._events: collections.deque = collections.deque(  # guarded-by: _lock
             maxlen=max_events)
         self._lock = threading.Lock()
-        self._dropped = 0
+        self._dropped = 0   # guarded-by: _lock
 
     def span(self, name: str, sync=None, **attrs):
         """Context manager timing its body as one Chrome-trace event.

@@ -1,0 +1,601 @@
+"""graftlint (mmlspark_tpu.analysis): per-rule fixture self-tests + the
+repo-wide gate.
+
+Every rule must (a) catch its positive fixture and (b) stay silent on the
+clean twin — an analyzer that can't demonstrate both is folklore with a
+CLI. The final tests run the whole package through every rule against
+the checked-in baseline and fail on any NEW finding: this is the tier-1
+CI gate the docs promise (docs/static-analysis.md)."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from mmlspark_tpu.analysis import Baseline, run_analysis
+from mmlspark_tpu.analysis.cli import main as graftlint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mmlspark_tpu")
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+
+
+def lint(tmp_path, source, rules=None, name="mod.py", options=None):
+    """Write one fixture module and run the analyzer over it."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_analysis([str(p)], root=str(tmp_path), rules=rules,
+                        options=options)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ jit-safety
+
+class TestJitSafety:
+    def test_host_sync_positive(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + x.item()
+        """, rules=["jit-host-sync"])
+        assert len(fs) == 2
+        assert all(f.rule == "jit-host-sync" for f in fs)
+
+    def test_host_sync_np_asarray_and_derived_taint(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                y = x * 2          # taint propagates through assignment
+                return np.asarray(y)
+        """, rules=["jit-host-sync"])
+        assert rules_of(fs) == ["jit-host-sync"]
+
+    def test_host_sync_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return jnp.asarray(x) * 2
+
+            def host_helper(x):        # not traced: conversions are fine
+                return float(np.asarray(x).sum())
+        """, rules=["jit-host-sync"])
+        assert fs == []
+
+    def test_traced_branch_positive(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, rules=["jit-traced-branch"])
+        assert rules_of(fs) == ["jit-traced-branch"]
+
+    def test_traced_branch_clean_static_attrs(self, tmp_path):
+        # shape/ndim/is-None branches are trace-time static: legal
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x, mask=None):
+                if mask is None:
+                    mask = x * 0
+                if x.ndim == 2:
+                    return x + mask
+                return x
+        """, rules=["jit-traced-branch"])
+        assert fs == []
+
+    def test_traced_branch_respects_static_argnames(self, tmp_path):
+        fs = lint(tmp_path, """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if mode == "fast":     # static: fine
+                    return x
+                return x * 2
+        """, rules=["jit-traced-branch"])
+        assert fs == []
+
+    def test_scan_body_is_traced(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def outer(xs):
+                def body(carry, x):
+                    if x > 0:          # traced scan arg
+                        carry = carry + x
+                    return carry, x
+                return lax.scan(body, 0.0, xs)
+        """, rules=["jit-traced-branch"])
+        assert rules_of(fs) == ["jit-traced-branch"]
+
+    def test_nondeterministic_iter(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                for k in {"a", "b"}:
+                    x = x + len(k)
+                return x
+        """, rules=["jit-nondeterministic-iter"])
+        assert rules_of(fs) == ["jit-nondeterministic-iter"]
+        clean = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def f(x):
+                for k in ("a", "b"):
+                    x = x + len(k)
+                return x
+        """, rules=["jit-nondeterministic-iter"], name="clean.py")
+        assert clean == []
+
+    def test_jit_in_loop(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            def run(fns, x):
+                for fn in fns:
+                    x = jax.jit(fn)(x)     # compile per iteration
+                return x
+        """, rules=["jit-in-loop"])
+        assert rules_of(fs) == ["jit-in-loop"]
+        clean = lint(tmp_path, """
+            import jax
+
+            def run(fn, xs):
+                jfn = jax.jit(fn)
+                for x in xs:
+                    x = jfn(x)
+                return x
+        """, rules=["jit-in-loop"], name="clean.py")
+        assert clean == []
+
+    def test_missing_donate(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            @jax.jit
+            def step(params, opt_state, batch):
+                return params, opt_state
+        """, rules=["jit-missing-donate"])
+        assert rules_of(fs) == ["jit-missing-donate"]
+        clean = lint(tmp_path, """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(params, opt_state, batch):
+                return params, opt_state
+
+            def make(fn):
+                def step2(params, opt_state, b):
+                    return params, opt_state
+                return jax.jit(step2, donate_argnums=(0, 1))
+        """, rules=["jit-missing-donate"], name="clean.py")
+        assert clean == []
+
+    def test_unseeded_random(self, tmp_path):
+        fs = lint(tmp_path, """
+            import random
+            import numpy as np
+
+            def jitter():
+                return random.uniform(0, 1)
+
+            def pick(xs):
+                rng = np.random.default_rng()
+                return rng.choice(xs)
+
+            _shared = random          # module captured as an RNG value
+        """, rules=["unseeded-random"])
+        assert len(fs) == 3
+        clean = lint(tmp_path, """
+            import random
+            import numpy as np
+
+            _rng = random.Random(1234)
+
+            def jitter():
+                return _rng.uniform(0, 1)
+
+            def pick(xs, seed):
+                return np.random.default_rng(seed).choice(xs)
+        """, rules=["unseeded-random"], name="clean.py")
+        assert clean == []
+
+
+# ----------------------------------------------------------------- concurrency
+
+class TestConcurrency:
+    def test_blocking_call_under_lock(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def refresh(self):
+                    with self._lock:
+                        time.sleep(0.5)
+        """, rules=["lock-blocking-call"])
+        assert rules_of(fs) == ["lock-blocking-call"]
+
+    def test_blocking_call_outside_lock_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+            import time
+
+            class Pool:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def refresh(self):
+                    with self._lock:
+                        n = 1
+                    time.sleep(0.5)
+                    return n
+        """, rules=["lock-blocking-call"])
+        assert fs == []
+
+    def test_logging_under_lock_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import logging
+            import threading
+
+            log = logging.getLogger(__name__)
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def act(self):
+                    with self._lock:
+                        log.warning("held")
+        """, rules=["lock-blocking-call"])
+        assert rules_of(fs) == ["lock-blocking-call"]
+
+    def test_lock_order_cycle(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+        """, rules=["lock-order-cycle"])
+        assert rules_of(fs) == ["lock-order-cycle"]
+        clean = lint(tmp_path, """
+            import threading
+
+            class AB:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def also_forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+        """, rules=["lock-order-cycle"], name="clean.py")
+        assert clean == []
+
+    def test_lock_reacquire_nested_and_one_hop(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:      # guaranteed deadlock
+                            pass
+
+                def caller(self):
+                    with self._lock:
+                        self.helper()         # helper re-takes the lock
+
+                def helper(self):
+                    with self._lock:
+                        pass
+        """, rules=["lock-reacquire"])
+        assert len(fs) == 2
+        clean = lint(tmp_path, """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()   # reentrant: legal
+
+                def outer(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+        """, rules=["lock-reacquire"], name="clean.py")
+        assert clean == []
+
+    def test_guarded_by_mutation_outside_lock(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Log:
+                def __init__(self):
+                    self._rows = []      # guarded-by: _lock
+                    self._lock = threading.Lock()
+
+                def bad_append(self, row):
+                    self._rows.append(row)
+
+                def good_append(self, row):
+                    with self._lock:
+                        self._rows.append(row)
+
+                def helper_append(self, row):   # requires-lock: _lock
+                    self._rows.append(row)
+        """, rules=["guarded-by"])
+        assert len(fs) == 1
+        assert fs[0].context == "Log.bad_append"
+
+    def test_guarded_by_thread_confinement(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class P:
+                def __init__(self):
+                    self._done = False    # guarded-by: !_work
+                    self._t = threading.Thread(target=self._work)
+
+                def _work(self):
+                    self._done = True     # the excluded thread mutates it
+
+                def close(self):
+                    self._done = True     # consumer side: fine
+        """, rules=["guarded-by"])
+        assert len(fs) == 1
+        assert fs[0].context == "P._work"
+
+
+# ----------------------------------------------------------------- consistency
+
+_DOC = """
+# obs
+
+## Metric catalogue
+
+| Metric (exposition name) | Type | Where | Meaning |
+|---|---|---|---|
+| `myapp_requests_total` | counter | here | requests |
+| `myapp_stale_gauge` | gauge | gone | no longer registered |
+
+## Span catalogue
+
+| Span / instant | Kind | Where | Meaning |
+|---|---|---|---|
+| `serve/batch` | span | here | batch |
+| `old/span` | span | gone | stale |
+"""
+
+_METRICS_SRC = """
+    from mmlspark_tpu import telemetry
+
+    _reqs = telemetry.registry.counter("myapp_requests", "requests")
+    _depth = telemetry.registry.gauge("myapp_queue_depth", "undocumented")
+
+    def serve():
+        with telemetry.trace.span("serve/batch"):
+            pass
+        telemetry.trace.instant("undocumented/instant")
+"""
+
+
+class TestConsistency:
+    def _run(self, tmp_path, rules):
+        doc = tmp_path / "obs.md"
+        doc.write_text(_DOC)
+        return lint(tmp_path, _METRICS_SRC, rules=rules,
+                    options={"observability_doc": str(doc)})
+
+    def test_metric_catalogue_both_directions(self, tmp_path):
+        fs = self._run(tmp_path, ["metric-catalogue"])
+        msgs = "\n".join(f.message for f in fs)
+        # registered counter resolves to its exposition name and matches
+        assert "myapp_requests_total" not in msgs
+        assert "myapp_queue_depth" in msgs          # registered, undocumented
+        assert "myapp_stale_gauge" in msgs          # documented, unregistered
+        assert len(fs) == 2
+
+    def test_span_catalogue_both_directions(self, tmp_path):
+        fs = self._run(tmp_path, ["span-catalogue"])
+        msgs = "\n".join(f.message for f in fs)
+        assert "undocumented/instant" in msgs
+        assert "old/span" in msgs
+        assert "serve/batch" not in msgs
+        assert len(fs) == 2
+
+    def test_fault_site_both_directions(self, tmp_path):
+        (tmp_path / "faults.py").write_text(textwrap.dedent("""
+            SITES = ("fleet.poll", "never.injected")
+
+            def inject(site):
+                pass
+        """))
+        (tmp_path / "user.py").write_text(textwrap.dedent("""
+            from resilience import faults
+
+            def poll():
+                faults.inject("fleet.poll")
+
+            def rogue():
+                faults.inject("not.registered")
+        """))
+        fs = run_analysis([str(tmp_path)], root=str(tmp_path),
+                          rules=["fault-site"])
+        msgs = "\n".join(f.message for f in fs)
+        assert "not.registered" in msgs
+        assert "never.injected" in msgs
+        assert len(fs) == 2
+
+    def test_codegen_sync_detects_stale_artifact(self, tmp_path):
+        # a fake repo root whose committed R wrapper was tampered with:
+        # regeneration from the live Param registry must flag the drift
+        (tmp_path / "pyproject.toml").write_text("[project]\n")
+        (tmp_path / "R").mkdir()
+        committed = os.path.join(REPO, "R", "generated_wrappers.R")
+        with open(committed) as f:
+            (tmp_path / "R" / "generated_wrappers.R").write_text(
+                f.read() + "\n# local drift\n")
+        (tmp_path / "mod.py").write_text("x = 1\n")
+        fs = run_analysis([str(tmp_path / "mod.py")], root=str(tmp_path),
+                          rules=["codegen-sync"],
+                          options={"codegen": True})
+        assert any(f.rule == "codegen-sync"
+                   and "generated_wrappers.R" in f.message for f in fs)
+
+
+# ----------------------------------------------------- suppression + baseline
+
+class TestSuppressionAndBaseline:
+    SRC = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    time.sleep(1)   # graftlint: disable=lock-blocking-call
+
+            def b(self):
+                with self._lock:
+                    time.sleep(1)
+    """
+
+    def test_line_suppression(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, rules=["lock-blocking-call"])
+        assert len(fs) == 1 and fs[0].context == "C.b"
+
+    def test_file_suppression(self, tmp_path):
+        src = ("# graftlint: disable-file=lock-blocking-call\n"
+               + textwrap.dedent(self.SRC))
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        fs = run_analysis([str(p)], root=str(tmp_path),
+                          rules=["lock-blocking-call"])
+        assert fs == []
+
+    def test_baseline_grandfathers_and_survives_line_moves(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(textwrap.dedent(self.SRC))
+        base = tmp_path / "baseline.json"
+        fs = run_analysis([str(p)], root=str(tmp_path),
+                          rules=["lock-blocking-call"])
+        Baseline.write(str(base), fs)
+        # shift every line down: the fingerprint (no line numbers) holds
+        p.write_text("# a new leading comment\n"
+                     + textwrap.dedent(self.SRC))
+        fs2 = run_analysis([str(p)], root=str(tmp_path),
+                           rules=["lock-blocking-call"],
+                           baseline=str(base))
+        assert len(fs2) == 1 and fs2[0].baselined
+        doc = json.loads(base.read_text())
+        assert doc["findings"][0]["rule"] == "lock-blocking-call"
+
+
+# ------------------------------------------------------------- repo-wide gate
+
+class TestRepoGate:
+    def test_package_is_clean_against_baseline(self):
+        """THE CI gate: every rule over the whole package; any finding
+        not in tools/graftlint_baseline.json fails tier-1."""
+        findings = run_analysis([PKG], root=REPO, baseline=BASELINE,
+                                options={"codegen": False})
+        new = [f for f in findings if not f.baselined]
+        assert new == [], "new graftlint findings:\n" + "\n".join(
+            f.render() for f in new)
+
+    def test_annotations_have_real_coverage(self):
+        """The guarded-by pass must actually see the annotated state the
+        issue requires (a silent parse regression would turn the rule
+        into a no-op)."""
+        from mmlspark_tpu.analysis.concurrency import _collect_classes
+        from mmlspark_tpu.analysis.core import load_project
+        project = load_project([PKG], root=REPO)
+        guards = {}
+        for sf in project.files:
+            for cname, ci in _collect_classes(sf).items():
+                if ci.guards:
+                    guards.setdefault(sf.rel, {})[cname] = set(ci.guards)
+        assert set(guards["mmlspark_tpu/io/http/fleet.py"]
+                   ["ProcessHTTPSource"]) >= {
+            "_log", "_log_ids", "_reply_buf", "_parked_rows",
+            "_parked_replies", "_offset", "_committed"}
+        assert "_targets" in guards["mmlspark_tpu/resilience/policy.py"][
+            "CircuitBreaker"]
+        assert "_events" in guards["mmlspark_tpu/telemetry/tracer.py"][
+            "Tracer"]
+        assert "_children" in guards["mmlspark_tpu/telemetry/registry.py"][
+            "_Metric"]
+        assert "_finished" in guards["mmlspark_tpu/parallel/prefetch.py"][
+            "DevicePrefetcher"]
+
+    def test_cli_json_and_exit_code(self, tmp_path, capsys):
+        rc = graftlint_main(["--no-codegen", "--format", "json"])
+        out = capsys.readouterr().out
+        doc = json.loads(out)
+        assert rc == 0 and doc["new"] == 0
+
+    def test_counter_exposition_not_double_suffixed(self):
+        """The drift the consistency pass surfaced: counters registered
+        WITH `_total` must not expose `..._total_total`."""
+        from mmlspark_tpu import telemetry
+        telemetry.registry.counter("mmlspark_already_total", "t").inc
+        text = telemetry.prometheus_text()
+        assert "_total_total" not in text
+
+    @pytest.mark.extended
+    def test_codegen_sync_clean_on_repo(self):
+        findings = run_analysis([PKG], root=REPO, baseline=BASELINE,
+                                rules=["codegen-sync"],
+                                options={"codegen": True})
+        assert [f for f in findings if not f.baselined] == []
