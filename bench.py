@@ -139,6 +139,94 @@ def main(profile: bool = False):
         print(json.dumps({"trace_file": path, "events": n_ev}))
 
 
+def chaos_train():
+    """Elastic-training chaos scenario: a 4-host (simulated device-group)
+    fit with 10% injected step faults loses one host mid-run; reports
+    steps/sec and the verdict->recovered recovery time. The elastic analog
+    of ``bench_serving.py --chaos`` — the number that matters is how fast
+    a preempted host stops costing committed steps."""
+    # the scenario needs >= 4 devices to host 4 failure domains; on the
+    # CPU backend force the virtual device count BEFORE jax imports
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import tempfile
+    import threading
+
+    import jax
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    from mmlspark_tpu.resilience import faults
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+
+    n_hosts = min(4, len(jax.devices()))
+    if n_hosts < 2:
+        raise SystemExit("--chaos-train needs >= 2 devices to lose one")
+    rng = np.random.default_rng(0)
+    n, bs, epochs = 512, 16, 2                 # 32 steps/epoch
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": y})
+    ck = tempfile.mkdtemp(prefix="chaos_train_")
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [32, 16],
+                                "num_classes": 2})
+               .setEpochs(epochs).setBatchSize(bs).setLearningRate(0.05)
+               .setDeviceDataCap(1)            # the per-step feed path
+               .setCheckpointDir(ck).setCheckpointEverySteps(8))
+    # 10% step faults (absorbed by the retry-once policy) + a per-step
+    # delay that paces the fit past the verdict window — recovery_s is
+    # the metric, the paced steps/sec is reported for context only
+    faults.configure("elastic.step:error:0.1;trainer.step:delay:1.0:0.03",
+                     seed=7)
+    coord = ElasticFitCoordinator(learner, n_hosts=n_hosts, grace=0.3,
+                                  heartbeat_interval=0.05)
+
+    victim = f"host{n_hosts // 2}"
+    done = threading.Event()
+
+    def killer():   # preempt the victim at the first step checkpoint
+        while not done.is_set():
+            if any("_s" in f for f in os.listdir(ck)
+                   if f.endswith(".msgpack")):
+                coord.heartbeats[victim].kill()
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    t0 = time.perf_counter()
+    try:
+        model = coord.fit(df)
+    finally:
+        done.set()
+        faults.clear()
+    dt = time.perf_counter() - t0
+    steps_total = len(coord.committed)
+    recovery = next((a["recovery_s"] for a in coord.attempts
+                     if "recovery_s" in a), None)
+    replayed = steps_total - epochs * (n // bs)
+    metric = "chaos_train_recovery_seconds"
+    base = _baseline_value(metric)
+    assert np.isfinite(model._final_loss)
+    print(json.dumps({
+        "metric": metric,
+        "value": None if recovery is None else round(recovery, 3),
+        "unit": "s",
+        "vs_baseline": (round(recovery / base, 3)
+                        if base and recovery is not None else None),
+        "steps_per_sec": round(steps_total / dt, 1),
+        "steps_total": steps_total,
+        "steps_replayed": replayed,
+        "hosts": f"{n_hosts}->{n_hosts - 1}",
+        "attempts": len(coord.attempts),
+        "dead": sorted(coord.supervisor.dead_hosts()),
+    }))
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -146,4 +234,13 @@ if __name__ == "__main__":
                     help="capture XLA cost analysis, compile accounting "
                          "and live-buffer HBM peaks (telemetry.profiler); "
                          "prints an extra {\"profile\": ...} JSON line")
-    main(profile=ap.parse_args().profile)
+    ap.add_argument("--chaos-train", action="store_true",
+                    help="elastic-training chaos scenario: kill one "
+                         "simulated host mid-fit under 10%% step faults; "
+                         "reports steps/sec + recovery seconds "
+                         "(docs/reliability.md, elastic training)")
+    args = ap.parse_args()
+    if args.chaos_train:
+        chaos_train()
+    else:
+        main(profile=args.profile)

@@ -565,6 +565,8 @@ def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
     """
     from jax.sharding import PartitionSpec as P
 
+    from ...parallel.compat import shard_map
+
     if tree_learner == "data":
         def body(bins, g, h, rm, fm):
             return _stack_class_axis([
@@ -599,9 +601,9 @@ def make_sharded_builder(mesh, tree_learner: str, *, depth: int, n_bins: int,
     # the rows it describes (feature mode holds full rows on every device)
     node_spec = (P(None, axis_name) if tree_learner == "data"
                  else P(None, None))
-    fn = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
-                       out_specs=(P(None), P(None), P(None), node_spec),
-                       check_vma=False)
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs,
+                   out_specs=(P(None), P(None), P(None), node_spec),
+                   check=False)
     return jax.jit(fn)
 
 

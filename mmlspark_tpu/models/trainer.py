@@ -202,6 +202,18 @@ def _host_tree(tree):
     return jax.tree_util.tree_map(conv, tree)
 
 
+def _params_digest(params) -> str:
+    """sha256 over the host bytes of every param leaf (treedef order) —
+    the elastic coordinator's bit-exact-resume evidence: a resumed
+    attempt's digest must equal the digest of the checkpoint it claims to
+    restore."""
+    import hashlib
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(_host_tree(params)):
+        h.update(np.ascontiguousarray(leaf).tobytes())
+    return h.hexdigest()
+
+
 def _replace_like(host_tree, placed_tree):
     """Put a host-numpy tree back onto the shardings of an already-placed
     tree (multi-process checkpoint restore: device_put cannot target
@@ -448,6 +460,31 @@ class TpuLearner(Estimator):
         "(telemetry.profiler). Enables telemetry and adds a sync point "
         "per dispatch — measurement mode, not the production default",
         default=False)
+    elastic = BooleanParam(
+        "run fit through the elastic training runtime "
+        "(resilience/elastic.py): host heartbeats + a TrainSupervisor "
+        "declare a dead/preempted host within the grace window, the fit "
+        "re-meshes over the surviving hosts and resumes from the latest "
+        "(epoch, step) consensus checkpoint — zero committed steps lost. "
+        "Requires checkpointDir; forces the per-step feed path; composes "
+        "with data(+tensor) parallelism only", default=False)
+    elasticHosts = IntParam(
+        "failure domains for elastic training: 0 = one host per JAX "
+        "process (the real host boundary); >1 single-process = split the "
+        "local devices into this many simulated host groups (chaos "
+        "testing / laptop rehearsal of the multi-host recovery path)",
+        default=0, min=0)
+    elasticMinHosts = IntParam(
+        "survivors needed to keep training in-job after a host loss; "
+        "below it the fit raises ElasticFleetLost (relaunch the fleet "
+        "against the same checkpointDir to resume)", default=1, min=1)
+    elasticGraceSeconds = FloatParam(
+        "heartbeat age that turns silence into a death verdict; 0 = "
+        "MMLSPARK_TPU_ELASTIC_GRACE or 2.0", default=0.0, min=0.0)
+    elasticMaxFailures = IntParam(
+        "transient fit failures tolerated WITHOUT a host verdict before "
+        "the elastic loop gives up (failures attributed to a dead host "
+        "re-mesh instead and do not burn this budget)", default=5, min=1)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     # Two granularities: ``ckpt_EEEEE.msgpack`` marks epoch E COMPLETE;
@@ -547,11 +584,12 @@ class TpuLearner(Estimator):
     def _resume_training_state(self, params, opt_state, nproc: int):
         """Consensus-pick the resume position and restore (params,
         opt_state) onto their existing mesh shardings. Returns (params,
-        opt_state, start_epoch, start_step). Shared by fit() and
-        fitStream()."""
+        opt_state, start_epoch, start_step, resume_pos) — resume_pos is
+        the ``(epoch, step)`` consensus position restored from, or None
+        for a fresh start. Shared by fit() and fitStream()."""
         resume = self._consensus_resume(self._latest_checkpoint(), nproc)
         if resume is None:
-            return params, opt_state, 0, 0
+            return params, opt_state, 0, 0, None
         placed = (params, opt_state)
         params, opt_state = self._restore_checkpoint(resume, params,
                                                      opt_state)
@@ -563,12 +601,28 @@ class TpuLearner(Estimator):
         epoch, step = resume
         if step is None:
             log.info("resumed from checkpoint epoch %d", epoch)
-            return params, opt_state, epoch + 1, 0
+            return params, opt_state, epoch + 1, 0, resume
         log.info("resumed from checkpoint epoch %d step %d", epoch, step)
-        return params, opt_state, epoch, step + 1
+        return params, opt_state, epoch, step + 1, resume
 
     # ---- training ----
     def fit(self, df: DataFrame) -> TpuModel:
+        if self.getElastic():
+            from ..resilience.elastic import ElasticFitCoordinator
+            return ElasticFitCoordinator(
+                self, n_hosts=self.getElasticHosts(),
+                min_hosts=self.getElasticMinHosts(),
+                grace=self.getElasticGraceSeconds() or None,
+                max_failures=self.getElasticMaxFailures()).fit(df)
+        return self._fit_core(df)
+
+    def _fit_core(self, df: DataFrame, devices=None,
+                  elastic_ctx=None) -> TpuModel:
+        """One fit attempt. ``devices`` restricts the mesh to a subset of
+        the visible devices (the elastic coordinator passes the surviving
+        hosts' pool after a re-mesh); ``elastic_ctx`` threads the per-step
+        host-loss check and the committed-step/resume journal through the
+        dispatch loop."""
         # persistent compile cache for cold single-process fits (the
         # distributed path and tests already configure it)
         from ..parallel.distributed import configure_xla_cache
@@ -586,6 +640,11 @@ class TpuLearner(Estimator):
         ep = self.getExpertParallel()
         pp = self.getPipelineParallel()
         attn_fn = None
+        if elastic_ctx is not None and (sp > 1 or ep > 1 or pp > 1):
+            raise ValueError(
+                "elastic fit composes with data(+tensor) parallelism only "
+                "(a seq/expert/pipe axis cannot shrink mid-run); run "
+                "sp/ep/pp fits without elastic")
         if sp > 1 and ep > 1:
             raise ValueError("sequenceParallel and expertParallel cannot both "
                              "exceed 1 (compose dp x sp or dp x ep meshes)")
@@ -644,7 +703,7 @@ class TpuLearner(Estimator):
                 _require_inner_block_local({"pipelineParallel": pp})
             mesh = meshlib.make_mesh({"data": n_dev // pp, "pipe": pp})
         else:
-            mesh = meshlib.create_mesh(model=tp)
+            mesh = meshlib.create_mesh(model=tp, devices=devices)
         module = build_model(cfg, attn_fn=attn_fn)
         rng = jax.random.PRNGKey(self.getSeed())
         # init batch must satisfy the shard_map divisibility of the sp
@@ -712,7 +771,12 @@ class TpuLearner(Estimator):
         data_cap = self.getDeviceDataCap() or _device_data_cap()
         if self.getProfile():
             telemetry.profiler.enable()
-        if nproc == 1 and x.nbytes + y.nbytes <= data_cap:
+        # elastic fits stay on the per-step feed path: step-interval
+        # checkpoints and the per-dispatch host-loss check both need the
+        # host in the loop between steps (the scan path's whole-epoch
+        # dispatch would turn a mid-epoch host loss into a lost epoch)
+        if nproc == 1 and elastic_ctx is None \
+                and x.nbytes + y.nbytes <= data_cap:
             scan_fn = telemetry.profiler.wrap(_make_scan_epoch_fn(
                 module, tx, loss_fn, is_moe, moe_aux, mesh,
                 _scan_batch(bs_global, mesh, pp), step_body=pp_body),
@@ -731,8 +795,14 @@ class TpuLearner(Estimator):
         rng_np = np.random.default_rng(
             self.getSeed() + (0 if meshlib.in_local_fit()
                               else jax.process_index()))
-        params, opt_state, start_epoch, start_step = \
+        params, opt_state, start_epoch, start_step, resume_pos = \
             self._resume_training_state(params, opt_state, nproc)
+        if elastic_ctx is not None:
+            # bit-exact-resume evidence for the coordinator's journal: the
+            # digest of the restored params (None on a fresh start)
+            elastic_ctx.resumed(
+                resume_pos,
+                _params_digest(params) if resume_pos is not None else None)
 
         # concurrent fits from a thread pool (TuneHyperparameters) must not
         # interleave collective programs across the same devices — same
@@ -747,7 +817,7 @@ class TpuLearner(Estimator):
                 start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
                 nproc=nproc, train_step=train_step, params=params,
                 opt_state=opt_state, scan_fn=scan_fn,
-                start_step=start_step)
+                start_step=start_step, elastic_ctx=elastic_ctx)
 
         return self._package_model(cfg, params, last_loss)
 
@@ -832,7 +902,7 @@ class TpuLearner(Estimator):
             self.getMoeAuxWeight() if is_moe else 0.0), "trainer.step")
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
-        params, opt_state, start_epoch, start_step = \
+        params, opt_state, start_epoch, start_step, _ = \
             self._resume_training_state(params, opt_state, nproc)
         if start_step:
             # a stream cannot skip deterministically to step N (the
@@ -965,7 +1035,7 @@ class TpuLearner(Estimator):
 
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state,
-                    scan_fn=None, start_step=0):
+                    scan_fn=None, start_step=0, elastic_ctx=None):
         if scan_fn is not None:
             if start_step:
                 # the scan path cannot enter an epoch mid-way (one dispatch
@@ -1061,11 +1131,18 @@ class TpuLearner(Estimator):
                                           step=s) as sp:
                     def dispatch(_a, p=params, o=opt_state, xb=xb, yb=yb,
                                  wb=wb):
+                        if elastic_ctx is not None:
+                            # host-loss check + elastic.step fault site;
+                            # HostLossError is non-transient, so it skips
+                            # the retry and unwinds to the re-mesh
+                            elastic_ctx.check_step()
                         faults.inject("trainer.step")
                         return train_step(p, o, xb, yb, wb)
                     params, opt_state, loss = _STEP_RETRY.run(dispatch)
                     sp.set_sync(loss)
                 _m_step_time.observe(time.perf_counter() - t_step)
+                if elastic_ctx is not None:
+                    elastic_ctx.step_committed(epoch, s)
                 if s < steps - 1:
                     if ckpt_every and (s + 1) % ckpt_every == 0 \
                             and jax.process_index() == 0:

@@ -1,0 +1,61 @@
+"""JAX API compatibility shims for the parallel layer.
+
+The framework tracks JAX's public API, which moves under us: ``shard_map``
+graduated from ``jax.experimental.shard_map`` to ``jax.shard_map`` and its
+replication-check keyword renamed ``check_rep`` -> ``check_vma`` along the
+way. Call sites that pin either spelling break on the other half of the
+installed-version matrix — exactly the drift that turned the seq-parallel
+and pipeline-parallel suites red. This module resolves the installed
+spelling ONCE at import and exposes a single :func:`shard_map` the rest of
+``parallel/`` (and the GBDT engine's collective tree builders) call, so the
+next rename is a one-line fix here instead of a five-module sweep.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Resolve the installed shard_map entry point: prefer the public
+# ``jax.shard_map`` (>= 0.6), fall back to the experimental module that
+# hosted it through the 0.4/0.5 series.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _CHECK_KWARG = "check_vma"
+else:  # pragma: no cover - exercised only on older jaxlib images
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KWARG = "check_rep"
+    try:  # 0.4.30+ spells it check_rep; probe instead of version-sniffing
+        import inspect
+        if "check_rep" not in inspect.signature(_shard_map).parameters:
+            _CHECK_KWARG = "check_vma"
+    except (TypeError, ValueError):
+        pass
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis from inside a ``shard_map`` body.
+
+    ``lax.axis_size`` where the installed JAX has it; the 0.4-series
+    equivalent (``jax.core.axis_frame`` resolves the bound axis env and
+    yields the size as a plain int) otherwise. Must stay static — callers
+    unroll ring schedules and ppermute tables from it.
+    """
+    from jax import lax
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as _core
+    return _core.axis_frame(axis_name)
+
+
+def shard_map(f, mesh, in_specs, out_specs, check: bool = False):
+    """Version-portable ``shard_map``.
+
+    ``check`` maps onto whichever replication/varying-manual-axes check
+    keyword the installed JAX spells (``check_rep`` before the rename,
+    ``check_vma`` after). The framework always passes False: its collective
+    bodies (ring attention, GPipe ticks, masked-psum tree builders) use
+    per-device ``axis_index`` branches the static checker cannot prove
+    replicated.
+    """
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KWARG: check})

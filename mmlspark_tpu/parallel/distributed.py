@@ -53,6 +53,30 @@ DEFAULT_INIT_TIMEOUT = 120
 _initialized = False
 
 
+def _enable_cpu_collectives() -> None:
+    """Multi-process CPU fleets need a real cross-process collective
+    implementation: the plain CPU client raises "Multiprocess computations
+    aren't implemented on the CPU backend" at the first allgather. jaxlib
+    ships gloo TCP collectives behind a config knob — select them whenever
+    the job will run on the CPU platform (the multiproc-CPU smoke, local
+    fleet rehearsal, CI). Must run BEFORE the backend client is created;
+    initialize() is the single choke point every launcher goes through.
+    On TPU/GPU jobs the knob is irrelevant and skipped."""
+    # platform must be decided WITHOUT touching jax.devices(): instantiating
+    # the backend here would bake the collectives choice in before the knob
+    # lands. The config value covers jax.config.update("jax_platforms",...)
+    # callers (tests, the smoke workers); the env vars cover launchers.
+    platforms = (getattr(jax.config, "jax_platforms", None)
+                 or os.environ.get("JAX_PLATFORMS", "")
+                 or os.environ.get("JAX_PLATFORM_NAME", "")).lower()
+    if platforms != "cpu":
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:   # older jaxlib without the knob: leave as-was
+        pass
+
+
 def is_initialized() -> bool:
     return _initialized
 
@@ -82,6 +106,7 @@ def initialize(coordinator_address: Optional[str] = None,
         heartbeat_timeout = int(os.environ[ENV_HEARTBEAT_TIMEOUT])
     if heartbeat_timeout is not None:
         kwargs["heartbeat_timeout_seconds"] = heartbeat_timeout
+    _enable_cpu_collectives()
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id,

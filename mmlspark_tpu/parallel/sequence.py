@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .compat import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -142,7 +144,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     LightGBM socket ring TrainUtils.scala:141 and the MPI ring
     CommandBuilders.scala:241, both CPU-side; here the ring IS the compute).
     """
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
@@ -189,7 +191,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     two ``lax.all_to_all`` re-shard to (B, T, H/sp, D) — full sequence,
     head-sharded — where dense local attention runs, then back. Requires
     H % sp == 0."""
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     H = q.shape[2]
     if H % sp != 0:
         raise ValueError(f"ulysses needs heads ({H}) divisible by sp ({sp})")
@@ -234,8 +236,8 @@ def make_sp_attention(mesh: Mesh, axis_name: str = "seq",
         raise ValueError(f"unknown sp mode {mode!r} (ring|ulysses)")
 
     def attn(q, k, v):
-        return jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)(q, k, v)
+        return shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check=False)(q, k, v)
     return attn
 
 

@@ -21,7 +21,13 @@ the trainer:
                         recovery path is testable on CPU in CI;
   * :mod:`supervisor` — :class:`FleetSupervisor`: health probing, automatic
                         worker restart with backoff, and redispatch of a
-                        dead worker's in-flight rows.
+                        dead worker's in-flight rows;
+  * :mod:`elastic`    — elastic training: :class:`TrainSupervisor`
+                        (heartbeat-file death verdicts with a grace
+                        window, restart-vs-shrink decisions) and
+                        :class:`ElasticFitCoordinator` (re-mesh over
+                        surviving hosts + consensus-checkpoint resume —
+                        a fit survives a preempted host).
 
 Everything reports through :mod:`mmlspark_tpu.telemetry` (retry counters,
 breaker-state gauges, injected-fault counters, restart counters); see
@@ -31,8 +37,11 @@ docs/reliability.md.
 from __future__ import annotations
 
 from . import faults
+from .elastic import (ElasticFitCoordinator, ElasticFleetLost,
+                      HostHeartbeat, HostLossError, TrainSupervisor)
 from .policy import BreakerOpen, CircuitBreaker, RetryPolicy
 from .supervisor import FleetSupervisor
 
 __all__ = ["faults", "BreakerOpen", "CircuitBreaker", "RetryPolicy",
-           "FleetSupervisor"]
+           "FleetSupervisor", "TrainSupervisor", "ElasticFitCoordinator",
+           "ElasticFleetLost", "HostHeartbeat", "HostLossError"]

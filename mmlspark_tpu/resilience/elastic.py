@@ -1,0 +1,521 @@
+"""Elastic multi-host training: heartbeats, death verdicts, re-mesh, resume.
+
+PR 3's resilience machinery (RetryPolicy, faults, FleetSupervisor, step
+checkpoints) protects *serving*; training still died with its first lost
+host. This module extends the same model to ``TpuLearner.fit``: a fit that
+loses a host **re-meshes over the survivors and resumes from the latest
+consensus checkpoint**, losing zero committed steps — the fault-tolerant
+distributed-training posture of the reference's distributed LightGBM
+lineage (PAPER.md L5), rebuilt on XLA collectives, and the
+barrier-execution recovery shape of JAMPI (arxiv 2007.01811: a failed
+collective stage re-runs from its barrier, here the checkpoint).
+
+Three pieces:
+
+* :class:`HostHeartbeat` — one per host, a background thread writing
+  ``hb_<host>.json`` (atomic write-then-rename, like checkpoints) into a
+  directory on the job's shared storage every ``interval`` seconds, carrying
+  the host's latest committed ``(epoch, step)``. A host that stops beating
+  *is* the failure signal — a preempted VM cannot be asked.
+* :class:`TrainSupervisor` — the :class:`~.supervisor.FleetSupervisor`
+  sibling for training fleets. Probes heartbeat ages (fault site
+  ``supervisor.heartbeat``), declares a host dead once its heartbeat is
+  older than the ``grace`` window, and answers the restart-vs-shrink
+  question: **shrink** while the survivors still satisfy ``min_hosts``,
+  **restart** (give up in-job, let the launcher relaunch against the same
+  checkpointDir) below it.
+* :class:`ElasticFitCoordinator` — drives ``learner._fit_core`` in a
+  recovery loop. Every optimizer step passes through
+  :meth:`ElasticStepContext.check_step` (fault site ``elastic.step``;
+  transient errors ride the trainer's existing retry-once policy); a death
+  verdict on a mesh member raises :class:`HostLossError` out of the step
+  loop, and the coordinator then re-meshes (fault site ``elastic.remesh``):
+  rebuilds the device pool from the surviving hosts, re-creates the
+  ``parallel/mesh`` mesh, re-places params, and re-enters the fit — which
+  resumes from the ``(epoch, step)`` consensus checkpoint
+  (``checkpointEverySteps`` format), so every step that reached a
+  checkpoint survives the loss bit-exactly.
+
+Single-process mode rehearses the full recovery path with *simulated*
+hosts (contiguous device groups, ``mesh.host_device_groups``): killing a
+group's heartbeat exercises verdict -> re-mesh -> resume exactly as a real
+preemption would, which is what the tier-1 chaos test and
+``bench.py --chaos-train`` drive. Multi-process mode runs the same
+heartbeats and verdicts, but an in-job re-mesh is impossible once
+``jax.distributed`` has lost a member — there the coordinator's job is to
+fail FAST and cleanly (HostLossError instead of a hung collective), so the
+launcher can relaunch the fleet smaller against the same checkpointDir;
+the consensus-resume logic picks it up from the last committed step.
+
+Env knobs: ``MMLSPARK_TPU_ELASTIC_GRACE`` (death-verdict window, seconds;
+the ``elasticGraceSeconds`` param overrides), ``MMLSPARK_TPU_ELASTIC_HB``
+(heartbeat write interval, default grace/4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import telemetry
+from ..core.utils import get_logger
+from . import faults
+from .policy import default_transient
+
+log = get_logger("resilience.elastic")
+
+_m_host_losses = telemetry.registry.counter(
+    "mmlspark_elastic_host_losses_total",
+    "hosts declared dead by the train supervisor", labels=("host",))
+_m_remeshes = telemetry.registry.counter(
+    "mmlspark_elastic_remeshes_total",
+    "fit recoveries that rebuilt the mesh over surviving hosts")
+_m_attempt_failures = telemetry.registry.counter(
+    "mmlspark_elastic_attempt_failures_total",
+    "elastic fit attempts that ended in a classified-transient failure "
+    "without a host verdict (retried on the same mesh)")
+_m_recovery_seconds = telemetry.registry.histogram(
+    "mmlspark_elastic_recovery_seconds",
+    "host-loss detection -> first optimizer step committed on the "
+    "re-meshed (or retried) fit")
+_m_hosts_alive = telemetry.registry.gauge(
+    "mmlspark_elastic_hosts_alive",
+    "hosts currently alive in the elastic training fleet")
+_m_steps_replayed = telemetry.registry.counter(
+    "mmlspark_elastic_steps_replayed_total",
+    "committed-but-unchekpointed steps re-run after a resume (the work a "
+    "smaller checkpointEverySteps would have saved)")
+
+
+class HostLossError(RuntimeError):
+    """A mesh-member host was declared dead mid-fit. Deliberately NOT a
+    ConnectionError: the per-step retry policy must not absorb it — the
+    recovery is a re-mesh + checkpoint resume, not a redispatch."""
+
+    def __init__(self, hosts):
+        self.hosts = sorted(hosts)
+        super().__init__(f"host(s) {', '.join(self.hosts)} declared dead "
+                         f"mid-fit")
+
+
+class ElasticFleetLost(RuntimeError):
+    """Survivors fell below ``min_hosts`` (or the failure budget ran out):
+    in-job recovery is off the table; relaunch the fleet against the same
+    checkpointDir to resume."""
+
+
+def _grace_default() -> float:
+    try:
+        return float(os.environ.get("MMLSPARK_TPU_ELASTIC_GRACE", "") or 2.0)
+    except ValueError:
+        return 2.0
+
+
+def _hb_interval_default(grace: float) -> float:
+    try:
+        v = os.environ.get("MMLSPARK_TPU_ELASTIC_HB", "")
+        return float(v) if v else max(0.05, grace / 4.0)
+    except ValueError:
+        return max(0.05, grace / 4.0)
+
+
+def heartbeat_dir(checkpoint_dir: str) -> str:
+    return os.path.join(checkpoint_dir, "heartbeats")
+
+
+class HostHeartbeat:
+    """Background liveness beacon for one host.
+
+    Writes ``hb_<host>.json`` with ``{host, time, epoch, step}`` every
+    ``interval`` seconds (write-then-rename: a torn read must never look
+    like a dead host). ``beat(epoch, step)`` advances the progress the
+    file carries; :meth:`kill` stops the thread WITHOUT a farewell write —
+    the simulated-preemption switch chaos tests flip (a real preemption
+    stops mid-air the same way).
+    """
+
+    def __init__(self, host_id: str, directory: str, interval: float):
+        self.host_id = host_id
+        self.directory = directory
+        self.interval = interval
+        self._lock = threading.Lock()
+        self._pos = (0, -1)          # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"heartbeat-{host_id}")
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, f"hb_{self.host_id}.json")
+
+    def beat(self, epoch: int, step: int):
+        with self._lock:
+            self._pos = (epoch, step)
+
+    def _write(self):
+        with self._lock:
+            epoch, step = self._pos
+        doc = {"host": self.host_id, "time": time.time(),
+               "epoch": epoch, "step": step}
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self._write()
+            except OSError as e:   # shared storage blip: skip one beat
+                log.warning("heartbeat %s write failed: %s", self.host_id, e)
+            self._stop.wait(self.interval)
+
+    def start(self) -> "HostHeartbeat":
+        os.makedirs(self.directory, exist_ok=True)
+        self._write()
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Clean shutdown (fit finished): final write then join, so a
+        supervisor that outlives the fit doesn't read a stale file age."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+
+    def kill(self):
+        """Simulated preemption: the beacon stops mid-air, no final write.
+        The supervisor's grace window turns the silence into a verdict."""
+        self._stop.set()
+
+
+class TrainSupervisor:
+    """Death-verdict loop over an elastic training fleet's heartbeats.
+
+    The :class:`~.supervisor.FleetSupervisor` sibling: same tick/thread
+    shape, but the subjects are training hosts (heartbeat files on shared
+    storage) rather than serving workers (HTTP health probes), and the
+    remedy is a re-mesh rather than a respawn — dead training hosts are
+    *removed*, not restarted, because the collective program must shrink
+    with them.
+
+    ``probe(host_id) -> age_seconds | None`` is pluggable (tests inject
+    clocks); the default reads the heartbeat file's ``time`` field. A host
+    whose heartbeat is older than ``grace`` — or unreadable past the same
+    window — is declared dead exactly once; verdicts are sticky (a zombie
+    heartbeat resuming after its verdict stays dead: its devices left the
+    mesh, rejoining means relaunching).
+    """
+
+    def __init__(self, host_ids, directory: str,
+                 grace: Optional[float] = None,
+                 min_hosts: int = 1,
+                 probe: Optional[Callable] = None,
+                 probe_interval: Optional[float] = None):
+        self.host_ids = list(host_ids)
+        self.directory = directory
+        self.grace = grace if grace is not None else _grace_default()
+        self.min_hosts = max(1, min_hosts)
+        self._probe = probe or self._probe_file
+        self.probe_interval = (probe_interval if probe_interval is not None
+                               else max(0.05, self.grace / 4.0))
+        self._lock = threading.Lock()
+        self._dead: set[str] = set()        # guarded-by: _lock
+        self._started_at = time.monotonic()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="train-supervisor")
+        _m_hosts_alive.set(len(self.host_ids))
+
+    # ---- probing ----
+    def _probe_file(self, host_id: str) -> Optional[float]:
+        """Heartbeat age in seconds; None when the file is missing or
+        unreadable (counted against the host once the startup grace is
+        spent — a host that never wrote at all is as dead as one that
+        stopped)."""
+        try:
+            with open(os.path.join(self.directory,
+                                   f"hb_{host_id}.json"),
+                      "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            return max(0.0, time.time() - float(doc["time"]))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def tick(self):
+        """One verdict pass (public: deterministic tests drive it directly,
+        the background thread calls it on ``probe_interval``)."""
+        verdicts = []
+        for host_id in self.host_ids:
+            with self._lock:
+                if host_id in self._dead:
+                    continue
+            faults.inject("supervisor.heartbeat")
+            age = self._probe(host_id)
+            if age is None:
+                # missing file: only fatal once the fleet has had time to
+                # write its first beats
+                if time.monotonic() - self._started_at < self.grace:
+                    continue
+                verdicts.append((host_id, None))
+            elif age > self.grace:
+                verdicts.append((host_id, age))
+        for host_id, age in verdicts:
+            with self._lock:
+                if host_id in self._dead:
+                    continue
+                self._dead.add(host_id)
+                alive = len(self.host_ids) - len(self._dead)
+            # verdict bookkeeping is IO (log/trace/metrics): after release
+            _m_host_losses.labels(host=host_id).inc()
+            _m_hosts_alive.set(alive)
+            telemetry.trace.instant("elastic/host_loss", host=host_id,
+                                    age=age)
+            telemetry.flight.note("elastic/host_loss", host=host_id,
+                                  age=age, alive=alive)
+            log.warning(
+                "host %s declared DEAD (heartbeat %s, grace %.2fs); "
+                "%d host(s) remain", host_id,
+                "missing" if age is None else f"{age:.2f}s old",
+                self.grace, alive)
+
+    def dead_hosts(self) -> set[str]:
+        with self._lock:
+            return set(self._dead)
+
+    def alive_hosts(self) -> list[str]:
+        with self._lock:
+            return [h for h in self.host_ids if h not in self._dead]
+
+    def decision(self) -> str:
+        """``"shrink"`` when the survivors can keep training in-job,
+        ``"restart"`` when they cannot (relaunch against the same
+        checkpointDir — consensus resume carries the run over)."""
+        return ("shrink" if len(self.alive_hosts()) >= self.min_hosts
+                else "restart")
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:   # a probe bug must not kill the loop
+                log.warning("train-supervisor tick failed: %s", e)
+            self._stop.wait(self.probe_interval)
+
+    def start(self) -> "TrainSupervisor":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+
+class ElasticStepContext:
+    """The per-step hook the trainer's dispatch loop calls during an
+    elastic fit. Cheap when nothing is wrong: one fault-site check and one
+    set read per optimizer step."""
+
+    def __init__(self, coordinator: "ElasticFitCoordinator"):
+        self._coord = coordinator
+
+    def check_step(self):
+        """Runs inside the step dispatch, BEFORE the device work. An
+        injected ``elastic.step`` fault is a ConnectionError — the
+        trainer's retry-once policy absorbs singles, doubles escalate to
+        the coordinator's transient classification. A death verdict on a
+        mesh member raises :class:`HostLossError` (non-transient: skips
+        the retry and unwinds to the re-mesh)."""
+        faults.inject("elastic.step")
+        dead = self._coord.dead_mesh_hosts()
+        if dead:
+            raise HostLossError(dead)
+
+    def step_committed(self, epoch: int, step: int):
+        """The trainer reports each completed optimizer step: advances
+        this process's heartbeat progress, closes any pending
+        recovery-time measurement, and feeds the committed-step journal
+        the chaos tests audit for gaps."""
+        self._coord.note_step(epoch, step)
+
+    def resumed(self, pos, params_digest: Optional[str]):
+        """The trainer reports the checkpoint position (or None for a
+        fresh start) and a digest of the restored params — the bit-exact
+        resume evidence."""
+        self._coord.note_resume(pos, params_digest)
+
+
+class ElasticFitCoordinator:
+    """Drives a ``TpuLearner`` fit through host loss.
+
+    ``fit(df)``: build the host groups, start heartbeats + the
+    supervisor, then loop ``learner._fit_core(df, devices=pool,
+    elastic_ctx=ctx)`` until it returns a model. A
+    :class:`HostLossError` (or an exhausted-transient failure that a
+    fresh verdict pass attributes to a dead host) triggers the re-mesh:
+    survivors' devices become the new pool, and the next ``_fit_core``
+    attempt resumes from the latest consensus checkpoint. Failures with
+    *no* dead host burn the ``max_failures`` budget and retry on the same
+    mesh — persistent infrastructure trouble must not loop forever.
+    """
+
+    def __init__(self, learner, n_hosts: int = 0,
+                 min_hosts: int = 1,
+                 grace: Optional[float] = None,
+                 max_failures: int = 5,
+                 heartbeat_interval: Optional[float] = None):
+        if not learner.getCheckpointDir():
+            raise ValueError(
+                "elastic fit requires checkpointDir: recovery is a resume "
+                "from the consensus checkpoint — without one a host loss "
+                "restarts from scratch, losing every committed step")
+        self.learner = learner
+        self.grace = grace if grace is not None else _grace_default()
+        self.min_hosts = max(1, min_hosts)
+        self.max_failures = max(1, max_failures)
+        hb = (heartbeat_interval if heartbeat_interval is not None
+              else _hb_interval_default(self.grace))
+        from ..parallel import mesh as meshlib
+        self.groups = dict(meshlib.host_device_groups(n_hosts))
+        self.hb_dir = heartbeat_dir(learner.getCheckpointDir())
+        self.heartbeats = {h: HostHeartbeat(h, self.hb_dir, hb)
+                           for h in self.groups}
+        self.supervisor = TrainSupervisor(
+            list(self.groups), self.hb_dir, grace=self.grace,
+            min_hosts=self.min_hosts)
+        self.attempts: list[dict] = []   # per-attempt journal (tests/bench)
+        self.committed: list[tuple] = []   # (epoch, step) journal
+        self._mesh_hosts: set[str] = set()
+        self._pending_recovery_t0: Optional[float] = None
+        self._last_ckpt_pos: Optional[tuple] = None
+
+    # ---- state read by the step hook (fit thread) ----
+    def dead_mesh_hosts(self) -> set[str]:
+        return self.supervisor.dead_hosts() & self._mesh_hosts
+
+    def note_step(self, epoch: int, step: int):
+        self.committed.append((epoch, step))
+        for h in self._mesh_hosts:
+            self.heartbeats[h].beat(epoch, step)
+        if self._pending_recovery_t0 is not None:
+            dt = time.monotonic() - self._pending_recovery_t0
+            self._pending_recovery_t0 = None
+            _m_recovery_seconds.observe(dt)
+            self.attempts[-1]["recovery_s"] = dt
+            log.info("elastic recovery complete: first step committed "
+                     "%.2fs after the failure", dt)
+
+    def note_resume(self, pos, params_digest):
+        self._last_ckpt_pos = pos
+        self.attempts[-1]["resume_pos"] = pos
+        self.attempts[-1]["resume_digest"] = params_digest
+        if pos is not None and self.committed:
+            # steps the previous attempt committed past the checkpoint are
+            # about to be re-run — the measurable cost of the ckpt interval
+            e, s = pos
+            replay = sum(1 for (ce, cs) in self.committed
+                         if (ce, cs) > (e, -1 if s is None else s))
+            if replay:
+                _m_steps_replayed.inc(replay)
+
+    # ---- the recovery loop ----
+    def _pool(self) -> list:
+        self._mesh_hosts = set(self.supervisor.alive_hosts())
+        return [d for h in sorted(self._mesh_hosts)
+                for d in self.groups[h]]
+
+    def fit(self, df):
+        from ..parallel import mesh as meshlib
+        if meshlib.effective_process_count() > 1:
+            # real multi-process fleet: heartbeats + verdicts run (fast,
+            # clean failure instead of a hung collective), but an in-job
+            # re-mesh cannot outlive a jax.distributed member loss — the
+            # launcher relaunches smaller and consensus-resume continues
+            return self._fit_multiprocess(df)
+        ctx = ElasticStepContext(self)
+        for h in self.heartbeats.values():
+            h.start()
+        self.supervisor.start()
+        failures = 0
+        try:
+            while True:
+                pool = self._pool()
+                self.attempts.append({"hosts": sorted(self._mesh_hosts),
+                                      "devices": len(pool)})
+                try:
+                    with telemetry.trace.span("elastic/attempt",
+                                              hosts=len(self._mesh_hosts),
+                                              devices=len(pool)):
+                        return self.learner._fit_core(df, devices=pool,
+                                                      elastic_ctx=ctx)
+                except HostLossError as e:
+                    self._pending_recovery_t0 = time.monotonic()
+                    self._remesh(e.hosts)
+                except Exception as e:
+                    if not default_transient(e):
+                        raise
+                    # transient exhaustion with no verdict yet: force a
+                    # probe pass — the failure may BE the dying host
+                    self._pending_recovery_t0 = time.monotonic()
+                    self.supervisor.tick()
+                    dead = self.dead_mesh_hosts()
+                    if dead:
+                        self._remesh(dead, cause=e)
+                    else:
+                        failures += 1
+                        _m_attempt_failures.inc()
+                        if failures >= self.max_failures:
+                            raise ElasticFleetLost(
+                                f"elastic fit failed {failures} times "
+                                f"without a host verdict; last error: "
+                                f"{e!r}") from e
+                        log.warning(
+                            "elastic fit attempt failed transiently (%r); "
+                            "retrying from the latest checkpoint on the "
+                            "same mesh (%d/%d)", e, failures,
+                            self.max_failures)
+        finally:
+            self.supervisor.stop()
+            for h in self.heartbeats.values():
+                h.stop()
+
+    def _remesh(self, dead_hosts, cause=None):
+        faults.inject("elastic.remesh")
+        if self.supervisor.decision() == "restart":
+            raise ElasticFleetLost(
+                f"{len(self.supervisor.alive_hosts())} host(s) alive < "
+                f"min_hosts ({self.min_hosts}); relaunch the fleet against "
+                f"checkpointDir {self.learner.getCheckpointDir()!r} to "
+                f"resume from the last committed step")
+        _m_remeshes.inc()
+        telemetry.trace.instant("elastic/remesh",
+                                dead=",".join(sorted(dead_hosts)),
+                                alive=len(self.supervisor.alive_hosts()))
+        telemetry.flight.note("elastic/remesh", dead=sorted(dead_hosts))
+        log.warning(
+            "re-meshing after loss of %s: %d host(s) remain; resuming "
+            "from the consensus checkpoint%s", sorted(dead_hosts),
+            len(self.supervisor.alive_hosts()),
+            f" (trigger: {cause!r})" if cause is not None else "")
+
+    def _fit_multiprocess(self, df):
+        import jax
+        host_id = f"host{jax.process_index()}"
+        hb = self.heartbeats.get(host_id)
+        ctx = ElasticStepContext(self)
+        self._mesh_hosts = set(self.groups)
+        if hb is not None:
+            hb.start()
+        self.supervisor.start()
+        try:
+            self.attempts.append({"hosts": sorted(self.groups),
+                                  "devices": len(jax.devices())})
+            return self.learner._fit_core(df, elastic_ctx=ctx)
+        finally:
+            self.supervisor.stop()
+            if hb is not None:
+                hb.stop()

@@ -31,7 +31,8 @@ are independent and the whole run is reproducible. Injection sites call
 Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``fleet.respond``, ``fleet.transform``, ``serving.transform``,
 ``http.request``, ``powerbi.post``, ``dataplane.put``,
-``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``.
+``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
+``supervisor.heartbeat``, ``elastic.step``, ``elastic.remesh``.
 """
 
 from __future__ import annotations
@@ -61,7 +62,8 @@ KINDS = ("error", "delay")
 SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
          "serving.transform", "http.request", "powerbi.post",
          "dataplane.put", "dataplane.allgather", "trainer.step",
-         "supervisor.probe")
+         "supervisor.probe", "supervisor.heartbeat", "elastic.step",
+         "elastic.remesh")
 
 
 class InjectedFault(ConnectionError):
