@@ -15,31 +15,54 @@ was measured at ~3x a train step on v5e (near-scalar for 1-byte rows), so
 shuffling is rotation+window-permutation instead — see ROOFLINE.md.
 """
 
-import glob
 import json
 import os
 import time
 
 import numpy as np
 
+#: bench output schema version (the ``--all`` document; the perf gate —
+#: ``python -m mmlspark_tpu.perf`` — parses this and the per-round
+#: harness records interchangeably)
+SCHEMA = "mmlspark-bench/v1"
+
+#: ``--baseline`` override: a BENCH/run JSON file or a directory holding
+#: the BENCH_r*.json trajectory (None = discover via mmlspark_tpu.perf)
+_BASELINE = None
+
 
 def _baseline_value(metric: str):
     """Most recent prior measurement of ``metric`` from the BENCH_r*.json
-    trajectory next to this script (None when no round has recorded it) —
-    lets every run print its ratio vs. the last round."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    best = None
-    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
-        try:
-            doc = json.loads(open(path).read())
-        except (OSError, ValueError):
-            continue
-        parsed = doc.get("parsed") or {}
-        if parsed.get("metric") == metric and parsed.get("value"):
-            key = int(doc.get("n", 0))
-            if best is None or key > best[0]:
-                best = (key, float(parsed["value"]))
-    return best[1] if best else None
+    trajectory (None when no round has recorded it) — every run prints
+    its ratio vs. the last round. Discovery is delegated to
+    ``mmlspark_tpu.perf.history``: the explicit ``--baseline`` file/dir
+    first, else the cwd and its parents, else the checkout this script
+    lives in (the harness cwd is NOT the repo root — the old
+    look-next-to-the-script glob never resolved there when the script
+    was staged elsewhere, which is why five rounds of BENCH history all
+    say ``vs_baseline: null``)."""
+    from mmlspark_tpu.perf import history as H
+    if _BASELINE and os.path.isfile(_BASELINE):
+        rec = H.load_record(_BASELINE)
+        m = rec["metrics"].get(metric)
+        return m["value"] if m else None
+    if _BASELINE:
+        d = _BASELINE
+    else:
+        d = H.find_history_dir(os.path.dirname(os.path.abspath(__file__)))
+    if not d:
+        return None
+    return H.latest_value(H.load_history(d), metric)
+
+
+def _with_baseline(result: dict) -> dict:
+    """Fill ``vs_baseline`` (value / last recorded round) in a metric
+    dict that doesn't already carry one."""
+    if result.get("vs_baseline") is None and result.get("value"):
+        base = _baseline_value(result["metric"])
+        if base:
+            result["vs_baseline"] = round(result["value"] / base, 3)
+    return result
 
 
 def main(profile: bool = False):
@@ -114,15 +137,13 @@ def main(profile: bool = False):
 
     # the batch shards over every attached chip -> divide for per-chip
     imgs_per_sec = n_dispatch * k_steps * batch / dt / mesh.size
-    metric = "cifar10_resnet20_train_imgs_per_sec_per_chip"
-    base = _baseline_value(metric)
-    print(json.dumps({
-        "metric": metric,
+    result = _with_baseline({
+        "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/sec/chip",
-        "vs_baseline": (round(imgs_per_sec / base, 3)
-                        if base else None),
-    }))
+        "vs_baseline": None,
+    })
+    print(json.dumps(result))
     if profile:
         # the device-profile line: per-dispatch FLOPs/bytes, compile
         # count + seconds + causes, achieved FLOP/s vs roofline peak,
@@ -137,6 +158,7 @@ def main(profile: bool = False):
         path = telemetry_trace_path() or "bench_trace.jsonl"
         n_ev = telemetry.trace.export_chrome_trace(path)
         print(json.dumps({"trace_file": path, "events": n_ev}))
+    return result
 
 
 def chaos_train():
@@ -227,6 +249,235 @@ def chaos_train():
     }))
 
 
+def gbdt_scenario():
+    """GBDT fit + predict wall-clock (the engine's two hot paths). TPU
+    runs the bench_gbdt.py 1M-row shape; the CPU backend runs a smoke
+    scale that validates the pipeline, mirrors bench.py's own CPU
+    policy, and keeps ``--all`` runnable in CI."""
+    import jax
+    from mmlspark_tpu.models.gbdt import engine
+    from mmlspark_tpu.models.gbdt.engine import GBDTParams, fit_gbdt
+
+    if jax.default_backend() == "cpu":
+        n, d, iters, depth = 20_000, 16, 10, 4
+    else:
+        n, d, iters, depth = 1_000_000, 28, 100, 5
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logit = x[:, 0] * 2 + x[:, 1] - x[:, 2] * 0.5 + rng.normal(0, 0.5, n)
+    y = (logit > 0).astype(np.float32)
+    p = GBDTParams(num_iterations=iters, max_depth=depth,
+                   objective="binary")
+
+    def timed_fit():
+        t0 = time.perf_counter()
+        ens = fit_gbdt(x, y, p)
+        np.asarray(ens.leaf).sum()      # hard sync (async dispatch)
+        return time.perf_counter() - t0, ens
+
+    _cold, ens = timed_fit()            # compile pass
+    fit_s = min(timed_fit()[0] for _ in range(2))
+    np.asarray(engine.predict(ens, x)).sum()    # predict compile
+    t0 = time.perf_counter()
+    np.asarray(engine.predict(ens, x)).sum()
+    pred_s = time.perf_counter() - t0
+    cfg = f"{n} rows x {d} cols, {iters} iters, depth {depth}"
+    out = [_with_baseline({"metric": "gbdt_fit_seconds",
+                           "value": round(fit_s, 3), "unit": "s",
+                           "vs_baseline": None, "config": cfg}),
+           _with_baseline({"metric": "gbdt_predict_seconds",
+                           "value": round(pred_s, 3), "unit": "s",
+                           "vs_baseline": None, "config": cfg})]
+    for r in out:
+        print(json.dumps(r))
+    return out
+
+
+def serving_scenario():
+    """Closed-loop serving latency/throughput through the real HTTP ->
+    micro-batching -> pjit path (``serve_pipeline``): N threaded clients
+    each posting back-to-back. bench_serving.py remains the deep serving
+    bench (load levels, chaos, tracing); this is the always-on number
+    the perf gate tracks."""
+    import base64
+    import threading
+    import urllib.request
+
+    import jax
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.io.http import serve_pipeline
+    from mmlspark_tpu.models import TpuModel, build_model
+
+    if jax.default_backend() == "cpu":
+        dim, hidden, clients, per_client = 64, [32], 4, 12
+    else:
+        dim, hidden, clients, per_client = 3072, [256, 128], 16, 25
+    cfg = {"type": "mlp", "hidden": hidden, "num_classes": 10}
+    module = build_model(cfg)
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, dim), np.float32))
+    model = (TpuModel().setModelConfig(cfg).setModelParams(params)
+             .setInputCol("features"))
+    model.warmup(DataFrame({"features": object_column(
+        [np.zeros(dim, np.float32)])}), max_rows=64)
+
+    class _Scorer:
+        def prepare(self, df):
+            feats = [np.frombuffer(base64.b64decode(v), dtype=np.float32)
+                     for v in df.col("value")]
+            return df.withColumn("features", object_column(feats))
+
+        def transform(self, df):
+            scored = model.transform(df)
+            replies = [json.dumps({"label": int(np.argmax(s))})
+                       for s in scored.col("scores")]
+            return scored.withColumn("reply", object_column(replies))
+
+    rng = np.random.default_rng(0)
+    payload = base64.b64encode(
+        rng.normal(size=dim).astype(np.float32).tobytes())
+    scorer = _Scorer()
+    source, loop = serve_pipeline(scorer, max_batch=64,
+                                  prepare=scorer.prepare)
+
+    def post(timeout=60.0):
+        req = urllib.request.Request(source.url, data=payload)
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            assert r.status == 200, r.status
+            r.read()
+
+    try:
+        post(timeout=120)               # warmup: no request pays compile
+        lat: list = []
+        failures: list = []
+        lock = threading.Lock()
+
+        def client():
+            mine, bad = [], []
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                try:
+                    post(timeout=30.0)
+                    mine.append(time.perf_counter() - t0)
+                except Exception as e:
+                    bad.append(repr(e))
+            with lock:
+                lat.extend(mine)
+                failures.extend(bad)
+
+        threads = [threading.Thread(target=client)
+                   for _ in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        if failures:    # never print numbers over a shrunken sample
+            raise RuntimeError(f"{len(failures)} failed requests, "
+                               f"e.g. {failures[0]}")
+        lat_ms = np.sort(np.array(lat)) * 1e3
+        conf = (f"mlp{hidden} dim {dim}, {clients} clients x "
+                f"{per_client} reqs")
+        out = [_with_baseline({
+                   "metric": "serving_closed_loop_p99_ms",
+                   "value": round(float(np.percentile(lat_ms, 99)), 2),
+                   "unit": "ms", "vs_baseline": None,
+                   "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                   "config": conf}),
+               _with_baseline({
+                   "metric": "serving_closed_loop_rps",
+                   "value": round(len(lat) / wall, 1),
+                   "unit": "req/sec", "vs_baseline": None,
+                   "config": conf})]
+        for r in out:
+            print(json.dumps(r))
+        return out
+    finally:
+        loop.stop()
+        source.close()
+
+
+def loader_scenario():
+    """Data-ingest throughput: disk -> threaded JPEG decode/resize ->
+    staging -> device (the bench_loader.py pipeline at suite scale).
+    Skipped (not failed) when OpenCV is absent — the loader's decode
+    path requires it."""
+    import tempfile
+
+    import cv2                          # noqa: F401  (corpus writer)
+    import jax
+    from mmlspark_tpu.io.loader import device_image_batches
+    from mmlspark_tpu.native import available
+
+    n_images, batch = ((128, 32) if jax.default_backend() == "cpu"
+                       else (1024, 128))
+    src_hw, out_hw = (256, 256), (224, 224)
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = []
+        for i in range(n_images):
+            img = rng.integers(0, 256, (*src_hw, 3), dtype=np.uint8)
+            p = os.path.join(tmp, f"img_{i:05d}.jpg")
+            cv2.imwrite(p, img)
+            paths.append(p)
+        warm = None
+        for warm, _, _ in device_image_batches(paths[:batch], batch,
+                                               *out_hw):
+            pass
+        if warm is not None:
+            np.asarray(warm)
+        t0 = time.perf_counter()
+        total, last = 0, None
+        for dev_batch, ok, count in device_image_batches(paths, batch,
+                                                         *out_hw):
+            total += int(ok[:count].sum())
+            last = dev_batch
+        _ = np.asarray(last)            # the final transfer must land
+        dt = time.perf_counter() - t0
+    out = [_with_baseline({
+        "metric": "loader_jpeg_to_device_imgs_per_sec",
+        "value": round(total / dt, 1), "unit": "imgs/sec",
+        "vs_baseline": None, "native_decoder": available(),
+        "config": f"{n_images} x {src_hw[0]}px jpeg -> {out_hw[0]}px, "
+                  f"batch {batch}"})]
+    print(json.dumps(out[0]))
+    return out
+
+
+def suite(profile: bool = False):
+    """``--all``: every scenario, one versioned schema document (the
+    last printed line; the perf gate's input). A scenario whose optional
+    dependency is missing is recorded as skipped, not failed — CI boxes
+    without OpenCV still gate the other hot paths."""
+    import jax
+
+    scenarios = (("train", lambda: [main(profile=profile)]),
+                 ("gbdt", gbdt_scenario),
+                 ("serving", serving_scenario),
+                 ("loader", loader_scenario))
+    scen_out: dict = {}
+    metrics: list = []
+    for name, fn in scenarios:
+        t0 = time.perf_counter()
+        try:
+            results = fn()
+        except ImportError as e:
+            scen_out[name] = {"skipped": f"missing dependency: {e}"}
+            continue
+        scen_out[name] = {"wall_s": round(time.perf_counter() - t0, 2),
+                          "metrics": [r["metric"] for r in results]}
+        metrics.extend(results)
+    doc = {"schema": SCHEMA,
+           "backend": jax.default_backend(),
+           "chips": jax.device_count(),
+           "scenarios": scen_out,
+           "metrics": metrics}
+    print(json.dumps(doc))
+    return doc
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -239,8 +490,22 @@ if __name__ == "__main__":
                          "simulated host mid-fit under 10%% step faults; "
                          "reports steps/sec + recovery seconds "
                          "(docs/reliability.md, elastic training)")
+    ap.add_argument("--all", action="store_true",
+                    help="multi-scenario suite (train, GBDT fit/predict, "
+                         "serving closed-loop, loader); the last line is "
+                         "one mmlspark-bench/v1 JSON document the perf "
+                         "gate (python -m mmlspark_tpu.perf) checks "
+                         "against the BENCH_r*.json history")
+    ap.add_argument("--baseline", metavar="PATH", default=None,
+                    help="vs_baseline source: a BENCH/run JSON file or a "
+                         "directory holding BENCH_r*.json (default: "
+                         "search cwd + parents, then this checkout)")
     args = ap.parse_args()
+    if args.baseline:
+        _BASELINE = args.baseline
     if args.chaos_train:
         chaos_train()
+    elif args.all:
+        suite(profile=args.profile)
     else:
         main(profile=args.profile)

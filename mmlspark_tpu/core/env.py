@@ -42,6 +42,24 @@ def flight_path() -> Optional[str]:
     return v
 
 
+def timeseries_interval() -> Optional[float]:
+    """MMLSPARK_TPU_TIMESERIES: arm the time-series sampler
+    (telemetry.timeseries) at import. ``=1``/``true`` samples every
+    second; a float value (``=0.25``) is the tick interval in seconds.
+    Returns None (disarmed) or the interval. Arming also enables
+    telemetry."""
+    v = os.environ.get("MMLSPARK_TPU_TIMESERIES", "").strip()
+    if not v or v.lower() in ("0", "false", "no", "off"):
+        return None
+    if v.lower() in ("1", "true", "yes", "on"):
+        return 1.0
+    try:
+        iv = float(v)
+    except ValueError:
+        return 1.0
+    return iv if iv > 0 else None
+
+
 def fault_spec() -> Optional[str]:
     """MMLSPARK_TPU_FAULTS="site:kind:rate[:arg];...": arm the seeded
     fault-injection registry (mmlspark_tpu.resilience.faults) at import.

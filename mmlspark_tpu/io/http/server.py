@@ -110,11 +110,15 @@ class HTTPSource:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  api_path: str = "/", name: str = "source",
-                 max_port_probes: int = 20, max_queue_depth: int = 0):
+                 max_port_probes: int = 20, max_queue_depth: int = 0,
+                 slo=None):
         self._pending: "queue.Queue[_Exchange]" = queue.Queue()
         self._inflight: dict[str, _Exchange] = {}
         self._lock = threading.Lock()
         self.max_queue_depth = max_queue_depth
+        # optional telemetry.slo.SLOEngine: its breach state rides
+        # /healthz and (for shed_on_breach objectives) gates admission
+        self.slo = slo
         self._t0 = time.monotonic()
         # live requests awaiting batch pickup. NOT _pending.qsize(): a
         # timed-out client's exchange lingers in the queue until a later
@@ -137,24 +141,30 @@ class HTTPSource:
                 if telemetry.enabled():
                     ctx = (telemetry.context.from_headers(self.headers)
                            or telemetry.context.new_trace())
+                shed = False
                 if source.max_queue_depth:
                     with source._lock:
                         shed = source._n_pending >= source.max_queue_depth
-                    if shed:
-                        _m_shed.inc()
-                        _m_replies.labels(code="503").inc()
-                        with telemetry.context.use(ctx):
-                            telemetry.trace.instant(
-                                "http/shed", depth=source.max_queue_depth)
-                        payload = b'{"error": "overloaded, retry later"}'
-                        self.send_response(503)
-                        self.send_header("Retry-After", "1")
-                        self.send_header("Content-Type", "application/json")
-                        self.send_header("Content-Length",
-                                         str(len(payload)))
-                        self.end_headers()
-                        self.wfile.write(payload)
-                        return
+                if not shed and source.slo is not None:
+                    # SLO-driven admission control: while a shed_on_breach
+                    # objective's error budget burns in both windows, a
+                    # fast 503 beats queueing work the budget can't afford
+                    shed = source.slo.should_shed()
+                if shed:
+                    _m_shed.inc()
+                    _m_replies.labels(code="503").inc()
+                    with telemetry.context.use(ctx):
+                        telemetry.trace.instant(
+                            "http/shed", depth=source.max_queue_depth)
+                    payload = b'{"error": "overloaded, retry later"}'
+                    self.send_response(503)
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 t0 = time.perf_counter()
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length).decode("utf-8")
@@ -225,6 +235,16 @@ class HTTPSource:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
+                elif self.path == "/timeseries":
+                    # the sampler's ring buffers as JSON: recent history
+                    # of every metric series, not just the last scrape
+                    payload = json.dumps(
+                        telemetry.timeseries.snapshot()).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
                 else:
                     self.send_error(404)
 
@@ -249,11 +269,17 @@ class HTTPSource:
         process."""
         with self._lock:
             depth = self._n_pending
-        return {"ok": True,
-                "uptime_s": round(time.monotonic() - self._t0, 3),
-                "queue_depth": depth,
-                "max_queue_depth": self.max_queue_depth,
-                "breakers": CircuitBreaker.snapshot_all()}
+        out = {"ok": True,
+               "uptime_s": round(time.monotonic() - self._t0, 3),
+               "queue_depth": depth,
+               "max_queue_depth": self.max_queue_depth,
+               "breakers": CircuitBreaker.snapshot_all()}
+        if self.slo is not None:
+            # the SLO engine's verdicts ride the same probe surface: a
+            # supervisor (or k8s) sees budget burn without a new endpoint
+            out["slo"] = self.slo.healthz()
+            out["ok"] = out["ok"] and out["slo"]["ok"]
+        return out
 
     def getBatch(self, max_rows: int = 1024,
                  timeout: float = 0.05) -> DataFrame:
@@ -419,12 +445,13 @@ class ServingLoop:
 
 def serve_pipeline(transformer, host: str = "127.0.0.1", port: int = 0,
                    max_batch: int = 1024, prefetch_depth: int = 2,
-                   prepare=None,
-                   max_queue_depth: int = 0) -> tuple[HTTPSource,
-                                                      ServingLoop]:
-    """Convenience: spin up source + loop for a fitted transformer."""
+                   prepare=None, max_queue_depth: int = 0,
+                   slo=None) -> tuple[HTTPSource, ServingLoop]:
+    """Convenience: spin up source + loop for a fitted transformer.
+    ``slo`` (a ``telemetry.slo.SLOEngine``) surfaces objective state on
+    ``/healthz`` and lets ``shed_on_breach`` objectives gate admission."""
     source = HTTPSource(host=host, port=port,
-                        max_queue_depth=max_queue_depth)
+                        max_queue_depth=max_queue_depth, slo=slo)
     loop = ServingLoop(source, transformer, max_batch,
                        prefetch_depth=prefetch_depth,
                        prepare=prepare).start()

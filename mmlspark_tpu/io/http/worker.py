@@ -91,6 +91,12 @@ class WorkerServer:
                     self._json(200, {"events": telemetry.trace.events(),
                                      "dropped": telemetry.trace.dropped(),
                                      "pid": worker_pid})
+                elif self.path == "/timeseries":
+                    # the worker's sampler rings: per-process metric
+                    # history over the control plane (same payload as the
+                    # public port's /timeseries on the serving server)
+                    from ... import telemetry
+                    self._json(200, telemetry.timeseries.snapshot())
                 elif self.path == "/debug/flight":
                     from ... import telemetry
                     self._json(200,

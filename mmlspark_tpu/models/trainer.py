@@ -326,14 +326,23 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
                      step_body=None):
     """One jitted optimizer step (fitStream / multi-host feed path).
 
-    The batch buffers (xb, yb) are DONATED: the feed path uploads a fresh
-    batch every step and never reads it back, so XLA reuses their HBM for
-    the step's outputs instead of allocating alongside. The weight mask wb
-    is NOT donated — the feed path caches one placed mask per (rows,
-    n_real) signature and reuses it across steps."""
+    The batch buffers (xb, yb) are DONATED on accelerator backends: the
+    feed path uploads a fresh batch every step and never reads it back, so
+    XLA reuses their HBM for the step's outputs instead of allocating
+    alongside. The weight mask wb is NOT donated — the feed path caches one
+    placed mask per (rows, n_real) signature and reuses it across steps.
+
+    On the CPU backend the donation is DISABLED: ``device_put`` there can
+    alias the host numpy buffer zero-copy, and donating an aliased buffer
+    hands memory the host allocator still owns back to XLA as scratch —
+    the step outputs land in pages numpy reuses for later allocations, and
+    training corrupts nondeterministically (losses exploding to ~1e35 on
+    a fitStream that is bit-identical to fit() with donation off). Host
+    memory is not the scarce resource on CPU, so nothing is lost."""
+    donate = () if jax.default_backend() == "cpu" else (2, 3)
     return jax.jit(step_body or
                    _make_step_body(module, tx, loss_fn, is_moe, moe_aux),
-                   donate_argnums=(2, 3))
+                   donate_argnums=donate)
 
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
@@ -485,6 +494,16 @@ class TpuLearner(Estimator):
         "transient fit failures tolerated WITHOUT a host verdict before "
         "the elastic loop gives up (failures attributed to a dead host "
         "re-mesh instead and do not burn this budget)", default=5, min=1)
+    sloConfig = DictParam(
+        "declarative SLO config evaluated DURING this fit "
+        "(telemetry.slo): either a full {'objectives': [...], "
+        "'interval': s} document, or the {'stepTimeBudget': seconds, "
+        "'windows': [fast_s, slow_s]} shorthand for a mean-step-time "
+        "objective over mmlspark_trainer_step_seconds. Enables telemetry "
+        "+ the time-series sampler for the fit; breaches surface as "
+        "slo/breach trace instants, flight-recorder notes and the "
+        "mmlspark_slo_* gauges, and the final per-objective state lands "
+        "on the learner as _last_slo_report", default=None)
 
     # ---- checkpointing (reference has none; SURVEY.md §5) ----
     # Two granularities: ``ckpt_EEEEE.msgpack`` marks epoch E COMPLETE;
@@ -606,15 +625,71 @@ class TpuLearner(Estimator):
         return params, opt_state, epoch, step + 1, resume
 
     # ---- training ----
+    def _slo_session(self):
+        """Fit-scoped SLO evaluation (the ``sloConfig`` param): a private
+        time-series sampler + SLOEngine run for the duration of the fit
+        and the final per-objective verdicts land on
+        ``self._last_slo_report``. Returns a context manager yielding the
+        engine (or None when the param is unset)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def session():
+            cfg = self.getSloConfig()
+            if not cfg:
+                yield None
+                return
+            from ..telemetry.slo import SLOEngine
+            from ..telemetry.timeseries import TimeSeriesSampler
+            cfg = dict(cfg)
+            if "objectives" not in cfg:
+                # shorthand: a mean-step-time budget over the trainer's
+                # step histogram
+                budget = float(cfg.get("stepTimeBudget", 0) or 0)
+                if budget <= 0:
+                    raise ValueError(
+                        "sloConfig needs an 'objectives' list or a "
+                        "positive 'stepTimeBudget'")
+                cfg = {"objectives": [{
+                    "name": "fit-step-time", "kind": "step_time",
+                    "hist": "mmlspark_trainer_step_seconds",
+                    "budget_s": budget,
+                    "windows": cfg.get("windows", [5.0, 30.0]),
+                    "burn_threshold": cfg.get("burnThreshold", 1.0)}],
+                    "interval": cfg.get("interval", 0.25)}
+            interval = float(cfg.get("interval") or 0.25)
+            sampler = TimeSeriesSampler(interval=interval)
+            engine = SLOEngine.from_config(cfg, sampler=sampler)
+            sampler.start(interval)   # also enables telemetry
+            engine.start()
+            try:
+                yield engine
+            finally:
+                engine.stop()
+                sampler.stop()
+                sampler.tick()        # final sample + verdict pass
+                final = engine.evaluate()
+                breached = sorted(engine.breached_ever())
+                self._last_slo_report = {"objectives": final,
+                                         "breached": breached}
+                if breached:
+                    telemetry.flight.note("slo/fit_summary",
+                                          breached=",".join(breached))
+                    log.warning("fit SLO summary: objective(s) %s "
+                                "breached their budget", breached)
+
+        return session()
+
     def fit(self, df: DataFrame) -> TpuModel:
-        if self.getElastic():
-            from ..resilience.elastic import ElasticFitCoordinator
-            return ElasticFitCoordinator(
-                self, n_hosts=self.getElasticHosts(),
-                min_hosts=self.getElasticMinHosts(),
-                grace=self.getElasticGraceSeconds() or None,
-                max_failures=self.getElasticMaxFailures()).fit(df)
-        return self._fit_core(df)
+        with self._slo_session():
+            if self.getElastic():
+                from ..resilience.elastic import ElasticFitCoordinator
+                return ElasticFitCoordinator(
+                    self, n_hosts=self.getElasticHosts(),
+                    min_hosts=self.getElasticMinHosts(),
+                    grace=self.getElasticGraceSeconds() or None,
+                    max_failures=self.getElasticMaxFailures()).fit(df)
+            return self._fit_core(df)
 
     def _fit_core(self, df: DataFrame, devices=None,
                   elastic_ctx=None) -> TpuModel:
@@ -852,6 +927,10 @@ class TpuLearner(Estimator):
         exhausted streams contribute zero-weight dummy batches until the
         longest stream drains — unequal shard sizes never deadlock.
         """
+        with self._slo_session():
+            return self._fit_stream_core(batches_fn)
+
+    def _fit_stream_core(self, batches_fn) -> TpuModel:
         cfg = dict(self.getModelConfig())
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
                 or self.getPipelineParallel() > 1):

@@ -1,0 +1,34 @@
+"""Perf gate: statistical regression checking over the BENCH_r* history.
+
+The repo measures speed (``bench.py`` and friends) and records it
+(``BENCH_r*.json`` rounds at the repo root), but until this package
+nothing *defended* it — a PR that halved throughput still merged green.
+``python -m mmlspark_tpu.perf --check <run.json>`` is the
+``graftlint``-style gate that closes the loop:
+
+  * :mod:`.history` discovers and parses the bench trajectory — both the
+    harness round records (``{"n": .., "parsed": {...}}``) and the
+    multi-scenario ``mmlspark-bench/v1`` schema ``bench.py --all``
+    emits — searching the explicit ``--history`` dir, then the current
+    directory and its parents, then the checkout the package lives in
+    (the fix for the long-standing ``vs_baseline: null``: the harness
+    cwd is not the repo root);
+  * :mod:`.gate` compares each metric in a run against the
+    **median-of-N** of its history with a noise band of
+    ``max(min_rel · median, k · 1.4826 · MAD)`` — a 2% wobble on a noisy
+    series passes, a 20% cliff on a stable one fails — with the
+    regression direction derived from the unit (``s``/``ms`` regress
+    upward, throughput regresses downward);
+  * :mod:`.cli` exits nonzero naming the metric and the delta, so CI
+    fails the run that lands the slowdown, not a retrospective.
+
+Console script ``mmlspark-tpu-perf``; wrapper ``tools/bin/perfgate``.
+"""
+
+from .gate import GateReport, check_run, lower_is_better, mad, median
+from .history import (SCHEMA, find_history_dir, latest_value, load_history,
+                      load_record, metric_series)
+
+__all__ = ["check_run", "GateReport", "lower_is_better", "median", "mad",
+           "find_history_dir", "load_history", "load_record",
+           "metric_series", "latest_value", "SCHEMA"]

@@ -1,0 +1,7 @@
+"""``python -m mmlspark_tpu.perf`` — the bench-regression gate CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,122 @@
+"""The statistical regression check: median-of-N history + MAD band.
+
+A naive ``value < last_round`` gate is wrong twice over: bench numbers
+wobble run to run (so it false-alarms on noise), and a slow drift can
+hide behind a lucky last round (so it misses real regressions). The
+gate here compares a run against the **median** of the metric's history
+and only fails when the delta clears a noise band sized from the
+history's own spread:
+
+    band = max(min_rel * |median|,  k_mad * 1.4826 * MAD)
+
+``1.4826 * MAD`` is the robust stand-in for one standard deviation
+(exact under normality, outlier-immune otherwise); ``min_rel`` floors
+the band so a perfectly-flat history doesn't fail on a 0.1% wobble.
+
+Direction is derived from the metric itself: units measured in
+seconds/milliseconds (and ``*_seconds`` / ``*_ms`` metric names)
+regress when they go UP, everything else (throughput, rates) regresses
+when it goes DOWN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: defaults: 5% floor, 3 robust sigmas
+DEFAULT_MIN_REL = 0.05
+DEFAULT_K_MAD = 3.0
+
+_MAD_TO_SIGMA = 1.4826
+
+
+def median(values) -> float:
+    s = sorted(values)
+    n = len(s)
+    if not n:
+        raise ValueError("median of empty series")
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def mad(values) -> float:
+    """Median absolute deviation around the median."""
+    m = median(values)
+    return median([abs(v - m) for v in values])
+
+
+def lower_is_better(metric: str, unit: str = "") -> bool:
+    """Regression direction from the metric's identity: time-like
+    metrics regress upward, throughput-like metrics regress downward."""
+    u = (unit or "").strip().lower()
+    if u == "s" or u.startswith(("s ", "s(", "s/", "sec", "ms")):
+        return True
+    m = metric.lower()
+    return m.endswith(("_seconds", "_ms", "_s", "_latency")) \
+        or "latency" in m
+
+
+class GateReport:
+    """Per-metric verdicts for one checked run."""
+
+    def __init__(self, entries: list, history_dir: Optional[str]):
+        self.entries = entries
+        self.history_dir = history_dir
+
+    @property
+    def regressions(self) -> list:
+        return [e for e in self.entries if e["status"] == "regression"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.regressions
+
+    def to_json(self) -> dict:
+        return {"ok": self.ok, "history_dir": self.history_dir,
+                "checked": len(self.entries),
+                "regressions": len(self.regressions),
+                "metrics": self.entries}
+
+
+def check_metric(metric: str, value: float, unit: str, series: list,
+                 min_rel: float = DEFAULT_MIN_REL,
+                 k_mad: float = DEFAULT_K_MAD) -> dict:
+    """One metric against its history series (oldest first)."""
+    if not series:
+        return {"metric": metric, "value": value, "status": "no-history",
+                "history_n": 0}
+    med = median(series)
+    band = max(min_rel * abs(med), k_mad * _MAD_TO_SIGMA * mad(series))
+    delta = value - med
+    rel = delta / med if med else (0.0 if not delta else float("inf"))
+    lower = lower_is_better(metric, unit)
+    if lower:
+        regressed = delta > band
+        improved = delta < -band
+    else:
+        regressed = delta < -band
+        improved = delta > band
+    return {"metric": metric, "value": value, "unit": unit,
+            "median": med, "band": band,
+            "delta": delta, "rel_delta": rel,
+            "history_n": len(series),
+            "direction": "lower-is-better" if lower
+            else "higher-is-better",
+            "status": ("regression" if regressed
+                       else "improvement" if improved else "ok")}
+
+
+def check_run(run: dict, history: list,
+              min_rel: float = DEFAULT_MIN_REL,
+              k_mad: float = DEFAULT_K_MAD,
+              history_dir: Optional[str] = None) -> GateReport:
+    """Every metric of a loaded run record (:func:`.history.load_record`)
+    against a loaded history (:func:`.history.load_history`)."""
+    from .history import metric_series
+    entries = []
+    for name in sorted(run["metrics"]):
+        m = run["metrics"][name]
+        entries.append(check_metric(
+            name, m["value"], m.get("unit", ""),
+            metric_series(history, name), min_rel=min_rel, k_mad=k_mad))
+    return GateReport(entries, history_dir)

@@ -88,6 +88,10 @@ _m_steps_replayed = telemetry.registry.counter(
     "mmlspark_elastic_steps_replayed_total",
     "committed-but-unchekpointed steps re-run after a resume (the work a "
     "smaller checkpointEverySteps would have saved)")
+_m_stragglers = telemetry.registry.counter(
+    "mmlspark_elastic_stragglers_total",
+    "hosts flagged anomalously slow by the rolling-MAD step-time "
+    "detector (each flag episode counts once)", labels=("host",))
 
 
 class HostLossError(RuntimeError):
@@ -214,7 +218,9 @@ class TrainSupervisor:
                  grace: Optional[float] = None,
                  min_hosts: int = 1,
                  probe: Optional[Callable] = None,
-                 probe_interval: Optional[float] = None):
+                 probe_interval: Optional[float] = None,
+                 anomaly_detector=None):
+        from ..telemetry.slo import StepTimeAnomalyDetector
         self.host_ids = list(host_ids)
         self.directory = directory
         self.grace = grace if grace is not None else _grace_default()
@@ -222,8 +228,17 @@ class TrainSupervisor:
         self._probe = probe or self._probe_file
         self.probe_interval = (probe_interval if probe_interval is not None
                                else max(0.05, self.grace / 4.0))
+        #: rolling-MAD step-time detector fed from heartbeat progress; a
+        #: STRAGGLER verdict (consistently slow, still beating) is advisory
+        #: — reported, never a death verdict (pass anomaly_detector=False
+        #: to disable, or inject a configured detector)
+        self.anomaly = (StepTimeAnomalyDetector()
+                        if anomaly_detector is None
+                        else (anomaly_detector or None))
         self._lock = threading.Lock()
         self._dead: set[str] = set()        # guarded-by: _lock
+        self._progress: dict[str, tuple] = {}    # guarded-by: _lock
+        self._flagged: set[str] = set()     # guarded-by: _lock
         self._started_at = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -241,9 +256,30 @@ class TrainSupervisor:
                                    f"hb_{host_id}.json"),
                       "r", encoding="utf-8") as f:
                 doc = json.load(f)
+            self._note_progress(host_id, doc)
             return max(0.0, time.time() - float(doc["time"]))
         except (OSError, ValueError, KeyError):
             return None
+
+    def _note_progress(self, host_id: str, doc: dict):
+        """Feed the anomaly detector from heartbeat progress: successive
+        probes of the same epoch yield (wall delta / steps advanced) — a
+        central seconds-per-step estimate that needs no new wire format."""
+        if self.anomaly is None:
+            return
+        try:
+            cur = (int(doc["epoch"]), int(doc["step"]), float(doc["time"]))
+        except (KeyError, TypeError, ValueError):
+            return
+        with self._lock:
+            prev = self._progress.get(host_id)
+            self._progress[host_id] = cur
+        if prev is None:
+            return
+        pe, ps, pt = prev
+        e, s, t = cur
+        if e == pe and s > ps and t > pt:
+            self.anomaly.observe(host_id, (t - pt) / (s - ps))
 
     def tick(self):
         """One verdict pass (public: deterministic tests drive it directly,
@@ -281,6 +317,37 @@ class TrainSupervisor:
                 "%d host(s) remain", host_id,
                 "missing" if age is None else f"{age:.2f}s old",
                 self.grace, alive)
+        self._straggler_pass()
+
+    def _straggler_pass(self):
+        """Advisory anomaly verdicts: flag hosts the rolling-MAD detector
+        calls stragglers (and unflag recovered ones so a relapse re-flags).
+        Flag bookkeeping is decided under the lock; the IO (metrics,
+        instants, flight notes, logs) happens after release."""
+        if self.anomaly is None:
+            return
+        current = self.anomaly.stragglers()
+        with self._lock:
+            current -= self._dead
+            newly = current - self._flagged
+            self._flagged = current
+        med = self.anomaly.host_medians() if newly else {}
+        for host_id in sorted(newly):
+            _m_stragglers.labels(host=host_id).inc()
+            telemetry.trace.instant("elastic/straggler", host=host_id,
+                                    median_s=med.get(host_id))
+            telemetry.flight.note("elastic/straggler", host=host_id,
+                                  median_s=med.get(host_id))
+            log.warning("host %s flagged as STRAGGLER (median step "
+                        "%.4fs vs fleet %s); still alive — advisory only",
+                        host_id, med.get(host_id, float("nan")),
+                        {h: round(v, 4) for h, v in med.items()})
+
+    def straggler_hosts(self) -> set[str]:
+        """Hosts currently flagged anomalously slow (advisory — they are
+        alive and beating, just burning the step-time budget)."""
+        with self._lock:
+            return set(self._flagged)
 
     def dead_hosts(self) -> set[str]:
         with self._lock:

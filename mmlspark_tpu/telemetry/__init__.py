@@ -32,17 +32,21 @@ from .registry import (DEFAULT_TIME_BUCKETS, REGISTRY, Counter, Gauge,
 from .tracer import TRACER, Tracer, merge_traces
 from . import context
 from . import profiler
+from . import slo
 from .flight import FLIGHT
+from .timeseries import SAMPLER, TimeSeriesSampler
 
 #: process-global singletons — the module-level API
 registry = REGISTRY
 trace = TRACER
 flight = FLIGHT
+timeseries = SAMPLER
 
 __all__ = ["registry", "trace", "enabled", "enable", "disable",
            "snapshot", "prometheus_text", "warn_once", "merge_traces",
-           "context", "profiler", "flight",
+           "context", "profiler", "flight", "timeseries", "slo",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
+           "TimeSeriesSampler",
            "DEFAULT_TIME_BUCKETS", "pow2_buckets"]
 
 
@@ -85,9 +89,14 @@ def warn_once(logger, key: str, msg: str, *args):
 
 def _init_from_env():
     from ..core.env import (flight_path, telemetry_enabled,
-                            telemetry_trace_path)
+                            telemetry_trace_path, timeseries_interval)
     if telemetry_enabled():
         enable()
+    ts = timeseries_interval()
+    if ts is not None:
+        # arming the sampler also enables telemetry (a sampler over a
+        # disabled registry records nothing)
+        SAMPLER.start(interval=ts)
     path = telemetry_trace_path()
     if path:
         import atexit

@@ -122,10 +122,12 @@ class FlightRecorder:
         span tracing is quiet)."""
         if not self._enabled:
             return
-        self._append({"kind": "note", "t": time.time(), "name": name,
-                      **{k: (v if isinstance(v, (int, float, str, bool,
+        # reserved fields win: a caller kwarg named "kind"/"t"/"name"
+        # must not reshape the ring entry itself
+        self._append({**{k: (v if isinstance(v, (int, float, str, bool,
                                                  type(None))) else str(v))
-                         for k, v in data.items()}})
+                         for k, v in data.items()},
+                      "kind": "note", "t": time.time(), "name": name})
         self._maybe_sample_metrics()
 
     def _maybe_sample_metrics(self):
