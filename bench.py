@@ -65,7 +65,7 @@ def _with_baseline(result: dict) -> dict:
     return result
 
 
-def main(profile: bool = False):
+def main(profile: bool = False, mixed: bool = False):
     import jax
     import optax
     from mmlspark_tpu import telemetry
@@ -102,9 +102,23 @@ def main(profile: bool = False):
     params = meshlib.put_replicated(params, mesh)
     opt_state = jax.jit(tx.init)(params)
     loss_fn = make_loss("cross_entropy", per_example=True)
+    # ``mixed`` = the train_bf16 scenario: the fused loss-scaling step
+    # (models/precision.py) with (params, opt_state, scale_state)
+    # donated — the roofline twin of the default bf16-compute run
+    scale_state = None
+    raw_scan = _make_scan_epoch_fn(module, tx, loss_fn, False, 0.0, mesh,
+                                   batch, mixed=mixed)
+    if mixed:
+        from mmlspark_tpu.models.precision import init_scale_state
+        scale_state = init_scale_state()
     scan_fn = telemetry.profiler.wrap(
-        _make_scan_epoch_fn(module, tx, loss_fn, False, 0.0, mesh, batch),
-        "bench.scan_epoch")
+        raw_scan, "bench.scan_epoch_bf16" if mixed else "bench.scan_epoch")
+
+    def run_scan(p, o, s, starts):
+        if s is None:
+            p, o, loss = scan_fn(p, o, x_dev, y_dev, w_dev, starts)
+            return p, o, None, loss
+        return scan_fn(p, o, s, x_dev, y_dev, w_dev, starts)
 
     margin = lambda a: np.concatenate([a, a[:batch]], axis=0)
     x_dev = meshlib.shard_batch(margin(x), mesh)
@@ -119,8 +133,8 @@ def main(profile: bool = False):
     # compile + warmup. NOTE: on the axon TPU tunnel block_until_ready()
     # returns before the chain actually executes — a host-side value fetch
     # (float()) is the only hard sync, so that is what brackets the timing.
-    params, opt_state, loss = scan_fn(params, opt_state, x_dev, y_dev,
-                                      w_dev, plan(1))
+    params, opt_state, scale_state, loss = run_scan(params, opt_state,
+                                                    scale_state, plan(1))
     float(loss)
 
     t0 = time.perf_counter()
@@ -128,8 +142,8 @@ def main(profile: bool = False):
         for d in range(n_dispatch):
             with telemetry.trace.span("fit/step", dispatch=d,
                                       steps=k_steps) as sp:
-                params, opt_state, loss = scan_fn(params, opt_state, x_dev,
-                                                  y_dev, w_dev, plan(2 + d))
+                params, opt_state, scale_state, loss = run_scan(
+                    params, opt_state, scale_state, plan(2 + d))
                 sp.set_sync(loss)
         fsp.set_sync(loss)
     float(loss)  # hard sync: forces the whole chain to complete
@@ -138,7 +152,8 @@ def main(profile: bool = False):
     # the batch shards over every attached chip -> divide for per-chip
     imgs_per_sec = n_dispatch * k_steps * batch / dt / mesh.size
     result = _with_baseline({
-        "metric": "cifar10_resnet20_train_imgs_per_sec_per_chip",
+        "metric": ("train_bf16_imgs_per_sec_per_chip" if mixed else
+                   "cifar10_resnet20_train_imgs_per_sec_per_chip"),
         "value": round(imgs_per_sec, 1),
         "unit": "imgs/sec/chip",
         "vs_baseline": None,
@@ -290,6 +305,50 @@ def gbdt_scenario():
                            "vs_baseline": None, "config": cfg})]
     for r in out:
         print(json.dumps(r))
+    return out
+
+
+def gbdt_predict_quant_scenario():
+    """Quantized ensemble predict (``predict_impl='pallas'``): SoA
+    uint8/bf16 test tables walked by the tile-resident kernel
+    (ops/pallas_kernels.py). On CPU the kernel runs in interpret mode —
+    the number validates the path and parity, not speed; the TPU round
+    is where the metric earns its keep against ``gbdt_predict_seconds``."""
+    import jax
+    from mmlspark_tpu.models.gbdt import engine
+    from mmlspark_tpu.models.gbdt.engine import GBDTParams, fit_gbdt
+
+    if jax.default_backend() == "cpu":
+        # 30 iters, not the gbdt scenario's 10: the ≤1e-3 parity bound
+        # is on summed raw scores, and a 10-tree sum is small enough
+        # that the per-leaf bf16 rounding (≤ 2^-9 relative) doesn't
+        # wash out against it — the committed test configs
+        # (tests/test_gbdt.py TestQuantizedPredict) set the bar
+        n, d, iters, depth = 8_000, 12, 30, 5
+    else:
+        n, d, iters, depth = 1_000_000, 28, 100, 5
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    logit = x[:, 0] * 2 + x[:, 1] - x[:, 2] * 0.5 + rng.normal(0, 0.5, n)
+    y = (logit > 0).astype(np.float32)
+    ens = fit_gbdt(x, y, GBDTParams(num_iterations=iters, max_depth=depth,
+                                    objective="binary"))
+    dense = engine.predict_raw(ens, x, predict_impl="dense")
+    np.asarray(engine.predict_raw(ens, x, predict_impl="pallas")).sum()
+    t0 = time.perf_counter()
+    quant = engine.predict_raw(ens, x, predict_impl="pallas")
+    np.asarray(quant).sum()
+    quant_s = time.perf_counter() - t0
+    # never publish a number for a path that lost parity
+    rel = float(np.abs(quant - dense).max() / np.abs(dense).max())
+    assert rel <= 1e-3, f"quantized predict parity broke: rel={rel}"
+    out = [_with_baseline({
+        "metric": "gbdt_predict_quant_seconds",
+        "value": round(quant_s, 3), "unit": "s", "vs_baseline": None,
+        "rel_err_vs_dense": round(rel, 6),
+        "config": f"{n} rows x {d} cols, {iters} iters, depth {depth}, "
+                  f"{'interpret' if jax.default_backend() != 'tpu' else 'mosaic'}"})]
+    print(json.dumps(out[0]))
     return out
 
 
@@ -454,7 +513,10 @@ def suite(profile: bool = False):
     import jax
 
     scenarios = (("train", lambda: [main(profile=profile)]),
+                 ("train_bf16",
+                  lambda: [main(profile=profile, mixed=True)]),
                  ("gbdt", gbdt_scenario),
+                 ("gbdt_predict_quant", gbdt_predict_quant_scenario),
                  ("serving", serving_scenario),
                  ("loader", loader_scenario))
     scen_out: dict = {}
@@ -491,7 +553,8 @@ if __name__ == "__main__":
                          "reports steps/sec + recovery seconds "
                          "(docs/reliability.md, elastic training)")
     ap.add_argument("--all", action="store_true",
-                    help="multi-scenario suite (train, GBDT fit/predict, "
+                    help="multi-scenario suite (train, train_bf16 mixed-"
+                         "precision, GBDT fit/predict, quantized predict, "
                          "serving closed-loop, loader); the last line is "
                          "one mmlspark-bench/v1 JSON document the perf "
                          "gate (python -m mmlspark_tpu.perf) checks "

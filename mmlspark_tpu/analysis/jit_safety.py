@@ -457,6 +457,220 @@ _traced_rule("jit-nondeterministic-iter",
              "set-order iteration inside traced bodies")
 
 
+#: dtype tokens for the silent-upcast rule
+_BF16_CTORS = {"jnp.bfloat16", "jax.numpy.bfloat16"}
+_F32_CTORS = {"jnp.float32", "jnp.float64", "jax.numpy.float32",
+              "jax.numpy.float64", "np.float32", "np.float64",
+              "numpy.float32", "numpy.float64"}
+
+
+def _dtype_token(node) -> Optional[str]:
+    """'bf16' / 'f32' when ``node`` names a dtype (attribute or string
+    literal), else None."""
+    dn = dotted(node)
+    if dn in _BF16_CTORS or dn == "bfloat16":
+        return "bf16"
+    if dn in _F32_CTORS or dn in ("float32", "float64"):
+        return "f32"
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value == "bfloat16":
+            return "bf16"
+        if node.value in ("float32", "float64"):
+            return "f32"
+    return None
+
+
+def _is_bf16_cast(node) -> bool:
+    """``x.astype(jnp.bfloat16)`` / ``jnp.bfloat16(x)`` — the explicit
+    downcasts that start bf16 provenance."""
+    if not isinstance(node, ast.Call):
+        return False
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args:
+        return _dtype_token(node.args[0]) == "bf16"
+    return dotted(node.func) in _BF16_CTORS and bool(node.args)
+
+
+def _has_precision_comment(sf: SourceFile, line: int) -> bool:
+    """The rule's escape hatch: a comment mentioning 'precision' on the
+    node's line (or the line above — long expressions wrap) declares the
+    upcast deliberate, e.g. ``# precision: f32 accumulation``."""
+    for ln in (line, line - 1):
+        if "precision" in sf.comments.get(ln, "").lower():
+            return True
+    return False
+
+
+class _Bf16Taint:
+    """Lexical bf16 provenance over one traced body: names whose value
+    came from an explicit bfloat16 cast (directly or through jnp ops,
+    which preserve dtype)."""
+
+    def __init__(self):
+        self.names: set = set()
+
+    def expr(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, ast.Call):
+            if _is_bf16_cast(node):
+                return True
+            fname = dotted(node.func)
+            if fname in _UNTAINT_CALLS:
+                return False
+            # an f32 cast ENDS the provenance (it is also where the rule
+            # fires); any other call fed a bf16 value is assumed to keep
+            # its dtype (jnp ops do)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "astype" \
+                    and node.args and _dtype_token(node.args[0]) == "f32":
+                return False
+            if fname in _F32_CTORS:
+                return False
+            return (any(self.expr(a) for a in node.args)
+                    or any(self.expr(k.value) for k in node.keywords))
+        return False
+
+    def assign(self, target, value_tainted: bool):
+        for t in (ast.walk(target) if not isinstance(target, ast.Name)
+                  else (target,)):
+            if isinstance(t, ast.Name):
+                if value_tainted:
+                    self.names.add(t.id)
+                else:
+                    self.names.discard(t.id)
+
+
+def _silent_upcast_findings(sf: SourceFile, td) -> Iterable[Finding]:
+    taint = _Bf16Taint()
+    body = (td.node.body if isinstance(td.node.body, list)
+            else [ast.Expr(td.node.body)])
+
+    def flag(node, what: str):
+        if _has_precision_comment(sf, getattr(node, "lineno", 1)):
+            return None
+        return sf.finding(
+            "jit-silent-upcast", node,
+            f"{what} promotes a bf16-typed value back to f32/f64 inside "
+            f"traced function `{td.qual}` — the compute silently leaves "
+            f"the bf16 fast path (2x the HBM traffic, off the full-rate "
+            f"MXU mode)",
+            hint="keep the chain in bf16, or declare the upcast with an "
+                 "explicit-precision comment (e.g. `# precision: f32 "
+                 "accumulation`) on the line",
+            context=td.qual)
+
+    def scan_expr(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                # x.astype(jnp.float32) on a bf16-provenance value
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "astype" and sub.args \
+                        and _dtype_token(sub.args[0]) == "f32" \
+                        and taint.expr(sub.func.value):
+                    f = flag(sub, "`.astype(float32/float64)`")
+                    if f:
+                        yield f
+                # jnp.float32(x) on a bf16-provenance value
+                elif dotted(sub.func) in _F32_CTORS and sub.args \
+                        and taint.expr(sub.args[0]):
+                    f = flag(sub, f"`{dotted(sub.func)}(...)`")
+                    if f:
+                        yield f
+            elif isinstance(sub, ast.BinOp):
+                # typed-literal promotion: bf16 op jnp.float32(2.0) —
+                # the f32-TYPED operand wins the promotion (a bare
+                # Python float literal is weakly typed and stays bf16,
+                # so it is NOT flagged)
+                for a, b in ((sub.left, sub.right), (sub.right, sub.left)):
+                    if taint.expr(a) and isinstance(b, ast.Call) \
+                            and dotted(b.func) in _F32_CTORS:
+                        f = flag(sub, "a binary op with an f32-typed "
+                                      "literal operand")
+                        if f:
+                            yield f
+                        break
+
+    def visit(stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                if getattr(st, "value", None) is not None:
+                    yield from scan_expr(st.value)
+                    t = taint.expr(st.value)
+                    targets = (st.targets if isinstance(st, ast.Assign)
+                               else [st.target])
+                    for target in targets:
+                        taint.assign(target, t)
+                continue
+            if isinstance(st, (ast.If, ast.While)):
+                yield from scan_expr(st.test)
+                yield from visit(st.body)
+                yield from visit(getattr(st, "orelse", []) or [])
+                continue
+            if isinstance(st, ast.For):
+                yield from scan_expr(st.iter)
+                taint.assign(st.target, taint.expr(st.iter))
+                yield from visit(st.body)
+                yield from visit(st.orelse or [])
+                continue
+            if isinstance(st, ast.With):
+                yield from visit(st.body)
+                continue
+            if isinstance(st, ast.Try):
+                yield from visit(st.body)
+                for h in st.handlers:
+                    yield from visit(h.body)
+                yield from visit(st.orelse or [])
+                yield from visit(st.finalbody or [])
+                continue
+            if isinstance(st, (ast.Return, ast.Expr)) \
+                    and getattr(st, "value", None) is not None:
+                yield from scan_expr(st.value)
+                continue
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    yield from scan_expr(child)
+
+    yield from visit(body)
+
+
+@rule("jit-silent-upcast", "jit-safety",
+      "f32/f64 promotion of a bf16-typed value inside traced bodies")
+def check_silent_upcast(project: Project) -> Iterable[Finding]:
+    """bf16 is the MXU's full-rate mode and half the HBM bytes; a value
+    explicitly cast down to bfloat16 that later gets ``.astype(f32)``'d
+    (or multiplied by an f32-TYPED literal — weakly-typed Python floats
+    stay bf16 and are fine) silently walks the whole downstream chain
+    back to full precision. Provenance is explicit-cast-rooted: only
+    values traceable to a ``.astype(jnp.bfloat16)`` / ``jnp.bfloat16()``
+    in the same traced body are tracked, so model-level deliberate
+    upcasts (flax modules casting logits to f32 for the loss) never
+    fire. Declare a deliberate upcast with a comment containing
+    'precision' on (or above) the line."""
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        for td in _collect_traced(sf):
+            yield from _silent_upcast_findings(sf, td)
+
+
 @rule("jit-in-loop", "jit-safety",
       "jax.jit constructed inside a for/while body (compile per iteration)")
 def check_jit_in_loop(project: Project) -> Iterable[Finding]:

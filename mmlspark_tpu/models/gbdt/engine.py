@@ -54,6 +54,12 @@ _m_predict_table_bytes = telemetry.registry.gauge(
 _m_auto_depthwise = telemetry.registry.counter(
     "mmlspark_gbdt_auto_depthwise_reroutes",
     "fits the growthPolicy='auto' heuristic rerouted to depthwise growth")
+_m_predict_bytes_per_row = telemetry.registry.gauge(
+    "mmlspark_gbdt_predict_bytes_per_row",
+    "estimated device-traffic bytes per scored row of the last ensemble "
+    "predict (uint8 bin row + staged node tests + amortized tree "
+    "tables); the quantized pallas path drops the test-table term and "
+    "shrinks the tables to uint8/bf16")
 
 
 class GBDTParams(NamedTuple):
@@ -1236,23 +1242,122 @@ def _predict_chunked(bins: np.ndarray, score_chunk, table_nodes: int
     return np.concatenate(outs, axis=0)
 
 
+def quantize_ensemble(ens: TreeEnsemble, num_iteration: Optional[int] = None):
+    """Level-wise ensemble -> structure-of-arrays quantized test tables:
+    ``(feature u8 (T,K,N), threshold u8 (T,K,N), leaf bf16 (T,K,L))``.
+
+    Exactness argument (the tables are lossless except the bf16 leaf
+    round): feature ids live in [0, d) with d <= 256 enforced here; bin
+    ids live in [0, max_bin) with max_bin <= 256 (fit_gbdt's uint8 wire
+    contract), so the route test ``bin > thr`` is unchanged by clamping
+    thresholds to 255 — the route-all-left sentinel (thr = n_bins) and a
+    bin-255 threshold both already route nothing right against uint8
+    bins. The bf16 leaf round is the one lossy step (<= 2^-9 relative
+    per leaf; the parity bound tests pin <= 1e-3 on summed raw scores)."""
+    T = ens.feature.shape[0]
+    T = min(T, num_iteration) if num_iteration else T
+    d = ens.bin_edges.shape[0]
+    if d > 256:
+        raise ValueError(f"quantized predict tables need <= 256 features "
+                         f"(uint8 feature ids), got {d}")
+    feat = np.asarray(ens.feature[:T]).astype(np.uint8)
+    thr = np.minimum(np.asarray(ens.threshold[:T]), 255).astype(np.uint8)
+    leaf = jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16)
+    return feat, thr, leaf
+
+
+def _resolve_predict_impl(requested: str, eligible: bool, why: str) -> str:
+    """auto|dense|pallas -> the impl that will run. 'auto' rides the
+    quantized pallas kernel only on TPU (interpret mode off-TPU is a
+    correctness fallback, not a fast path) and only when the ensemble
+    fits the kernel's unroll caps; an EXPLICIT 'pallas' on an ineligible
+    ensemble is an error, not a silent reroute."""
+    if requested not in ("auto", "dense", "pallas"):
+        raise ValueError(f"predict_impl must be auto|dense|pallas, got "
+                         f"{requested!r}")
+    if requested == "dense":
+        return "dense"
+    if requested == "pallas":
+        if not eligible:
+            raise ValueError(f"predict_impl='pallas' unavailable: {why}")
+        return "pallas"
+    return ("pallas" if eligible and jax.default_backend() == "tpu"
+            else "dense")
+
+
+def _quant_eligible_levelwise(ens: TreeEnsemble, depth: int):
+    from ...ops.pallas_kernels import (PREDICT_QUANT_MAX_LEAVES,
+                                       PREDICT_QUANT_MAX_NODES)
+    d = ens.bin_edges.shape[0]
+    if d > 256:
+        return False, f"{d} features exceed the uint8 feature-id space"
+    if 2 ** depth - 1 > PREDICT_QUANT_MAX_NODES \
+            or 2 ** depth > PREDICT_QUANT_MAX_LEAVES:
+        return False, (f"depth {depth} exceeds the kernel's unroll cap "
+                       f"({PREDICT_QUANT_MAX_NODES} nodes)")
+    return True, ""
+
+
+def _set_predict_traffic_gauge(n: int, d: int, K: int, table_bytes: int,
+                               test_table_nodes: int):
+    if telemetry.enabled() and n:
+        _m_predict_bytes_per_row.set(
+            d + 4 * K + test_table_nodes + table_bytes / n)
+
+
+def _predict_quant_levelwise(ens: TreeEnsemble, bins: np.ndarray, T: int,
+                             depth: int) -> np.ndarray:
+    """The quantized pallas scoring path: SoA uint8/bf16 tables + the
+    tile-resident kernel, chunked so per-chunk device staging stays
+    under the predict byte cap (the same streaming guard as the dense
+    path — here the per-row staging is the bin row + f32 output, no
+    test table)."""
+    from ...ops.pallas_kernels import gbdt_predict_quant_levelwise
+    feat, thr, leaf = quantize_ensemble(ens, T)
+    K = feat.shape[1]
+    n, d = bins.shape
+    base = jnp.asarray(ens.base)[None, :].astype(jnp.float32)
+    table_bytes = feat.nbytes + thr.nbytes + leaf.size * 2
+    _set_predict_traffic_gauge(n, d, K, table_bytes, 0)
+
+    @jax.jit
+    def run(part):
+        contrib = gbdt_predict_quant_levelwise(part.T, feat, thr, leaf,
+                                               depth=depth)
+        return contrib + base
+
+    prof = telemetry.profiler.wrap(run, "gbdt.predict_quant")
+    return _predict_chunked(
+        np.asarray(bins), lambda part: np.asarray(prof(jnp.asarray(part))),
+        d + 4 * K)
+
+
 def predict_raw(ens, x: np.ndarray,
-                num_iteration: Optional[int] = None) -> np.ndarray:
+                num_iteration: Optional[int] = None,
+                predict_impl: str = "auto") -> np.ndarray:
     """Raw ensemble scores (n, K). Accepts level-wise TreeEnsemble or
     leafwise.LeafwiseEnsemble. Rows batch past the test-table byte cap
     (_PREDICT_TABLE_BYTES_CAP) so deep/wide ensembles score huge inputs
-    at bounded HBM."""
+    at bounded HBM. ``predict_impl`` picks the scoring backend: 'dense'
+    (the f32/int32 XLA test-table path), 'pallas' (quantized SoA tables
+    — uint8 feature/threshold, bf16 leaf — walked by the tile-resident
+    kernel in ops/pallas_kernels.py), or 'auto' (pallas on TPU when the
+    ensemble fits the kernel caps, dense otherwise)."""
     from .leafwise import LeafwiseEnsemble, predict_raw_lw
     if isinstance(ens, LeafwiseEnsemble):
         bins = bin_data_auto(
             x, ens.bin_edges,
             ens.cat_features if ens.cat_features.any() else None,
             ens.bin_edges.shape[1] + 1)
-        return predict_raw_lw(ens, bins, num_iteration)
+        return predict_raw_lw(ens, bins, num_iteration,
+                              predict_impl=predict_impl)
     bins = bin_data_auto(x, ens.bin_edges)
     T, K, _ = ens.feature.shape
     depth = int(np.log2(ens.leaf.shape[2]))
     T = min(T, num_iteration) if num_iteration else T
+    eligible, why = _quant_eligible_levelwise(ens, depth)
+    if _resolve_predict_impl(predict_impl, eligible, why) == "pallas":
+        return _predict_quant_levelwise(ens, np.asarray(bins), T, depth)
 
     @jax.jit
     def run(bins, feature, threshold, leaf):
@@ -1270,6 +1375,12 @@ def predict_raw(ens, x: np.ndarray,
 
     nodes = 2 ** depth - 1
     table_nodes = nodes if nodes <= _TEST_TABLE_MAX_NODES else 64
+    d = ens.bin_edges.shape[0]
+    _set_predict_traffic_gauge(
+        bins.shape[0], d, K,
+        int(np.asarray(ens.feature[:T]).nbytes
+            + np.asarray(ens.threshold[:T]).nbytes
+            + np.asarray(ens.leaf[:T]).nbytes), table_nodes)
     return _predict_chunked(
         np.asarray(bins),
         lambda part: np.asarray(run(jnp.asarray(part), ens.feature[:T],
@@ -1288,6 +1399,8 @@ def prob_from_raw(objective: str, raw: np.ndarray) -> np.ndarray:
     return raw[:, 0]
 
 
-def predict(ens: TreeEnsemble, x: np.ndarray) -> np.ndarray:
+def predict(ens: TreeEnsemble, x: np.ndarray,
+            predict_impl: str = "auto") -> np.ndarray:
     """Probabilities for classification, values for regression."""
-    return prob_from_raw(ens.objective, predict_raw(ens, x))
+    return prob_from_raw(ens.objective,
+                         predict_raw(ens, x, predict_impl=predict_impl))

@@ -426,16 +426,80 @@ def predict_tree_lw(bins, S, F, T, W, IC, leaf, has_cats: bool = True):
                              has_cats=has_cats)
 
 
+def quantize_ensemble_lw(ens: LeafwiseEnsemble,
+                         num_iteration: Optional[int] = None):
+    """Leaf-wise ensemble -> SoA quantized tables: ``(split_leaf i32,
+    feature u8, threshold u8, leaf bf16)``. Numeric splits only (the
+    caller gates categorical ensembles onto the dense path — bitset
+    tests don't reduce to the uint8 compare). Same exactness argument
+    as engine.quantize_ensemble: only the bf16 leaf round is lossy."""
+    T = ens.feature.shape[0]
+    T = min(T, num_iteration) if num_iteration else T
+    d = ens.bin_edges.shape[0]
+    if d > 256:
+        raise ValueError(f"quantized predict tables need <= 256 features "
+                         f"(uint8 feature ids), got {d}")
+    return (np.asarray(ens.split_leaf[:T]).astype(np.int32),
+            np.asarray(ens.feature[:T]).astype(np.uint8),
+            np.minimum(np.asarray(ens.threshold[:T]), 255).astype(np.uint8),
+            jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16))
+
+
+def _quant_eligible_lw(ens: LeafwiseEnsemble, has_cats: bool):
+    from ...ops.pallas_kernels import (PREDICT_QUANT_MAX_LEAVES,
+                                       PREDICT_QUANT_MAX_NODES)
+    if has_cats:
+        return False, ("categorical bitset splits stay on the dense path")
+    d = ens.bin_edges.shape[0]
+    if d > 256:
+        return False, f"{d} features exceed the uint8 feature-id space"
+    splits = int(ens.split_leaf.shape[2])
+    if splits > PREDICT_QUANT_MAX_NODES \
+            or splits + 1 > PREDICT_QUANT_MAX_LEAVES:
+        return False, (f"{splits + 1} leaves exceed the kernel's unroll "
+                       f"cap ({PREDICT_QUANT_MAX_NODES} splits)")
+    return True, ""
+
+
+def _predict_quant_lw(ens: LeafwiseEnsemble, bins: np.ndarray,
+                      T: int) -> np.ndarray:
+    from .engine import (_predict_chunked, _set_predict_traffic_gauge)
+    from ...ops.pallas_kernels import gbdt_predict_quant_leafwise
+    from ... import telemetry
+    S, F, Th, leaf = quantize_ensemble_lw(ens, T)
+    K = F.shape[1]
+    n, d = bins.shape
+    base = jnp.asarray(ens.base)[None, :].astype(jnp.float32)
+    table_bytes = S.nbytes + F.nbytes + Th.nbytes + leaf.size * 2
+    _set_predict_traffic_gauge(n, d, K, table_bytes, 0)
+
+    @jax.jit
+    def run(part):
+        contrib = gbdt_predict_quant_leafwise(part.T, S, F, Th, leaf)
+        return contrib + base
+
+    prof = telemetry.profiler.wrap(run, "gbdt.predict_quant")
+    return _predict_chunked(
+        np.asarray(bins), lambda part: np.asarray(prof(jnp.asarray(part))),
+        d + 4 * K)
+
+
 def predict_raw_lw(ens: LeafwiseEnsemble, bins,
-                   num_iteration: Optional[int] = None) -> np.ndarray:
+                   num_iteration: Optional[int] = None,
+                   predict_impl: str = "auto") -> np.ndarray:
     """Raw scores (n, K) for a leaf-wise ensemble from binned features.
     Rows batch past the test-table byte cap (engine._predict_chunked) so
-    wide-leaf ensembles score huge inputs at bounded HBM."""
-    from .engine import _predict_chunked
+    wide-leaf ensembles score huge inputs at bounded HBM. ``predict_impl``
+    mirrors engine.predict_raw: dense | pallas (quantized SoA tables +
+    the tile-resident kernel; numeric splits only) | auto."""
+    from .engine import _predict_chunked, _resolve_predict_impl
     T, K = ens.feature.shape[:2]
     T = min(T, num_iteration) if num_iteration else T
 
     has_cats = bool(np.asarray(ens.cat_features).any())
+    eligible, why = _quant_eligible_lw(ens, has_cats)
+    if _resolve_predict_impl(predict_impl, eligible, why) == "pallas":
+        return _predict_quant_lw(ens, np.asarray(bins), T)
 
     @jax.jit
     def run(bins, S, F, Th, W, IC, leaf):
@@ -454,6 +518,13 @@ def predict_raw_lw(ens: LeafwiseEnsemble, bins,
 
     splits = int(ens.split_leaf.shape[2])
     table_nodes = splits if splits <= _TEST_TABLE_MAX_SPLITS else 1
+    from .engine import _set_predict_traffic_gauge
+    _set_predict_traffic_gauge(
+        bins.shape[0], ens.bin_edges.shape[0], K,
+        int(sum(np.asarray(a[:T]).nbytes
+                for a in (ens.split_leaf, ens.feature, ens.threshold,
+                          ens.cat_bitset, ens.is_cat, ens.leaf))),
+        table_nodes)
     return _predict_chunked(
         np.asarray(bins),
         lambda part: np.asarray(run(jnp.asarray(part), ens.split_leaf[:T],

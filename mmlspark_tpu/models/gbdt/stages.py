@@ -550,11 +550,22 @@ def _split_importances(state: dict, selection, bundles,
     return out
 
 
+_PREDICT_IMPL_DOC = (
+    "ensemble scoring backend: dense = the f32/int32 XLA test-table "
+    "path; pallas = quantized structure-of-arrays tables (uint8 "
+    "feature/threshold, bf16 leaf) walked by the tile-resident Pallas "
+    "kernel (ops/pallas_kernels.py; interpret-mode off-TPU); auto "
+    "(default) = pallas on TPU when the ensemble fits the kernel's "
+    "unroll caps, dense otherwise")
+
+
 class LightGBMClassificationModel(Model, HasFeaturesCol):
     rawPredictionCol = StringParam("raw margin column", default="rawPrediction")
     probabilityCol = StringParam("probability column", default="probability")
     predictionCol = StringParam("predicted label column", default="prediction")
     objective = StringParam("binary|multiclass", default="binary")
+    predictImpl = StringParam(_PREDICT_IMPL_DOC, default="auto",
+                              choices=("auto", "dense", "pallas"))
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)
@@ -578,7 +589,8 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
                               self.getFeatureSelection(),
                               self.getFeatureBundles())
         ens = self._ensemble()
-        raw = engine.predict_raw(ens, x)
+        raw = engine.predict_raw(ens, x,
+                                 predict_impl=self.getPredictImpl())
         prob = engine.prob_from_raw(ens.objective, raw)
         from ...core.utils import object_column
         raw_col = object_column(raw)
@@ -626,6 +638,8 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
 class LightGBMRegressionModel(Model, HasFeaturesCol):
     predictionCol = StringParam("prediction column", default="prediction")
     objective = StringParam("regression|quantile|mae", default="regression")
+    predictImpl = StringParam(_PREDICT_IMPL_DOC, default="auto",
+                              choices=("auto", "dense", "pallas"))
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)
@@ -645,7 +659,8 @@ class LightGBMRegressionModel(Model, HasFeaturesCol):
                               self.getFeatureSelection(),
                               self.getFeatureBundles())
         ens = _state_to_ensemble(self.getBoosterState(), self.getObjective())
-        pred = engine.predict(ens, x).astype(np.float64)
+        pred = engine.predict(
+            ens, x, predict_impl=self.getPredictImpl()).astype(np.float64)
         out = df.withColumn(self.getPredictionCol(), pred)
         return SparkSchema.setScoresColumnName(out, self.getPredictionCol(),
                                                "regression")

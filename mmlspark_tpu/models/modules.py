@@ -463,11 +463,13 @@ TOKEN_MODELS = ("bilstm", "transformer")
 MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
     "mlp": lambda cfg: MLPNet(
         hidden=tuple(cfg.get("hidden", (128, 64))),
-        num_classes=cfg.get("num_classes", 2)),
+        num_classes=cfg.get("num_classes", 2),
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16))),
     "convnet": lambda cfg: ConvNet(
         channels=tuple(cfg.get("channels", (32, 32, 64, 64))),
         dense=cfg.get("dense", 512),
-        num_classes=cfg.get("num_classes", 10)),
+        num_classes=cfg.get("num_classes", 10),
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16))),
     "resnet": lambda cfg: ResNet(
         blocks_per_stage=cfg.get("blocks_per_stage", 3),
         widths=tuple(cfg.get("widths", (16, 32, 64))),
@@ -492,7 +494,8 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
         vocab_size=cfg.get("vocab_size", 10000),
         embed_dim=cfg.get("embed_dim", 128),
         hidden=cfg.get("hidden", 128),
-        num_classes=cfg.get("num_classes", 8)),
+        num_classes=cfg.get("num_classes", 8),
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16))),
     "transformer": lambda cfg, attn_fn=None: TransformerEncoder(
         vocab_size=cfg.get("vocab_size", 10000),
         d_model=cfg.get("d_model", 128),
@@ -509,6 +512,7 @@ MODEL_BUILDERS: dict[str, Callable[..., nn.Module]] = {
         expert_top_k=cfg.get("expert_top_k", 2),
         capacity_factor=cfg.get("capacity_factor", 1.25),
         remat=cfg.get("remat", False),
+        dtype=jnp.dtype(cfg.get("dtype", jnp.bfloat16)),
         attn_fn=attn_fn),
 }
 

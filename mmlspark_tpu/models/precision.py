@@ -1,0 +1,191 @@
+"""Mixed-precision training state: dynamic loss scaling for bf16 compute.
+
+The model families already run their matmuls in bfloat16 over float32
+master params (flax ``dtype=jnp.bfloat16`` with the default f32
+``param_dtype`` — modules.py), which is the MXU-native fast path. What
+that leaves on the table is the *gradient safety* story: bf16 keeps
+f32's exponent range, but long reductions and attention logits can still
+overflow through f16-range intermediates, and half-precision gradients
+underflow to zero well before f32 ones do. ``TpuLearner(precision=
+"bf16_mixed")`` closes that gap with the classic dynamic-loss-scale
+recurrence (the same shape as AMP / optax.contrib's MixedPrecision):
+
+  * the loss is multiplied by ``scale`` BEFORE the backward pass, so
+    small gradients ride up into bf16/f32's well-conditioned range;
+  * gradients are unscaled (and optionally global-norm clipped) before
+    the optax update — all inside the one fused jitted step;
+  * a step whose unscaled gradients contain a non-finite value is
+    SKIPPED: params/opt_state keep their old buffers, ``scale`` backs
+    off by ``BACKOFF_FACTOR``, and the skip is counted
+    (``mmlspark_trainer_skipped_steps_total``);
+  * after ``GROWTH_INTERVAL`` consecutive finite steps the scale grows
+    by ``GROWTH_FACTOR`` (capped), probing for the largest safe scale.
+
+The whole recurrence lives in :class:`ScaleState` — three device
+scalars threaded through the jitted step alongside (params, opt_state)
+and donated with them, so the steady state stays a single fused XLA
+dispatch per step with no host sync. Checkpoints serialize the state
+next to the f32 masters (models/trainer.py), so a resumed fit continues
+with the exact scale it was killed at.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import telemetry
+
+#: trainer precision modes (the ``TpuLearner.precision`` param domain)
+MODES = ("f32", "bf16", "bf16_mixed")
+
+DEFAULT_INIT_SCALE = 2.0 ** 15
+GROWTH_INTERVAL = 2000      # finite steps before the scale doubles
+GROWTH_FACTOR = 2.0
+BACKOFF_FACTOR = 0.5
+MIN_SCALE = 1.0
+MAX_SCALE = 2.0 ** 24       # leaves f32 headroom above any sane loss
+
+_m_loss_scale = telemetry.registry.gauge(
+    "mmlspark_trainer_loss_scale",
+    "current dynamic loss scale of a precision='bf16_mixed' fit "
+    "(observed at epoch boundaries — the step itself never syncs)")
+_m_skipped_steps = telemetry.registry.counter(
+    "mmlspark_trainer_skipped_steps",
+    "optimizer steps skipped by the dynamic loss scaler because the "
+    "unscaled gradients contained a non-finite value (each skip also "
+    "backs the scale off)")
+
+
+class ScaleState(NamedTuple):
+    """Dynamic-loss-scale recurrence state: three device scalars.
+
+    scale:   () f32 — current loss multiplier
+    growth:  () i32 — consecutive finite steps since the last scale move
+    skipped: () i32 — cumulative skipped steps this fit (telemetry reads
+             the delta at epoch boundaries)
+    """
+    scale: jnp.ndarray
+    growth: jnp.ndarray
+    skipped: jnp.ndarray
+
+
+def init_scale_state(init_scale: float = DEFAULT_INIT_SCALE) -> ScaleState:
+    return ScaleState(jnp.float32(init_scale), jnp.int32(0), jnp.int32(0))
+
+
+def scale_state_to_host(state: ScaleState) -> dict:
+    """JSON/msgpack-able host form for checkpoints."""
+    return {"scale": float(np.asarray(state.scale)),
+            "growth": int(np.asarray(state.growth)),
+            "skipped": int(np.asarray(state.skipped))}
+
+
+def scale_state_from_host(d: dict) -> ScaleState:
+    return ScaleState(jnp.float32(d["scale"]), jnp.int32(d["growth"]),
+                      jnp.int32(d["skipped"]))
+
+
+def all_finite(tree) -> jnp.ndarray:
+    """() bool — every leaf of ``tree`` is finite everywhere."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    ok = jnp.bool_(True)
+    for leaf in leaves:
+        ok = ok & jnp.all(jnp.isfinite(leaf))
+    return ok
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    """Scale ``grads`` so their global L2 norm is at most ``max_norm``
+    (a no-op factor of 1 when already under). Runs AFTER unscaling under
+    bf16_mixed, so the clip threshold is in true gradient units."""
+    sq = sum(jnp.sum(jnp.square(g))
+             for g in jax.tree_util.tree_leaves(grads))
+    norm = jnp.sqrt(sq)
+    factor = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree_util.tree_map(lambda g: g * factor, grads)
+
+
+def update_scale(state: ScaleState, finite) -> ScaleState:
+    """One recurrence step: grow on sustained stability, back off on a
+    non-finite step, count the skip."""
+    grown = finite & (state.growth + 1 >= GROWTH_INTERVAL)
+    new_scale = jnp.where(
+        finite,
+        jnp.where(grown,
+                  jnp.minimum(state.scale * GROWTH_FACTOR, MAX_SCALE),
+                  state.scale),
+        jnp.maximum(state.scale * BACKOFF_FACTOR, MIN_SCALE))
+    growth = jnp.where(finite & ~grown, state.growth + 1, 0)
+    skipped = state.skipped + jnp.where(finite, 0, 1)
+    return ScaleState(new_scale.astype(jnp.float32),
+                      growth.astype(jnp.int32),
+                      skipped.astype(jnp.int32))
+
+
+def make_mixed_step_body(compute_loss, tx, grad_clip: float = 0.0):
+    """The fused bf16_mixed optimizer step:
+    cast→grad→unscale→clip→update in ONE traced body.
+
+    ``compute_loss(params, xb, yb, wb) -> () f32`` is the trainer's loss
+    closure (the model itself casts to its compute dtype — flax
+    ``dtype=`` — so the "cast" stage is already inside the traced
+    forward). Returns a body with signature::
+
+        (params, opt_state, scale_state, xb, yb, wb)
+            -> (params, opt_state, scale_state, loss)
+
+    where ``loss`` is the UNSCALED value (finite even when the scaled
+    backward overflowed — divergence detection must not confuse a
+    too-high scale with a diverged model). A non-finite-gradient step
+    returns the ORIGINAL params/opt_state buffers (the update is
+    elementwise-selected away), so a skipped step costs one wasted
+    backward, never a corrupted model.
+    """
+
+    def step_body(params, opt_state, scale_state, xb, yb, wb):
+        scale = scale_state.scale
+
+        def scaled(p):
+            loss = compute_loss(p, xb, yb, wb)
+            return loss * scale, loss
+
+        (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(params)
+        inv = 1.0 / scale
+        grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+        finite = all_finite(grads)
+        if grad_clip > 0.0:
+            grads = clip_by_global_norm(grads, grad_clip)
+        # the update runs unconditionally (lax.cond would break the scan
+        # path's fixed shapes and win nothing — the backward dominates);
+        # a skipped step selects the OLD buffers back
+        safe = jax.tree_util.tree_map(
+            lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads)
+        updates, new_opt = tx.update(safe, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        keep = lambda new, old: jax.tree_util.tree_map(
+            lambda a, b: jnp.where(finite, a, b), new, old)
+        return (keep(new_params, params), keep(new_opt, opt_state),
+                update_scale(scale_state, finite), loss)
+
+    return step_body
+
+
+def observe_scale_state(state, prev_skipped: int) -> int:
+    """Epoch-boundary telemetry flush: set the loss-scale gauge, count
+    newly skipped steps, return the new cumulative skip count. The ONLY
+    place the scale state is read host-side — the per-step hot loop
+    never syncs on it."""
+    if state is None:
+        return prev_skipped
+    if telemetry.enabled():
+        host = scale_state_to_host(state)
+        _m_loss_scale.set(host["scale"])
+        if host["skipped"] > prev_skipped:
+            _m_skipped_steps.inc(host["skipped"] - prev_skipped)
+        return host["skipped"]
+    return prev_skipped

@@ -272,34 +272,61 @@ def _place_params(params, mesh, tx, *, tp: int = 1, ep: int = 1):
     return params, opt
 
 
-def _make_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float):
+def _make_loss_compute(module, loss_fn, is_moe: bool, moe_aux: float):
+    """The weighted scalar loss of one batch — the ONE forward every
+    precision mode and step path shares. The model casts itself to its
+    compute dtype (flax ``dtype=``), so precision selection rides the
+    model config; the loss reduction stays f32."""
+
+    def compute(p, xb, yb, wb):
+        # weighted mean so mesh-padding rows (weight 0) carry no gradient.
+        # MoE routing must see the row weights too: padded rows may not
+        # claim expert capacity or skew the balancing stats
+        kw = {"row_mask": wb} if is_moe else {}
+        if moe_aux > 0.0:
+            preds, inter = module.apply(p, xb, mutable=["intermediates"],
+                                        **kw)
+            from .moe import read_moe_aux_loss
+            aux = read_moe_aux_loss(inter["intermediates"])
+        else:
+            preds = module.apply(p, xb, **kw)
+            aux = 0.0
+        losses = loss_fn(preds, yb)
+        main = jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
+        return main + moe_aux * aux
+
+    return compute
+
+
+def _make_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float,
+                    grad_clip: float = 0.0):
     """The un-jitted optimizer step: loss -> grads -> update. Shared by the
     one-step-per-dispatch path (fitStream, multi-host) and the scanned
     multi-step path (fit's default)."""
+    compute = _make_loss_compute(module, loss_fn, is_moe, moe_aux)
 
     def step_body(params, opt_state, xb, yb, wb):
-        # weighted mean so mesh-padding rows (weight 0) carry no gradient
-        def compute(p):
-            # MoE routing must see the row weights too: padded rows may
-            # not claim expert capacity or skew the balancing stats
-            kw = {"row_mask": wb} if is_moe else {}
-            if moe_aux > 0.0:
-                preds, inter = module.apply(p, xb,
-                                            mutable=["intermediates"],
-                                            **kw)
-                from .moe import read_moe_aux_loss
-                aux = read_moe_aux_loss(inter["intermediates"])
-            else:
-                preds = module.apply(p, xb, **kw)
-                aux = 0.0
-            losses = loss_fn(preds, yb)
-            main = jnp.sum(losses * wb) / jnp.maximum(jnp.sum(wb), 1.0)
-            return main + moe_aux * aux
-        loss, grads = jax.value_and_grad(compute)(params)
+        loss, grads = jax.value_and_grad(
+            lambda p: compute(p, xb, yb, wb))(params)
+        if grad_clip > 0.0:
+            from .precision import clip_by_global_norm
+            grads = clip_by_global_norm(grads, grad_clip)
         updates, opt2 = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt2, loss
 
     return step_body
+
+
+def _make_mixed_step_body(module, tx, loss_fn, is_moe: bool, moe_aux: float,
+                          grad_clip: float = 0.0):
+    """bf16_mixed twin of _make_step_body: the fused
+    cast→grad→unscale→clip→update body threading a ScaleState
+    (models/precision.py). Signature gains the scale_state operand:
+    ``(params, opt_state, scale_state, xb, yb, wb) ->
+    (params, opt_state, scale_state, loss)``."""
+    from .precision import make_mixed_step_body
+    return make_mixed_step_body(
+        _make_loss_compute(module, loss_fn, is_moe, moe_aux), tx, grad_clip)
 
 
 def _make_pp_step_body(cfg: dict, mesh, tx, loss_fn, n_micro: int):
@@ -323,7 +350,8 @@ def _make_pp_step_body(cfg: dict, mesh, tx, loss_fn, n_micro: int):
 
 
 def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
-                     step_body=None):
+                     step_body=None, mixed: bool = False,
+                     grad_clip: float = 0.0):
     """One jitted optimizer step (fitStream / multi-host feed path).
 
     The batch buffers (xb, yb) are DONATED on accelerator backends: the
@@ -332,21 +360,40 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
     alongside. The weight mask wb is NOT donated — the feed path caches one
     placed mask per (rows, n_real) signature and reuses it across steps.
 
-    On the CPU backend the donation is DISABLED: ``device_put`` there can
-    alias the host numpy buffer zero-copy, and donating an aliased buffer
-    hands memory the host allocator still owns back to XLA as scratch —
-    the step outputs land in pages numpy reuses for later allocations, and
-    training corrupts nondeterministically (losses exploding to ~1e35 on
-    a fitStream that is bit-identical to fit() with donation off). Host
-    memory is not the scarce resource on CPU, so nothing is lost."""
-    donate = () if jax.default_backend() == "cpu" else (2, 3)
+    ``mixed=True`` (precision='bf16_mixed') jits the fused loss-scaling
+    body instead and additionally donates the FULL training state —
+    (params, opt_state, scale_state) — so the whole update is one
+    dispatch whose state buffers are reused in place (the state outputs
+    are jit outputs, never host-aliased, so this donation is safe on
+    every backend).
+
+    On the CPU backend the BATCH donation is DISABLED: ``device_put``
+    there can alias the host numpy buffer zero-copy, and donating an
+    aliased buffer hands memory the host allocator still owns back to
+    XLA as scratch — the step outputs land in pages numpy reuses for
+    later allocations, and training corrupts nondeterministically
+    (losses exploding to ~1e35 on a fitStream that is bit-identical to
+    fit() with donation off). Host memory is not the scarce resource on
+    CPU, so nothing is lost."""
+    cpu = jax.default_backend() == "cpu"
+    # `mixed` is a host-side factory flag, static at build time (the
+    # profiler.wrap discovery over-approximates this FACTORY as a traced
+    # body — only the returned step functions are ever traced)
+    if mixed:   # graftlint: disable=jit-traced-branch
+        body = step_body or _make_mixed_step_body(
+            module, tx, loss_fn, is_moe, moe_aux, grad_clip)
+        donate = (0, 1, 2) if cpu else (0, 1, 2, 3, 4)
+        return jax.jit(body, donate_argnums=donate)
+    donate = () if cpu else (2, 3)
     return jax.jit(step_body or
-                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux),
+                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux,
+                                   grad_clip),
                    donate_argnums=donate)
 
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
-                        mesh, bs: int, step_body=None):
+                        mesh, bs: int, step_body=None, mixed: bool = False,
+                        grad_clip: float = 0.0):
     """A whole epoch of optimizer steps per XLA dispatch over
     DEVICE-RESIDENT data.
 
@@ -369,9 +416,41 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
     """
     from functools import partial
 
-    step_body = step_body or _make_step_body(module, tx, loss_fn, is_moe,
-                                             moe_aux)
     data_sh = meshlib.batch_sharding(mesh)
+
+    def window(arrs, o):
+        xb = jax.lax.dynamic_slice_in_dim(arrs[0], o, bs, 0)
+        yb = jax.lax.dynamic_slice_in_dim(arrs[1], o, bs, 0)
+        wb = jax.lax.dynamic_slice_in_dim(arrs[2], o, bs, 0)
+        if mesh.size > 1:  # trivial meshes stay off the SPMD path
+            xb = jax.lax.with_sharding_constraint(xb, data_sh)
+            yb = jax.lax.with_sharding_constraint(yb, data_sh)
+        return xb, yb, wb
+
+    # host-side factory flag, static at build time (see _make_train_step)
+    if mixed:   # graftlint: disable=jit-traced-branch
+        mixed_body = step_body or _make_mixed_step_body(
+            module, tx, loss_fn, is_moe, moe_aux, grad_clip)
+
+        # the scale state scans WITH (params, opt_state): a skipped step
+        # inside the window backs the scale off for the very next step of
+        # the same dispatch — no host round-trip in the recurrence
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def run_epoch_mixed(params, opt_state, scale_state, x_all, y_all,
+                            w_all, starts):
+            def body(carry, o):
+                p, opt, s = carry
+                xb, yb, wb = window((x_all, y_all, w_all), o)
+                p, opt, s, loss = mixed_body(p, opt, s, xb, yb, wb)
+                return (p, opt, s), loss
+            (params, opt_state, scale_state), losses = jax.lax.scan(
+                body, (params, opt_state, scale_state), starts)
+            return params, opt_state, scale_state, losses[-1]
+
+        return run_epoch_mixed
+
+    step_body = step_body or _make_step_body(module, tx, loss_fn, is_moe,
+                                             moe_aux, grad_clip)
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def run_epoch(params, opt_state, x_all, y_all, w_all, starts):
@@ -379,12 +458,7 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
         # epoch array of n_pad + bs rows; w_all weights out padding rows
         def body(carry, o):
             p, opt = carry
-            xb = jax.lax.dynamic_slice_in_dim(x_all, o, bs, 0)
-            yb = jax.lax.dynamic_slice_in_dim(y_all, o, bs, 0)
-            wb = jax.lax.dynamic_slice_in_dim(w_all, o, bs, 0)
-            if mesh.size > 1:  # trivial meshes stay off the SPMD path
-                xb = jax.lax.with_sharding_constraint(xb, data_sh)
-                yb = jax.lax.with_sharding_constraint(yb, data_sh)
+            xb, yb, wb = window((x_all, y_all, w_all), o)
             p, opt, loss = step_body(p, opt, xb, yb, wb)
             return (p, opt), loss
         (params, opt_state), losses = jax.lax.scan(
@@ -437,6 +511,30 @@ class TpuLearner(Estimator):
         min=1)
     moeAuxWeight = FloatParam("weight of the MoE load-balancing aux loss",
                               default=0.01, min=0.0)
+    precision = StringParam(
+        "compute precision of the jitted train step: 'bf16' (default) = "
+        "bf16 activations/grads over f32 master weights (the MXU-native "
+        "mode the model families already default to); 'f32' = full-"
+        "precision compute (parity baseline / numerics debugging); "
+        "'bf16_mixed' = bf16 compute PLUS dynamic loss scaling — the "
+        "fused step scales the loss before the backward pass, unscales "
+        "and (optionally) clips the grads, SKIPS the update when any "
+        "grad is non-finite (scale backs off; skips counted on "
+        "mmlspark_trainer_skipped_steps_total), grows the scale on "
+        "sustained stability, and donates (params, opt_state, "
+        "scale_state) so the whole update stays one XLA dispatch. "
+        "Checkpoints always store the f32 masters, plus the scale state "
+        "under bf16_mixed, so resume is bit-exact per mode",
+        default="bf16", choices=("f32", "bf16", "bf16_mixed"))
+    gradClipNorm = FloatParam(
+        "global-L2-norm gradient clip applied inside the fused step "
+        "(0 = off); under bf16_mixed the clip runs AFTER unscaling, so "
+        "the threshold is in true gradient units", default=0.0, min=0.0)
+    lossScaleInit = FloatParam(
+        "initial dynamic loss scale for precision='bf16_mixed' "
+        "(backoff halves it on non-finite grads; growth doubles it "
+        "after sustained finite steps)", default=float(2.0 ** 15),
+        min=1.0)
     haltOnNonFinite = BooleanParam(
         "raise when the epoch loss goes NaN/inf instead of training on "
         "garbage (failure detection the reference lacks, SURVEY.md §5)",
@@ -544,10 +642,17 @@ class TpuLearner(Estimator):
                                          -1 if p[1] is None else p[1]))
 
     def _save_checkpoint(self, epoch: int, params, opt_state,
-                         step: Optional[int] = None):
+                         step: Optional[int] = None, scale_state=None):
         os.makedirs(self.getCheckpointDir(), exist_ok=True)
+        # params are ALWAYS the f32 masters (bf16 compute casts per-layer
+        # inside the step and never writes back), so every precision mode
+        # checkpoints the same full-precision state; bf16_mixed adds its
+        # loss-scale recurrence so a resumed fit continues bit-exact
         state = {"params": _host_tree(params),
                  "opt": serialization.to_state_dict(_host_tree(opt_state))}
+        if scale_state is not None:
+            from .precision import scale_state_to_host
+            state["scale"] = scale_state_to_host(scale_state)
         # write-then-rename: a crash mid-write must never leave a truncated
         # file that _latest_checkpoint would pick and brick the resume.
         # The tmp name is per-process: on SHARED storage every process
@@ -573,11 +678,14 @@ class TpuLearner(Estimator):
                         pass   # another process pruned it first
 
     def _restore_checkpoint(self, pos: tuple, params_tmpl, opt_tmpl):
+        """-> (params, opt, scale_host) — scale_host is the checkpointed
+        loss-scale dict (bf16_mixed fits) or None (every other mode, and
+        checkpoints written before the precision param existed)."""
         with open(self._ckpt_path(*pos), "rb") as f:
             state = serialization.msgpack_restore(f.read())
         params = serialization.from_state_dict(params_tmpl, state["params"])
         opt = serialization.from_state_dict(opt_tmpl, state["opt"])
-        return params, opt
+        return params, opt, state.get("scale")
 
     def _consensus_resume(self, resume: Optional[tuple], nproc: int):
         """Multi-host: resume only when EVERY process sees the same
@@ -600,18 +708,24 @@ class TpuLearner(Estimator):
                 "all processes", seen.tolist())
         return None
 
-    def _resume_training_state(self, params, opt_state, nproc: int):
+    def _resume_training_state(self, params, opt_state, nproc: int,
+                               scale_state=None):
         """Consensus-pick the resume position and restore (params,
         opt_state) onto their existing mesh shardings. Returns (params,
-        opt_state, start_epoch, start_step, resume_pos) — resume_pos is
-        the ``(epoch, step)`` consensus position restored from, or None
-        for a fresh start. Shared by fit() and fitStream()."""
+        opt_state, start_epoch, start_step, resume_pos, scale_state) —
+        resume_pos is the ``(epoch, step)`` consensus position restored
+        from, or None for a fresh start; scale_state is the checkpointed
+        loss-scale recurrence when this fit runs bf16_mixed (else the
+        passed-through value). Shared by fit() and fitStream()."""
         resume = self._consensus_resume(self._latest_checkpoint(), nproc)
         if resume is None:
-            return params, opt_state, 0, 0, None
+            return params, opt_state, 0, 0, None, scale_state
         placed = (params, opt_state)
-        params, opt_state = self._restore_checkpoint(resume, params,
-                                                     opt_state)
+        params, opt_state, scale_host = self._restore_checkpoint(
+            resume, params, opt_state)
+        if scale_host is not None and scale_state is not None:
+            from .precision import scale_state_from_host
+            scale_state = scale_state_from_host(scale_host)
         if nproc > 1:
             # restored host arrays must go back onto the global mesh
             # shardings (replicated for dp, model/expert axes for tp/ep)
@@ -620,11 +734,33 @@ class TpuLearner(Estimator):
         epoch, step = resume
         if step is None:
             log.info("resumed from checkpoint epoch %d", epoch)
-            return params, opt_state, epoch + 1, 0, resume
+            return params, opt_state, epoch + 1, 0, resume, scale_state
         log.info("resumed from checkpoint epoch %d step %d", epoch, step)
-        return params, opt_state, epoch, step + 1, resume
+        return params, opt_state, epoch, step + 1, resume, scale_state
 
     # ---- training ----
+    def _cfg_with_precision(self, cfg: dict) -> dict:
+        """Reflect the ``precision`` param into the model's compute
+        dtype. The model families default to bf16 compute already
+        (modules.py), so 'bf16' leaves the config untouched (bit-
+        identical to every fit before the param existed); 'f32' and
+        'bf16_mixed' pin the dtype explicitly — an explicit user
+        ``dtype`` in the config always wins."""
+        mode = self.getPrecision()
+        if mode != "bf16" and "dtype" not in cfg:
+            cfg["dtype"] = "float32" if mode == "f32" else "bfloat16"
+        return cfg
+
+    def _precision_setup(self):
+        """(mixed, grad_clip, scale_state) for this fit."""
+        mixed = self.getPrecision() == "bf16_mixed"
+        if mixed:
+            from .precision import init_scale_state
+            scale_state = init_scale_state(self.getLossScaleInit())
+        else:
+            scale_state = None
+        return mixed, self.getGradClipNorm(), scale_state
+
     def _slo_session(self):
         """Fit-scoped SLO evaluation (the ``sloConfig`` param): a private
         time-series sampler + SLOEngine run for the duration of the fit
@@ -702,7 +838,7 @@ class TpuLearner(Estimator):
         # distributed path and tests already configure it)
         from ..parallel.distributed import configure_xla_cache
         configure_xla_cache()
-        cfg = dict(self.getModelConfig())
+        cfg = self._cfg_with_precision(dict(self.getModelConfig()))
         x = _prep_input(df, self.getFeaturesCol(), tuple(self.getInputShape()))
         if cfg.get("type") in TOKEN_MODELS:
             x = x.astype(np.int32)
@@ -714,6 +850,13 @@ class TpuLearner(Estimator):
         sp = self.getSequenceParallel()
         ep = self.getExpertParallel()
         pp = self.getPipelineParallel()
+        mixed, grad_clip, scale_state = self._precision_setup()
+        if mixed and pp > 1:
+            raise ValueError(
+                "precision='bf16_mixed' composes with data/tensor/seq/"
+                "expert parallelism; the pipeline step body does not "
+                "thread the loss-scale state — run pipelineParallel fits "
+                "with precision='bf16' or 'f32'")
         attn_fn = None
         if elastic_ctx is not None and (sp > 1 or ep > 1 or pp > 1):
             raise ValueError(
@@ -854,14 +997,16 @@ class TpuLearner(Estimator):
                 and x.nbytes + y.nbytes <= data_cap:
             scan_fn = telemetry.profiler.wrap(_make_scan_epoch_fn(
                 module, tx, loss_fn, is_moe, moe_aux, mesh,
-                _scan_batch(bs_global, mesh, pp), step_body=pp_body),
+                _scan_batch(bs_global, mesh, pp), step_body=pp_body,
+                mixed=mixed, grad_clip=grad_clip),
                 "trainer.scan_epoch")
         else:
             # multi-host (per-process shards feed put_global_batch) or a
             # dataset too big for HBM residency: per-step host feed
             train_step = telemetry.profiler.wrap(
                 _make_train_step(module, tx, loss_fn, is_moe,
-                                 moe_aux, step_body=pp_body),
+                                 moe_aux, step_body=pp_body, mixed=mixed,
+                                 grad_clip=grad_clip),
                 "trainer.step")
         # per-process batch orders only matter when processes feed distinct
         # dp shards; in local-fit mode (fleet tuner trials/refits) every
@@ -870,8 +1015,9 @@ class TpuLearner(Estimator):
         rng_np = np.random.default_rng(
             self.getSeed() + (0 if meshlib.in_local_fit()
                               else jax.process_index()))
-        params, opt_state, start_epoch, start_step, resume_pos = \
-            self._resume_training_state(params, opt_state, nproc)
+        params, opt_state, start_epoch, start_step, resume_pos, \
+            scale_state = self._resume_training_state(
+                params, opt_state, nproc, scale_state)
         if elastic_ctx is not None:
             # bit-exact-resume evidence for the coordinator's journal: the
             # digest of the restored params (None on a fresh start)
@@ -892,7 +1038,8 @@ class TpuLearner(Estimator):
                 start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
                 nproc=nproc, train_step=train_step, params=params,
                 opt_state=opt_state, scan_fn=scan_fn,
-                start_step=start_step, elastic_ctx=elastic_ctx)
+                start_step=start_step, elastic_ctx=elastic_ctx,
+                scale_state=scale_state)
 
         return self._package_model(cfg, params, last_loss)
 
@@ -931,7 +1078,7 @@ class TpuLearner(Estimator):
             return self._fit_stream_core(batches_fn)
 
     def _fit_stream_core(self, batches_fn) -> TpuModel:
-        cfg = dict(self.getModelConfig())
+        cfg = self._cfg_with_precision(dict(self.getModelConfig()))
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
                 or self.getPipelineParallel() > 1):
             raise ValueError(
@@ -976,13 +1123,16 @@ class TpuLearner(Estimator):
                   and cfg.get("num_experts", 0) > 0)
         if self.getProfile():
             telemetry.profiler.enable()
+        mixed, grad_clip, scale_state = self._precision_setup()
         train_step = telemetry.profiler.wrap(_make_train_step(
             module, tx, loss_fn, is_moe,
-            self.getMoeAuxWeight() if is_moe else 0.0), "trainer.step")
+            self.getMoeAuxWeight() if is_moe else 0.0, mixed=mixed,
+            grad_clip=grad_clip), "trainer.step")
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
-        params, opt_state, start_epoch, start_step, _ = \
-            self._resume_training_state(params, opt_state, nproc)
+        params, opt_state, start_epoch, start_step, _, scale_state = \
+            self._resume_training_state(params, opt_state, nproc,
+                                        scale_state)
         if start_step:
             # a stream cannot skip deterministically to step N (the
             # generator is opaque); restart the epoch — the checkpointed
@@ -997,6 +1147,7 @@ class TpuLearner(Estimator):
         guard = (meshlib.collective_fit_lock if mesh.size > 1
                  else contextlib.nullcontext())
         last_loss = None
+        skipped_seen = 0
         with guard:
             for epoch in range(start_epoch, self.getEpochs()):
                 it = first_iter if epoch == start_epoch and first is not None \
@@ -1027,24 +1178,33 @@ class TpuLearner(Estimator):
                     for n, xb, yb, wb in steps_it:
                         with _m_step_time.time():
                             def dispatch(_a, p=params, o=opt_state,
-                                         xb=xb, yb=yb, wb=wb):
+                                         ss=scale_state, xb=xb, yb=yb,
+                                         wb=wb):
                                 faults.inject("trainer.step")
-                                return train_step(p, o, xb, yb, wb)
-                            params, opt_state, loss = _STEP_RETRY.run(
-                                dispatch)
+                                if ss is None:
+                                    p2, o2, loss = train_step(p, o, xb,
+                                                              yb, wb)
+                                    return p2, o2, None, loss
+                                return train_step(p, o, ss, xb, yb, wb)
+                            params, opt_state, scale_state, loss = \
+                                _STEP_RETRY.run(dispatch)
                         steps_run += 1
                         if n:
                             n_batches += 1
                         if ckpt_every and steps_run % ckpt_every == 0 \
                                 and jax.process_index() == 0:
                             self._save_checkpoint(epoch, params, opt_state,
-                                                  step=steps_run - 1)
+                                                  step=steps_run - 1,
+                                                  scale_state=scale_state)
                 finally:
                     steps_it.close()
                 if steps_run == 0:
                     raise ValueError(f"batches_fn() yielded no batches in "
                                      f"epoch {epoch}")
                 last_loss = float(loss)
+                from .precision import observe_scale_state
+                skipped_seen = observe_scale_state(scale_state,
+                                                   skipped_seen)
                 # the enclosing `with guard:` is the fit-serialization
                 # lock, held for the whole fit BY DESIGN (it serializes
                 # collective fits); logging under it is inherent, not a
@@ -1056,7 +1216,8 @@ class TpuLearner(Estimator):
                         f"training diverged: epoch {epoch} loss {last_loss} "
                         f"(lr={self.getLearningRate()})")
                 if self.getCheckpointDir():
-                    self._save_checkpoint(epoch, params, opt_state)
+                    self._save_checkpoint(epoch, params, opt_state,
+                                          scale_state=scale_state)
 
         return self._package_model(cfg, params, last_loss)
 
@@ -1114,7 +1275,8 @@ class TpuLearner(Estimator):
 
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state,
-                    scan_fn=None, start_step=0, elastic_ctx=None):
+                    scan_fn=None, start_step=0, elastic_ctx=None,
+                    scale_state=None):
         if scan_fn is not None:
             if start_step:
                 # the scan path cannot enter an epoch mid-way (one dispatch
@@ -1127,7 +1289,8 @@ class TpuLearner(Estimator):
             return self._run_epochs_scan(start_epoch, x, y, n, bs, steps,
                                          order_rng=order_rng, mesh=mesh,
                                          scan_fn=scan_fn, params=params,
-                                         opt_state=opt_state)
+                                         opt_state=opt_state,
+                                         scale_state=scale_state)
         import time
         from ..parallel import prefetch as prefetchlib
         if steps <= 0:
@@ -1198,6 +1361,7 @@ class TpuLearner(Estimator):
                            meshlib.put_global_batch(yb, mesh), wb)
 
         last_loss = None
+        skipped_seen = 0
         t_epoch = time.perf_counter()
         it = prefetchlib.prefetched(produce, depth=self.getPrefetchDepth(),
                                     name="fit-feed", span="fit/prefetch")
@@ -1208,16 +1372,20 @@ class TpuLearner(Estimator):
                 t_step = time.perf_counter()
                 with telemetry.trace.span("fit/step", epoch=epoch,
                                           step=s) as sp:
-                    def dispatch(_a, p=params, o=opt_state, xb=xb, yb=yb,
-                                 wb=wb):
+                    def dispatch(_a, p=params, o=opt_state,
+                                 ss=scale_state, xb=xb, yb=yb, wb=wb):
                         if elastic_ctx is not None:
                             # host-loss check + elastic.step fault site;
                             # HostLossError is non-transient, so it skips
                             # the retry and unwinds to the re-mesh
                             elastic_ctx.check_step()
                         faults.inject("trainer.step")
-                        return train_step(p, o, xb, yb, wb)
-                    params, opt_state, loss = _STEP_RETRY.run(dispatch)
+                        if ss is None:
+                            p2, o2, loss = train_step(p, o, xb, yb, wb)
+                            return p2, o2, None, loss
+                        return train_step(p, o, ss, xb, yb, wb)
+                    params, opt_state, scale_state, loss = \
+                        _STEP_RETRY.run(dispatch)
                     sp.set_sync(loss)
                 _m_step_time.observe(time.perf_counter() - t_step)
                 if elastic_ctx is not None:
@@ -1226,7 +1394,8 @@ class TpuLearner(Estimator):
                     if ckpt_every and (s + 1) % ckpt_every == 0 \
                             and jax.process_index() == 0:
                         self._save_checkpoint(epoch, params, opt_state,
-                                              step=s)
+                                              step=s,
+                                              scale_state=scale_state)
                     continue
                 # ---- epoch finalize (an early exit below must stop the
                 # producer promptly: the finally closes the prefetcher) ----
@@ -1234,6 +1403,9 @@ class TpuLearner(Estimator):
                 _m_rows_per_sec.set(
                     steps * bs / max(time.perf_counter() - t_epoch, 1e-9))
                 t_epoch = time.perf_counter()
+                from .precision import observe_scale_state
+                skipped_seen = observe_scale_state(scale_state,
+                                                   skipped_seen)
                 log.info("epoch %d loss %.4f", epoch, last_loss)
                 if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
                     last_good = self._latest_checkpoint() \
@@ -1247,13 +1419,15 @@ class TpuLearner(Estimator):
                            else "Set checkpointDir to make divergence "
                                 "resumable."))
                 if self.getCheckpointDir() and jax.process_index() == 0:
-                    self._save_checkpoint(epoch, params, opt_state)
+                    self._save_checkpoint(epoch, params, opt_state,
+                                          scale_state=scale_state)
         finally:
             it.close()
         return params, opt_state, last_loss
 
     def _run_epochs_scan(self, start_epoch, x, y, n, bs, steps, *,
-                         order_rng, mesh, scan_fn, params, opt_state):
+                         order_rng, mesh, scan_fn, params, opt_state,
+                         scale_state=None):
         """Single-host fast path: the epoch data lives in HBM (padded to
         ``steps*bs_pad`` rows, pad rows weight 0) and every epoch is one
         XLA dispatch — a random rotation plus a random permutation of the
@@ -1298,6 +1472,7 @@ class TpuLearner(Estimator):
         kpd = self.getStepsPerDispatch() or steps
         base = np.arange(steps, dtype=np.int32) * bs_pad
         last_loss = None
+        skipped_seen = 0
         import time
         for epoch in range(start_epoch, self.getEpochs()):
             t_epoch = time.perf_counter()
@@ -1318,17 +1493,26 @@ class TpuLearner(Estimator):
                     with telemetry.trace.span(
                             "fit/step", epoch=epoch, first_step=lo,
                             steps=min(kpd, steps - lo)) as sp:
-                        def dispatch(_a, p=params, o=opt_state, lo=lo):
+                        def dispatch(_a, p=params, o=opt_state,
+                                     ss=scale_state, lo=lo):
                             faults.inject("trainer.step")
-                            return scan_fn(p, o, x_dev, y_dev, w_dev,
+                            if ss is None:
+                                p2, o2, loss = scan_fn(
+                                    p, o, x_dev, y_dev, w_dev,
+                                    starts[lo:lo + kpd])
+                                return p2, o2, None, loss
+                            return scan_fn(p, o, ss, x_dev, y_dev, w_dev,
                                            starts[lo:lo + kpd])
-                        params, opt_state, loss = _STEP_RETRY.run(dispatch)
+                        params, opt_state, scale_state, loss = \
+                            _STEP_RETRY.run(dispatch)
                         sp.set_sync(loss)
                     _m_step_time.observe(time.perf_counter() - t_disp)
                 ep_sp.set_sync(loss)
             last_loss = float(loss)
             _m_rows_per_sec.set(steps * bs_pad
                                 / max(time.perf_counter() - t_epoch, 1e-9))
+            from .precision import observe_scale_state
+            skipped_seen = observe_scale_state(scale_state, skipped_seen)
             log.info("epoch %d loss %.4f (%d-step dispatches)",
                      epoch, last_loss, min(kpd, steps))
             if self.getHaltOnNonFinite() and not np.isfinite(last_loss):
@@ -1342,5 +1526,6 @@ class TpuLearner(Estimator):
                        if last_good is not None
                        else "Set checkpointDir to make divergence resumable."))
             if self.getCheckpointDir():
-                self._save_checkpoint(epoch, params, opt_state)
+                self._save_checkpoint(epoch, params, opt_state,
+                                      scale_state=scale_state)
         return params, opt_state, last_loss

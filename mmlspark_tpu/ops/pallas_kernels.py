@@ -571,6 +571,175 @@ def mxu_node_histogram(bins_t, node, g, h, *, n_nodes: int,
     return hg.transpose(1, 0, 2), hh.transpose(1, 0, 2)
 
 
+# ------------------------------------------------- GBDT quantized predict
+
+#: pallas predict eligibility caps: the per-tree traversal unrolls one
+#: compare-select per internal node (level-wise) or split round (leaf-
+#: wise) plus one per leaf — past these the unroll outgrows what Mosaic
+#: schedules well, and the engine's dense path (which streams past the
+#: same bound via its test-table guards) is the right tool anyway.
+PREDICT_QUANT_MAX_NODES = 127     # 2^depth - 1  (mirrors engine's cap)
+PREDICT_QUANT_MAX_LEAVES = 128
+
+
+def _gbdt_quant_lvl_kernel(feat_ref, thr_ref, leaf_ref, bins_ref, out_ref,
+                           *, n_trees: int, n_class: int, depth: int):
+    """Grid = (row_blocks,). One row block's uint8 bins stay VMEM-resident
+    while EVERY tree of the ensemble walks it: per level the node's
+    feature row is one dynamic-sublane VMEM load and the heap descent is
+    a pure compare-select chain (VPU elementwise — no (nodes, n) test
+    table ever exists, in VMEM or HBM). The tree tables ride the scalar-
+    prefetch path (SMEM), so feature/threshold lookups are scalar reads
+    indexed by the fori_loop tree counter."""
+    bn = out_ref.shape[1]
+    n_leaves = 2 ** depth
+
+    def tree_body(t, acc):
+        for k in range(n_class):
+            pos = jnp.zeros((bn,), jnp.int32)
+            for level in range(depth):
+                off = 2 ** level - 1
+                go_right = jnp.zeros((bn,), jnp.bool_)
+                for j in range(2 ** level):
+                    f = feat_ref[t, k, off + j]
+                    thr = thr_ref[t, k, off + j]
+                    row = pl.load(bins_ref,
+                                  (pl.ds(f, 1), slice(None)))[0]
+                    test = row.astype(jnp.int32) > thr
+                    go_right = jnp.where(pos == j, test, go_right)
+                pos = pos * 2 + go_right.astype(jnp.int32)
+            contrib = jnp.zeros((bn,), jnp.float32)
+            for leaf_id in range(n_leaves):
+                contrib = jnp.where(pos == leaf_id,
+                                    leaf_ref[t, k, leaf_id], contrib)
+            acc = acc.at[k].add(contrib)
+        return acc
+
+    out_ref[:] = jax.lax.fori_loop(
+        0, n_trees, tree_body, jnp.zeros((n_class, bn), jnp.float32))
+
+
+def _gbdt_quant_lw_kernel(split_ref, feat_ref, thr_ref, leaf_ref, bins_ref,
+                          out_ref, *, n_trees: int, n_class: int,
+                          n_rounds: int, n_leaves: int):
+    """Leaf-wise twin: replay the split sequence (round r splits leaf
+    ``split_ref[t,k,r]``, right child becomes leaf r+1) as compare-
+    selects over the VMEM-resident row block. A no-op round stores
+    split_leaf -1, which can never equal a (>= 0) position — the skip
+    needs no branch."""
+    bn = out_ref.shape[1]
+
+    def tree_body(t, acc):
+        for k in range(n_class):
+            pos = jnp.zeros((bn,), jnp.int32)
+            for r in range(n_rounds):
+                s = split_ref[t, k, r]
+                f = feat_ref[t, k, r]
+                thr = thr_ref[t, k, r]
+                row = pl.load(bins_ref, (pl.ds(f, 1), slice(None)))[0]
+                right = (pos == s) & (row.astype(jnp.int32) > thr)
+                pos = jnp.where(right, r + 1, pos)
+            contrib = jnp.zeros((bn,), jnp.float32)
+            for leaf_id in range(n_leaves):
+                contrib = jnp.where(pos == leaf_id,
+                                    leaf_ref[t, k, leaf_id], contrib)
+            acc = acc.at[k].add(contrib)
+        return acc
+
+    out_ref[:] = jax.lax.fori_loop(
+        0, n_trees, tree_body, jnp.zeros((n_class, bn), jnp.float32))
+
+
+def _quant_predict_call(kernel, bins_t, scalar_args, n_class: int,
+                        block_n: int, interpret):
+    """Shared pallas_call driver for both quantized predict kernels:
+    pad the (d, n) uint8 matrix to tile-friendly blocks, prefetch the
+    scalar tree tables, return (n, K) f32 contributions (no base)."""
+    d, n = bins_t.shape
+    interpret = _interpret() if interpret is None else interpret
+    block_n = max(128, min(block_n, -(-n // 128) * 128))
+    pad_n = (-n) % block_n
+    pad_d = (-d) % 32          # uint8 sublane tile is 32-deep
+    if pad_n or pad_d:
+        bins_t = jnp.pad(bins_t, ((0, pad_d), (0, pad_n)))
+    nblk = bins_t.shape[1] // block_n
+    d_pad = d + pad_d
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=len(scalar_args),
+        grid=(nblk,),
+        in_specs=[pl.BlockSpec((d_pad, block_n), lambda i, *_: (0, i))],
+        out_specs=pl.BlockSpec((n_class, block_n), lambda i, *_: (0, i)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_class, bins_t.shape[1]),
+                                       jnp.float32),
+        interpret=interpret,
+    )(*scalar_args, bins_t)
+    return out[:, :n].T
+
+
+def gbdt_predict_quant_levelwise(bins_t, feature, threshold, leaf, *,
+                                 depth: int, block_n: int = 512,
+                                 interpret=None):
+    """Quantized level-wise ensemble predict: one Pallas dispatch scores
+    every tree against uint8 rows that never leave VMEM.
+
+    bins_t (d, n) uint8 — the transposed bin matrix (the predict wire
+    format); feature/threshold (T, K, 2^depth - 1) uint8 — the
+    structure-of-arrays quantized test tables (threshold carries the
+    255-clamped route-all-left sentinel, see engine.quantize_ensemble);
+    leaf (T, K, 2^depth) bf16. Returns (n, K) f32 — the summed leaf
+    contributions, base NOT included (callers add it; keeps the kernel a
+    pure ensemble reduction).
+
+    Contrast with the dense path (engine._predict_tree_t): that one
+    stages a (2^depth - 1, n) bool test table per tree in HBM (bounded
+    by the _TEST_TABLE byte caps) and re-reads the f32/int32 tree
+    arrays per tree; here rows are read ONCE per (block, node-visit)
+    from VMEM, the tables are uint8/bf16, and the only HBM traffic is
+    the bin matrix in and (K, n) f32 out. Runs in interpret mode
+    off-TPU (CPU CI) — same results, no Mosaic."""
+    T, K, n_nodes = feature.shape
+    assert n_nodes <= PREDICT_QUANT_MAX_NODES, (n_nodes, "unroll cap")
+    assert 2 ** depth <= PREDICT_QUANT_MAX_LEAVES, depth
+    kernel = functools.partial(_gbdt_quant_lvl_kernel, n_trees=T,
+                               n_class=K, depth=depth)
+    scalars = (jnp.asarray(feature, jnp.int32),
+               jnp.asarray(threshold, jnp.int32),
+               # exact widening of the stored bf16 table (scalar memory
+               # holds f32; the quantization already happened at the
+               # bf16 round)  # precision: exact bf16->f32 widening
+               jnp.asarray(leaf).astype(jnp.float32))
+    return _quant_predict_call(kernel, bins_t, scalars, K, block_n,
+                               interpret)
+
+
+def gbdt_predict_quant_leafwise(bins_t, split_leaf, feature, threshold,
+                                leaf, *, block_n: int = 512,
+                                interpret=None):
+    """Quantized leaf-wise ensemble predict (numeric splits only —
+    categorical bitsets stay on the dense path). split_leaf (T, K, L-1)
+    int32; feature/threshold (T, K, L-1) uint8; leaf (T, K, L) bf16.
+    Returns (n, K) f32 contributions, base not included."""
+    T, K, n_rounds = split_leaf.shape
+    n_leaves = leaf.shape[2]
+    assert n_rounds <= PREDICT_QUANT_MAX_NODES, (n_rounds, "unroll cap")
+    assert n_leaves <= PREDICT_QUANT_MAX_LEAVES, n_leaves
+    kernel = functools.partial(_gbdt_quant_lw_kernel, n_trees=T,
+                               n_class=K, n_rounds=n_rounds,
+                               n_leaves=n_leaves)
+    scalars = (jnp.asarray(split_leaf, jnp.int32),
+               jnp.asarray(feature, jnp.int32),
+               jnp.asarray(threshold, jnp.int32),
+               # precision: exact bf16->f32 widening of the stored table
+               jnp.asarray(leaf).astype(jnp.float32))
+    return _quant_predict_call(kernel, bins_t, scalars, K, block_n,
+                               interpret)
+
+
 def node_sums(node, g, h, n_ids: int, impl: str = "auto"):
     """Per-node grad/hess sums (the leaf-value reduction) without the
     scatter: a one-hot f32 matmul at HIGHEST precision. Measured 11 ms vs

@@ -195,6 +195,51 @@ class TestJitSafety:
         """, rules=["jit-missing-donate"], name="clean.py")
         assert clean == []
 
+    def test_silent_upcast_positive(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                h = x.astype(jnp.bfloat16)
+                y = h * 2.0                 # weak Python literal: stays bf16
+                z = y.astype(jnp.float32)   # silent upcast
+                w = h * jnp.float32(3.0)    # f32-TYPED literal promotion
+                return z + w
+        """, rules=["jit-silent-upcast"])
+        assert len(fs) == 2
+        assert all(f.rule == "jit-silent-upcast" for f in fs)
+
+    def test_silent_upcast_clean_twin(self, tmp_path):
+        clean = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def declared(x):
+                h = x.astype(jnp.bfloat16)
+                # precision: f32 accumulation is deliberate here
+                acc = h.astype(jnp.float32)
+                return acc
+
+            @jax.jit
+            def weak_literals_fine(x):
+                h = jnp.bfloat16(x)
+                return h * 2.0 + 1.0     # weakly-typed floats stay bf16
+
+            @jax.jit
+            def no_bf16_provenance(x):
+                # upcasts of values never cast down are the model's
+                # business (flax logits->f32), not this rule's
+                return (x * 2).astype(jnp.float32)
+
+            def host_helper(x):          # not a traced body
+                h = x.astype(jnp.bfloat16)
+                return h.astype(jnp.float32)
+        """, rules=["jit-silent-upcast"], name="clean.py")
+        assert clean == []
+
     def test_unseeded_random(self, tmp_path):
         fs = lint(tmp_path, """
             import random

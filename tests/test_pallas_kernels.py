@@ -287,3 +287,84 @@ def test_explicit_segment_is_pure_segment(monkeypatch):
         assert calls["mxu"] >= 1     # auto = the MXU kernel on TPU
     else:
         assert calls["cr"] >= 1      # hybrid used the uint8 path
+
+
+# ---------------------------------------------- GBDT quantized predict
+
+
+def _walk_levelwise(bins, feat, thr, leaf, depth):
+    """numpy reference: heap descent over the quantized tables."""
+    n = bins.shape[0]
+    T, K, _ = feat.shape
+    out = np.zeros((n, K), np.float32)
+    for t in range(T):
+        for k in range(K):
+            pos = np.zeros(n, np.int64)
+            for level in range(depth):
+                node = 2 ** level - 1 + pos
+                f = feat[t, k, node]
+                go_right = bins[np.arange(n), f].astype(np.int64) \
+                    > thr[t, k, node]
+                pos = pos * 2 + go_right
+            out[:, k] += leaf[t, k][pos]
+    return out
+
+
+def _walk_leafwise(bins, split, feat, thr, leaf):
+    """numpy reference: replay the split sequence over the tables."""
+    n = bins.shape[0]
+    T, K, R = split.shape
+    out = np.zeros((n, K), np.float32)
+    for t in range(T):
+        for k in range(K):
+            pos = np.zeros(n, np.int64)
+            for r in range(R):
+                right = (pos == split[t, k, r]) & (
+                    bins[np.arange(n), feat[t, k, r]].astype(np.int64)
+                    > thr[t, k, r])
+                pos[right] = r + 1
+            out[:, k] += leaf[t, k][pos]
+    return out
+
+
+def test_gbdt_quant_levelwise_kernel_matches_reference(rng):
+    """The tile-resident quantized predict kernel (interpret mode on
+    CPU) vs a pure-numpy table walk — including the 255 route-all-left
+    sentinel and non-tile-aligned (n, d)."""
+    from mmlspark_tpu.ops.pallas_kernels import gbdt_predict_quant_levelwise
+    T, K, depth, d, n = 7, 3, 4, 11, 777       # nothing tile-aligned
+    nodes, leaves = 2 ** depth - 1, 2 ** depth
+    bins = rng.integers(0, 32, size=(n, d)).astype(np.uint8)
+    feat = rng.integers(0, d, size=(T, K, nodes)).astype(np.uint8)
+    thr = rng.integers(0, 32, size=(T, K, nodes)).astype(np.uint8)
+    thr[0, 0, 0] = 255                  # route-all-left sentinel
+    leaf32 = rng.normal(size=(T, K, leaves)).astype(np.float32)
+    leaf = jnp.asarray(leaf32).astype(jnp.bfloat16)
+    out = gbdt_predict_quant_levelwise(
+        jnp.asarray(bins.T), feat, thr, leaf, depth=depth, block_n=128)
+    ref = _walk_levelwise(bins, feat, thr,
+                          np.asarray(leaf, np.float32), depth)
+    assert out.shape == (n, K)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)
+
+
+def test_gbdt_quant_leafwise_kernel_matches_reference(rng):
+    """Leaf-wise twin, including -1 no-op split rounds (a stopped-early
+    tree) which must never move any row."""
+    from mmlspark_tpu.ops.pallas_kernels import gbdt_predict_quant_leafwise
+    T, K, R, d, n = 5, 1, 9, 6, 333
+    bins = rng.integers(0, 64, size=(n, d)).astype(np.uint8)
+    split = np.stack([
+        rng.integers(0, r + 1, size=(K, R)) for r in range(T)
+    ]).astype(np.int32)
+    split[2, :, 5:] = -1                # tree 2 stopped after 5 rounds
+    feat = rng.integers(0, d, size=(T, K, R)).astype(np.uint8)
+    thr = rng.integers(0, 64, size=(T, K, R)).astype(np.uint8)
+    leaf32 = rng.normal(size=(T, K, R + 1)).astype(np.float32)
+    leaf = jnp.asarray(leaf32).astype(jnp.bfloat16)
+    out = gbdt_predict_quant_leafwise(
+        jnp.asarray(bins.T), split, feat, thr, leaf, block_n=128)
+    ref = _walk_leafwise(bins, split, feat, thr,
+                         np.asarray(leaf, np.float32))
+    assert out.shape == (n, K)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-6)

@@ -1,0 +1,278 @@
+"""Mixed-precision trainer: precision modes, dynamic loss scaling,
+fused-step donation semantics, and checkpointed scale state.
+
+What the suite pins down:
+
+  * parity — a ``bf16_mixed`` fit lands in the same accuracy band as the
+    ``f32`` one on the tier-1 toy dataset, and is BIT-identical to plain
+    ``bf16`` when no step skips (power-of-two loss scaling is exact);
+  * the skip/backoff recurrence — a non-finite gradient leaves
+    params/opt_state untouched, halves the scale, and counts the skip
+    (unit-level on the fused body, and end-to-end through fit() with an
+    inf feature row + the telemetry gauges);
+  * checkpoint round-trip — a fit killed mid-epoch checkpoints f32
+    master params PLUS the live scale state, and the resumed fit
+    continues from the exact scale it was killed at.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mmlspark_tpu import DataFrame, telemetry
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models import precision as prec
+from mmlspark_tpu.models.trainer import (TpuLearner, _make_mixed_step_body,
+                                         make_loss, make_optimizer)
+from mmlspark_tpu.models.modules import build_model
+from mmlspark_tpu.resilience import faults
+
+
+@pytest.fixture
+def telemetry_on():
+    telemetry.enable()
+    telemetry.registry.reset()
+    yield
+    telemetry.registry.reset()
+    telemetry.disable()
+
+
+def _df(n=256, seed=0, inf_rows=()):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = (x[:, 0] + 0.5 * x[:, 1] > 0).astype(np.int64)
+    for i in inf_rows:
+        x[i] = np.inf
+    return DataFrame({"features": object_column([r for r in x]),
+                      "label": y})
+
+
+def _learner(mode, **kw):
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [16],
+                                "num_classes": 2})
+               .setEpochs(3).setBatchSize(32).setLearningRate(0.1)
+               .setPrecision(mode))
+    for k, v in kw.items():
+        getattr(learner, f"set{k[0].upper()}{k[1:]}")(v)
+    return learner
+
+
+def _accuracy(model, df):
+    out = model.transform(df)
+    pred = np.stack(list(out.col("scores"))).argmax(axis=1)
+    return float((pred == np.asarray(df.col("label"))).mean())
+
+
+# ------------------------------------------------------------------ parity
+
+def test_bf16_mixed_reaches_f32_accuracy_band():
+    """The tentpole's correctness bar: the mixed fit trains as well as
+    the full-precision one on the tier-1 toy task (both paths: scan and
+    per-step feed)."""
+    df = _df(512)
+    acc_f32 = _accuracy(_learner("f32").fit(df), df)
+    acc_mixed = _accuracy(_learner("bf16_mixed").fit(df), df)
+    assert acc_f32 >= 0.9, acc_f32
+    assert abs(acc_mixed - acc_f32) <= 0.05, (acc_mixed, acc_f32)
+    # feed path (deviceDataCap=1 forces per-step host feed)
+    acc_mixed_feed = _accuracy(
+        _learner("bf16_mixed", deviceDataCap=1).fit(df), df)
+    assert abs(acc_mixed_feed - acc_f32) <= 0.05, (acc_mixed_feed, acc_f32)
+
+
+def test_bf16_mixed_bit_identical_to_bf16_when_no_skips():
+    """Power-of-two loss scaling is EXACT in floating point: with no
+    skipped steps, the mixed fit's final loss equals plain bf16's bit
+    for bit — the strongest check that the fused scale/unscale pipeline
+    changes nothing but safety."""
+    df = _df(256)
+    loss_bf16 = _learner("bf16").fit(df)._final_loss
+    loss_mixed = _learner("bf16_mixed").fit(df)._final_loss
+    assert loss_bf16 == loss_mixed, (loss_bf16, loss_mixed)
+
+
+def test_precision_sets_model_config_dtype():
+    df = _df(64)
+    m32 = _learner("f32").setEpochs(1).fit(df)
+    assert m32.getModelConfig()["dtype"] == "float32"
+    mbf = _learner("bf16").setEpochs(1).fit(df)
+    assert "dtype" not in mbf.getModelConfig()   # default mode: untouched
+
+
+def test_mixed_rejects_pipeline_parallel():
+    with pytest.raises(ValueError, match="bf16_mixed"):
+        (_learner("bf16_mixed").setPipelineParallel(2)
+         .setModelConfig({"type": "transformer", "layers": 2})
+         .fit(_df(64)))
+
+
+def test_fit_stream_mixed():
+    """fitStream rides the same fused mixed step."""
+    rng = np.random.default_rng(0)
+
+    def batches():
+        for _ in range(6):
+            x = rng.normal(size=(32, 8)).astype(np.float32)
+            yield x, (x[:, 0] > 0).astype(np.int64)
+
+    model = _learner("bf16_mixed").setEpochs(2).fitStream(batches)
+    assert np.isfinite(model._final_loss)
+
+
+# -------------------------------------------------- skip/backoff recurrence
+
+def _mixed_step(grad_clip=0.0):
+    cfg = {"type": "mlp", "hidden": [8], "num_classes": 2,
+           "dtype": "bfloat16"}
+    module = build_model(cfg)
+    tx = make_optimizer("sgd", 0.1)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((2, 4), jnp.float32))
+    opt = tx.init(params)
+    body = jax.jit(_make_mixed_step_body(
+        module, tx, make_loss("cross_entropy", per_example=True), False,
+        0.0, grad_clip))
+    return body, params, opt
+
+
+def test_mixed_step_skips_on_nonfinite_grad():
+    """Unit-level recurrence check on the fused body: an inf batch
+    produces non-finite grads -> params/opt byte-identical, scale
+    halved, skip counted; the next clean batch updates normally at the
+    backed-off scale."""
+    body, params, opt = _mixed_step()
+    state = prec.init_scale_state(2.0 ** 10)
+    xb_bad = jnp.full((4, 4), jnp.inf, jnp.float32)
+    yb = jnp.zeros(4, jnp.int32)
+    wb = jnp.ones(4, jnp.float32)
+    p2, o2, s2, _ = body(params, opt, state, xb_bad, yb, wb)
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(s2.scale) == 2.0 ** 9          # backed off
+    assert int(s2.skipped) == 1
+    assert int(s2.growth) == 0
+
+    xb_ok = jnp.ones((4, 4), jnp.float32)
+    p3, o3, s3, loss = body(p2, o2, s2, xb_ok, yb, wb)
+    assert np.isfinite(float(loss))
+    assert float(s3.scale) == 2.0 ** 9          # no further move
+    assert int(s3.skipped) == 1
+    assert int(s3.growth) == 1
+    moved = any(not np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(jax.tree_util.tree_leaves(p2),
+                                jax.tree_util.tree_leaves(p3)))
+    assert moved, "clean step must update params"
+
+
+def test_scale_grows_after_interval():
+    grown = prec.update_scale(
+        prec.ScaleState(jnp.float32(8.0),
+                        jnp.int32(prec.GROWTH_INTERVAL - 1),
+                        jnp.int32(0)), jnp.bool_(True))
+    assert float(grown.scale) == 16.0
+    assert int(grown.growth) == 0
+    capped = prec.update_scale(
+        prec.ScaleState(jnp.float32(prec.MAX_SCALE),
+                        jnp.int32(prec.GROWTH_INTERVAL - 1),
+                        jnp.int32(0)), jnp.bool_(True))
+    assert float(capped.scale) == prec.MAX_SCALE
+    floored = prec.update_scale(
+        prec.ScaleState(jnp.float32(1.0), jnp.int32(0), jnp.int32(0)),
+        jnp.bool_(False))
+    assert float(floored.scale) == prec.MIN_SCALE
+
+
+def test_grad_clip_applies_in_mixed_step():
+    body, params, opt = _mixed_step(grad_clip=1e-6)
+    state = prec.init_scale_state(2.0 ** 10)
+    xb = jnp.ones((4, 4), jnp.float32)
+    yb = jnp.zeros(4, jnp.int32)
+    wb = jnp.ones(4, jnp.float32)
+    p2, _, _, _ = body(params, opt, state, xb, yb, wb)
+    # a near-zero clip norm freezes the update to numerical dust
+    delta = max(float(jnp.abs(a - b).max())
+                for a, b in zip(jax.tree_util.tree_leaves(params),
+                                jax.tree_util.tree_leaves(p2)))
+    assert delta < 1e-5, delta
+
+
+def test_backoff_triggers_in_fit_with_inf_row(telemetry_on):
+    """End-to-end: one inf feature row (first batch, shuffle off) makes
+    the first step's gradients non-finite -> that step skips, the scale
+    backs off once per epoch, and the telemetry gauges record it —
+    while the fit still converges on the clean rows."""
+    df = _df(256, inf_rows=(0,))
+    learner = (_learner("bf16_mixed", deviceDataCap=1)
+               .setShuffle(False).setEpochs(2)
+               .setLossScaleInit(float(2.0 ** 12)))
+    model = learner.fit(df)
+    assert np.isfinite(model._final_loss)
+    snap = telemetry.snapshot()
+    # snapshot keys use registered names; /metrics exposition appends
+    # the _total suffix (registry normalization, PR 5)
+    skipped = snap["mmlspark_trainer_skipped_steps"]["series"][0]
+    assert skipped["value"] == 2                 # one skip per epoch
+    scale = snap["mmlspark_trainer_loss_scale"]["series"][0]
+    assert scale["value"] == float(2.0 ** 10)    # halved twice
+
+
+# ----------------------------------------------------- checkpoint round-trip
+
+def test_ckpt_roundtrip_scale_state_and_f32_masters(tmp_path):
+    """Kill-and-resume with the scale recurrence live: the step
+    checkpoint stores f32 masters + the backed-off scale; the resumed
+    fit restores BOTH (scale continues at the killed value, not the
+    init) and completes."""
+    ck = str(tmp_path / "ck")
+    df = _df(64, inf_rows=(0,))           # 64 rows / bs 8 -> 8 steps
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [8],
+                                "num_classes": 2})
+               .setEpochs(1).setBatchSize(8).setLearningRate(0.05)
+               .setPrecision("bf16_mixed")
+               .setLossScaleInit(float(2.0 ** 12))
+               .setShuffle(False).setDeviceDataCap(1)
+               .setCheckpointDir(ck).setCheckpointEverySteps(2))
+    faults.configure("trainer.step:error:1.0:5", seed=0)  # die at step 5
+    try:
+        with pytest.raises(ConnectionError):
+            learner.fit(df)
+    finally:
+        faults.clear()
+    names = sorted(os.listdir(ck))
+    assert "ckpt_00000_s0000003.msgpack" in names
+
+    from flax import serialization
+    with open(os.path.join(ck, "ckpt_00000_s0000003.msgpack"), "rb") as f:
+        state = serialization.msgpack_restore(f.read())
+    # the inf row skipped step 0: the stored scale is the backed-off one
+    assert state["scale"]["scale"] == float(2.0 ** 11)
+    assert state["scale"]["skipped"] == 1
+    leaves = jax.tree_util.tree_leaves(state["params"])
+    assert all(np.asarray(leaf).dtype == np.float32 for leaf in leaves), \
+        "checkpoints must store f32 masters in every precision mode"
+
+    resumed = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [8],
+                                "num_classes": 2})
+               .setEpochs(1).setBatchSize(8).setLearningRate(0.05)
+               .setPrecision("bf16_mixed")
+               .setLossScaleInit(float(2.0 ** 12))
+               .setShuffle(False).setDeviceDataCap(1)
+               .setCheckpointDir(ck).setCheckpointEverySteps(2))
+    model = resumed.fit(df)
+    assert np.isfinite(model._final_loss)
+    # the epoch-final checkpoint carries the CONTINUED scale (the inf
+    # row lives in already-committed step 0, so no new skip): still the
+    # backed-off value, proving the resume restored it rather than
+    # restarting from lossScaleInit
+    with open(os.path.join(ck, "ckpt_00000.msgpack"), "rb") as f:
+        final = serialization.msgpack_restore(f.read())
+    assert final["scale"]["scale"] == float(2.0 ** 11)
+    assert final["scale"]["skipped"] == 1
