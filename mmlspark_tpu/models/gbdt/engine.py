@@ -826,24 +826,79 @@ def _loss(raw, y, objective: str, alpha):
 
 def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
              mesh=None, sample_weight: Optional[np.ndarray] = None,
-             eval_set: Optional[tuple] = None) -> TreeEnsemble:
+             eval_set: Optional[tuple] = None,
+             elastic_ctx=None) -> TreeEnsemble:
     """Train a boosted ensemble. With a `mesh`, `params.tree_learner` picks
     the distributed mode: "data" shards rows and psums histograms over ICI
     (explicit shard_map — LightGBM's socket-allreduce ring), "feature"
     splits histogram work by feature with all_gather'ed split candidates,
-    "auto" shards rows and lets XLA auto-SPMD place the collectives."""
+    "auto" shards rows and lets XLA auto-SPMD place the collectives.
+
+    ``elastic_ctx`` (an :class:`~...resilience.elastic.ElasticStepContext`)
+    makes the boosting loop preemption-tolerant: every iteration passes
+    the per-step host-loss/grow check, and the completed boosting state
+    (trees so far, raw scores, RNG streams, early-stopping bookkeeping)
+    is snapshotted host-side as the per-iteration checkpoint candidate a
+    re-meshed attempt resumes from — see :func:`fit_gbdt_elastic`."""
     with telemetry.trace.span("gbdt/fit", rows=int(x.shape[0]),
                               features=int(x.shape[1]),
                               objective=params.objective,
                               iterations=params.num_iterations):
         return _fit_gbdt_impl(x, y, params, mesh=mesh,
                               sample_weight=sample_weight,
-                              eval_set=eval_set)
+                              eval_set=eval_set, elastic_ctx=elastic_ctx)
+
+
+def fit_gbdt_elastic(x: np.ndarray, y: np.ndarray, params: GBDTParams,
+                     *, checkpoint_dir: str, n_hosts: int = 0,
+                     min_hosts: int = 1, grace: Optional[float] = None,
+                     max_failures: int = 5,
+                     heartbeat_interval: Optional[float] = None,
+                     max_hosts: int = 0,
+                     sample_weight: Optional[np.ndarray] = None,
+                     eval_set: Optional[tuple] = None) -> TreeEnsemble:
+    """Elastic boosted fit: drives :func:`fit_gbdt` through the
+    :class:`~...resilience.elastic.ElasticFitCoordinator` recovery loop,
+    so a host lost mid-boosting raises ``HostLossError`` -> re-mesh over
+    the survivors -> resume from the last completed iteration's
+    boosting-state snapshot (and a relaunched host grows the mesh back
+    at the next iteration boundary) instead of the fit dying.
+
+    ``x``/``y`` are the RAW (unpadded) rows: each attempt pads to its
+    own (possibly shrunk or regrown) device multiple. ``checkpoint_dir``
+    hosts the heartbeat files; the boosting state itself resumes from
+    the coordinator's in-memory snapshot (trees are cheap host arrays —
+    msgpack durability is the trainer's problem, liveness is this one's).
+    """
+    from ...parallel import mesh as meshlib
+    from ...resilience.elastic import ElasticFitCoordinator
+    if params.tree_learner not in ("data", "auto"):
+        raise ValueError(
+            "elastic GBDT fits shard rows (tree_learner=data|auto), got "
+            f"{params.tree_learner!r}")
+    coord = ElasticFitCoordinator(
+        checkpoint_dir=checkpoint_dir, n_hosts=n_hosts,
+        min_hosts=min_hosts, grace=grace, max_failures=max_failures,
+        heartbeat_interval=heartbeat_interval, max_hosts=max_hosts)
+
+    def attempt(devices, ctx):
+        mesh = meshlib.create_mesh(devices=devices)
+        xp, n_real = meshlib.pad_batch_to_devices(x, mesh)
+        yp = np.concatenate([y, np.zeros(len(xp) - n_real, y.dtype)])
+        w = (np.ones(n_real, np.float32) if sample_weight is None
+             else np.asarray(sample_weight, np.float32))
+        w = np.concatenate([w, np.zeros(len(xp) - n_real, np.float32)])
+        with meshlib.collective_fit_lock:
+            return fit_gbdt(xp, yp, params, mesh=mesh, sample_weight=w,
+                            eval_set=eval_set, elastic_ctx=ctx)
+
+    return coord.run(attempt)
 
 
 def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                    mesh=None, sample_weight: Optional[np.ndarray] = None,
-                   eval_set: Optional[tuple] = None) -> TreeEnsemble:
+                   eval_set: Optional[tuple] = None,
+                   elastic_ctx=None) -> TreeEnsemble:
     # persistent compile cache: a first single-process fit in a fresh
     # interpreter otherwise pays full XLA recompile of cacheable programs
     from ...parallel.distributed import configure_xla_cache
@@ -1046,8 +1101,56 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         return jnp.asarray(row_mask)
 
     lr_eff = 1.0 if is_rf else p.learning_rate
-    for it in range(p.num_iterations):
+
+    # ---- elastic resume: re-enter from the latest boosting snapshot ----
+    # (single-process failure domains only; a real multi-process fleet
+    # uses the coordinator's detection + fail-fast + relaunch path)
+    start_it = 0
+    row_mask_host = None
+    elastic_snap = elastic_ctx is not None and nproc == 1
+    if elastic_snap:
+        snap = elastic_ctx.latest_snapshot()
+        if snap is not None:
+            start_it = snap["it"] + 1
+            feats = list(snap["feats"])
+            thrs = list(snap["thrs"])
+            leaves = list(snap["leaves"])
+            best_loss, since_best, best_iter = snap["best"]
+            # the RNG streams continue EXACTLY where the lost attempt
+            # left them: bagging masks and feature fractions replay
+            # deterministically from the snapshot point
+            rng.bit_generator.state = snap["rng"]
+            feat_rng.bit_generator.state = snap["feat_rng"]
+            k = min(len(snap["raw"]), n)
+            raw_host = np.broadcast_to(base[None, :], (n, K)) \
+                .astype(np.float32).copy()
+            raw_host[:k] = snap["raw"][:k]     # pad rows train at weight 0
+            if shard_rows:
+                from ...parallel import mesh as _ml
+                raw = _ml.put_global_batch(raw_host, mesh)
+            else:
+                raw = jnp.asarray(raw_host)
+            if snap.get("row_mask") is not None:
+                mask = np.zeros(n, np.float32)
+                mask[:k] = snap["row_mask"][:k]
+                row_mask_host = mask
+                rm = _ship_row_mask(mask)
+            if eval_set is not None and snap.get("raw_val") is not None:
+                raw_val = jnp.asarray(snap["raw_val"])
+            from ...core.utils import get_logger
+            get_logger("gbdt").info(
+                "elastic resume: re-entering the boosting loop at "
+                "iteration %d (%d trees restored)", start_it, len(leaves))
+        elastic_ctx.resumed(None if snap is None else (0, snap["it"]),
+                            None)
+
+    for it in range(start_it, p.num_iterations):
         t_iter = time.perf_counter()
+        if elastic_ctx is not None:
+            # host-loss / grow check (site elastic.step): HostLossError /
+            # HostRejoinError unwind to the coordinator's re-mesh; the
+            # snapshot above is what the next attempt resumes from
+            elastic_ctx.check_step()
         # rf mode (LightGBM boosting=rf): every tree fits the INITIAL
         # gradients on its own bootstrap sample; raw never moves during the
         # fit and leaves are averaged (scaled 1/T) at the end
@@ -1066,11 +1169,13 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 # compound sample_weight geometrically
                 row_mask = (bag_mask if sample_weight is None
                             else bag_mask * sample_weight.astype(np.float32))
+                row_mask_host = row_mask
                 rm = _ship_row_mask(row_mask)
             # else: reuse the device-resident mask from the last refresh
         elif rm is None:
             row_mask = (np.ones(n, dtype=np.float32) if sample_weight is None
                         else sample_weight.astype(np.float32))
+            row_mask_host = row_mask
             rm = _ship_row_mask(row_mask)
         if p.feature_fraction < 1.0:
             fm = (feat_rng.random(d) < p.feature_fraction)
@@ -1185,6 +1290,27 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                 since_best += 1
                 if since_best >= p.early_stopping_round:
                     break
+
+        if elastic_snap:
+            # host-side boosting-state candidate (newest wins): everything
+            # a re-meshed attempt needs to continue bit-exactly from
+            # iteration it+1. checkpoint_saved marks the grow boundary —
+            # for boosted fits the snapshot IS the checkpoint.
+            import jax.tree_util as jtu
+            elastic_ctx.save_snapshot({
+                "it": it,
+                "feats": jtu.tree_map(np.asarray, list(feats)),
+                "thrs": jtu.tree_map(np.asarray, list(thrs)),
+                "leaves": [np.asarray(lv) for lv in leaves],
+                "raw": np.asarray(raw),
+                "raw_val": (np.asarray(raw_val) if eval_set is not None
+                            else None),
+                "row_mask": row_mask_host,
+                "rng": rng.bit_generator.state,
+                "feat_rng": feat_rng.bit_generator.state,
+                "best": (best_loss, since_best, best_iter)})
+            elastic_ctx.step_committed(0, it)
+            elastic_ctx.checkpoint_saved(0, it)
 
     if best_iter is not None:
         feats, thrs, leaves = (feats[:best_iter], thrs[:best_iter],

@@ -16,8 +16,9 @@ import numpy as np
 import jax
 
 from ...core.dataframe import DataFrame
-from ...core.params import (ComplexParam, FloatParam, HasFeaturesCol,
-                            HasLabelCol, IntParam, ListParam, StringParam)
+from ...core.params import (ComplexParam, DictParam, FloatParam,
+                            HasFeaturesCol, HasLabelCol, IntParam,
+                            ListParam, StringParam)
 from ...core.pipeline import Estimator, Model
 from ...core.schema import SparkSchema
 from ...ops.text_ops import rows_to_matrix
@@ -73,6 +74,17 @@ class _BoosterParams:
         choices=("data_parallel", "feature_parallel", "voting_parallel",
                  "serial"))
     seed = IntParam("random seed", default=0)
+    elasticConfig = DictParam(
+        "elastic boosted fit (resilience/elastic.py): "
+        "{'checkpointDir': dir (required; hosts the heartbeat files), "
+        "'hosts': N failure domains (0 = one per process), 'minHosts', "
+        "'graceSeconds', 'maxHosts', 'maxFailures'}. A host lost "
+        "mid-boosting re-meshes over the survivors and resumes from the "
+        "last completed iteration's boosting-state snapshot (a "
+        "relaunched host grows the mesh back at the next iteration "
+        "boundary) instead of the fit dying. Requires "
+        "parallelism=data_parallel (or the auto default) with a "
+        "multi-device mesh", default=None)
     maxDenseFeatures = IntParam(
         "sparse inputs wider than this train on the top-k document-"
         "frequency columns (the dense bin matrix is the device format; "
@@ -418,6 +430,27 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
                                      n_rows=_global_rows(x.shape[0]))
     mesh = params_holder._mesh(x.shape[0])
     nproc = meshlib.effective_process_count()
+    ecfg = params_holder.getOrDefault("elasticConfig")
+    if ecfg:
+        if not ecfg.get("checkpointDir"):
+            raise ValueError("elasticConfig needs 'checkpointDir' (hosts "
+                             "the heartbeat files)")
+        if mesh is None:
+            raise ValueError(
+                "elasticConfig requires a multi-device data-parallel "
+                "mesh (parallelism=data_parallel, >= 2 devices, and a "
+                "fit big enough not to fall back to serial)")
+        # the elastic wrapper pads per attempt (the device multiple
+        # changes when the mesh shrinks or grows), so it takes the RAW
+        # rows rather than this function's pre-padded ones
+        return engine.fit_gbdt_elastic(
+            x, y, p,
+            checkpoint_dir=ecfg["checkpointDir"],
+            n_hosts=int(ecfg.get("hosts", 0)),
+            min_hosts=int(ecfg.get("minHosts", 1)),
+            grace=ecfg.get("graceSeconds"),
+            max_failures=int(ecfg.get("maxFailures", 5)),
+            max_hosts=int(ecfg.get("maxHosts", 0)))
     if nproc > 1 and p.tree_learner not in ("data", "auto"):
         raise ValueError(
             "multi-process GBDT fits shard rows across processes and need "

@@ -496,6 +496,20 @@ class TpuLearner(Estimator):
         "interval instead of the last epoch. Applies to the per-step "
         "feed/stream paths; the scan path's epoch is already one "
         "dispatch. Requires checkpointDir", default=0, min=0)
+    asyncCheckpoint = BooleanParam(
+        "publish checkpoints from a background writer thread "
+        "(resilience/ckpt.py): the step loop takes only the host "
+        "snapshot; serialization, fsync, the atomic rename and the "
+        "manifest commit overlap with the next steps (depth-1 queue, "
+        "newest-wins coalescing, wait() barrier at epoch end / fit "
+        "exit). Lets checkpointEverySteps drop ~10x — a smaller elastic "
+        "replay window — without stalling the fit", default=False)
+    checkpointKeepSteps = IntParam(
+        "step checkpoints retained per epoch (keep-last-K pruning as new "
+        "ones commit; the epoch-final save still clears the rest). The "
+        "checkpoint an elastic fit last resumed from — the consensus "
+        "floor — is never pruned. Bounds a long fit's msgpack "
+        "accumulation at K files per in-flight epoch", default=3, min=1)
     tensorParallel = IntParam("size of the model (TP) mesh axis", default=1,
                               min=1)
     sequenceParallel = IntParam("size of the sequence (SP) mesh axis "
@@ -592,6 +606,12 @@ class TpuLearner(Estimator):
         "transient fit failures tolerated WITHOUT a host verdict before "
         "the elastic loop gives up (failures attributed to a dead host "
         "re-mesh instead and do not burn this budget)", default=5, min=1)
+    elasticMaxHosts = IntParam(
+        "ceiling for in-job GROW: a relaunched host whose joining "
+        "heartbeat earns a grow verdict re-enters the mesh at the next "
+        "checkpoint boundary only while the pool is below this many "
+        "hosts (0 = the launch fleet size). Shrink is unaffected",
+        default=0, min=0)
     sloConfig = DictParam(
         "declarative SLO config evaluated DURING this fit "
         "(telemetry.slo): either a full {'objectives': [...], "
@@ -627,62 +647,165 @@ class TpuLearner(Estimator):
         except ValueError:
             return None
 
-    def _latest_checkpoint(self) -> Optional[tuple]:
-        """The newest training position on disk as ``(epoch, step)`` —
-        ``step is None`` means the epoch completed. An epoch-final
-        checkpoint outranks any step checkpoint of the same epoch."""
+    def _ckpt_candidates(self) -> list:
+        """Every on-disk checkpoint as ``((epoch, step), filename)``,
+        best candidate first (epoch desc; an epoch-final outranks any
+        step checkpoint of its epoch; later steps outrank earlier)."""
         d = self.getCheckpointDir()
         if not d or not os.path.isdir(d):
-            return None
-        found = [p for p in map(self._parse_ckpt_name, os.listdir(d))
-                 if p is not None]
-        if not found:
-            return None
-        return max(found, key=lambda p: (p[0], p[1] is None,
-                                         -1 if p[1] is None else p[1]))
+            return []
+        found = [(p, f) for f in os.listdir(d)
+                 if (p := self._parse_ckpt_name(f)) is not None]
+        found.sort(key=lambda pf: (pf[0][0], pf[0][1] is None,
+                                   -1 if pf[0][1] is None else pf[0][1]),
+                   reverse=True)
+        return found
+
+    def _latest_checkpoint(self) -> Optional[tuple]:
+        """The newest MANIFEST-VERIFIED training position on disk as
+        ``(epoch, step)`` — ``step is None`` means the epoch completed.
+        A file the manifest doesn't vouch for (a torn write: renamed but
+        crashed before the manifest commit, or size drift) is skipped
+        with a warning and ``mmlspark_ckpt_corrupt_total``; the previous
+        checkpoint becomes the candidate. Pre-manifest directories pass
+        verification unconditionally."""
+        from ..resilience import ckpt as ckptlib
+        d = self.getCheckpointDir()
+        for pos, fname in self._ckpt_candidates():
+            if ckptlib.verify(d, fname):
+                return pos
+        return None
+
+    def _ckpt_writer(self):
+        """The per-learner background checkpoint publisher (created on
+        first async save)."""
+        w = getattr(self, "_ckpt_writer_inst", None)
+        if w is None:
+            from ..resilience.ckpt import AsyncCheckpointWriter
+            w = self._ckpt_writer_inst = AsyncCheckpointWriter("trainer")
+        return w
+
+    def _ckpt_barrier(self):
+        """Async-checkpoint barrier: returns once no write is pending or
+        in flight (no-op when asyncCheckpoint never armed). Taken at
+        epoch boundaries, fit exit, and before any resume read. A
+        writer-thread error re-raises here — unless another exception is
+        already unwinding (a HostLossError mid-recovery must not be
+        masked by a failed background write; it is logged instead)."""
+        import sys
+        w = getattr(self, "_ckpt_writer_inst", None)
+        if w is None:
+            return
+        if sys.exc_info()[0] is None:
+            w.wait()
+            return
+        try:
+            w.wait()
+        except Exception as e:
+            log.warning("async checkpoint failure surfaced while another "
+                        "error unwinds (kept secondary): %s", e)
+
+    def _prune_step_checkpoints(self, epoch: int, keep: Optional[int]):
+        """Drop this epoch's step checkpoints beyond the newest ``keep``
+        (``None`` = drop them all — the epoch-final save supersedes
+        them). The consensus floor — the checkpoint this fit resumed
+        from — is never pruned: a re-meshing peer may still target it."""
+        from ..resilience import ckpt as ckptlib
+        d = self.getCheckpointDir()
+        floor = getattr(self, "_ckpt_floor", None)
+        steps = sorted(p[1] for p, _f in self._ckpt_candidates()
+                       if p[0] == epoch and p[1] is not None)
+        drop = steps if keep is None else \
+            (steps[:-keep] if len(steps) > keep else [])
+        names = [f"ckpt_{epoch:05d}_s{s:07d}.msgpack" for s in drop
+                 if floor is None or (epoch, s) != tuple(floor)]
+        ckptlib.prune(d, names)
 
     def _save_checkpoint(self, epoch: int, params, opt_state,
-                         step: Optional[int] = None, scale_state=None):
+                         step: Optional[int] = None, scale_state=None,
+                         elastic_ctx=None,
+                         state_donated: Optional[bool] = None):
+        from ..resilience import ckpt as ckptlib
         os.makedirs(self.getCheckpointDir(), exist_ok=True)
+
         # params are ALWAYS the f32 masters (bf16 compute casts per-layer
         # inside the step and never writes back), so every precision mode
         # checkpoints the same full-precision state; bf16_mixed adds its
         # loss-scale recurrence so a resumed fit continues bit-exact
-        state = {"params": _host_tree(params),
-                 "opt": serialization.to_state_dict(_host_tree(opt_state))}
-        if scale_state is not None:
-            from .precision import scale_state_to_host
-            state["scale"] = scale_state_to_host(scale_state)
-        # write-then-rename: a crash mid-write must never leave a truncated
-        # file that _latest_checkpoint would pick and brick the resume.
-        # The tmp name is per-process: on SHARED storage every process
-        # writes the (identical, replicated) state, and a common tmp would
-        # let one process truncate another's half-written file before its
-        # atomic rename publishes it
+        def build_state():
+            st = {"params": _host_tree(params),
+                  "opt": serialization.to_state_dict(
+                      _host_tree(opt_state))}
+            if scale_state is not None:
+                from .precision import scale_state_to_host
+                st["scale"] = scale_state_to_host(scale_state)
+            return st
+
+        # Whether the NEXT dispatch donates these state buffers decides
+        # where the device->host snapshot may run. The feed/stream step
+        # fns donate state only under bf16_mixed (batches aside), so the
+        # plain modes defer the whole snapshot+serialize to the writer
+        # thread — JAX arrays are immutable and these buffers are never
+        # handed back to XLA, so reading them concurrently is safe, and
+        # the step loop pays ~nothing. Donated-state paths (mixed; the
+        # scan path donates (params, opt_state) too — its caller passes
+        # state_donated=True) must snapshot INLINE before the donation
+        # invalidates the buffers.
+        if state_donated is None:
+            state_donated = scale_state is not None
         path = self._ckpt_path(epoch, step)
-        tmp = f"{path}.tmp.{jax.process_index()}"
-        with open(tmp, "wb") as f:
-            f.write(serialization.msgpack_serialize(state))
-        os.replace(tmp, path)
-        if step is None:
-            # the epoch-final save supersedes its step checkpoints: prune
-            # them so resumes stay O(1) files per epoch and _latest never
-            # prefers stale mid-epoch state
-            d = self.getCheckpointDir()
-            for f in os.listdir(d):
-                p = self._parse_ckpt_name(f)
-                if p is not None and p[0] == epoch and p[1] is not None:
-                    try:
-                        os.remove(os.path.join(d, f))
-                    except OSError:
-                        pass   # another process pruned it first
+        keep = self.getCheckpointKeepSteps()
+
+        def on_commit():
+            # runs strictly AFTER the rename + manifest commit (writer
+            # thread under asyncCheckpoint, inline otherwise): pruning
+            # and the elastic checkpoint-boundary hook must only ever
+            # see durable state. The consensus floor advances to the
+            # just-committed position — the previous floor is superseded
+            # as a resume target and becomes prunable
+            self._ckpt_floor = (epoch, step)
+            if step is None:
+                self._prune_step_checkpoints(epoch, keep=None)
+            else:
+                self._prune_step_checkpoints(epoch, keep=keep)
+            if elastic_ctx is not None:
+                elastic_ctx.checkpoint_saved(epoch, step)
+
+        if self.getAsyncCheckpoint():
+            if state_donated:
+                state = build_state()     # inline: donation is imminent
+                payload = (lambda:
+                           serialization.msgpack_serialize(state))
+            else:
+                payload = (lambda:
+                           serialization.msgpack_serialize(build_state()))
+            self._ckpt_writer().submit(path, payload, on_commit=on_commit)
+            if step is None:
+                self._ckpt_barrier()   # epoch boundaries stay ordered
+        else:
+            ckptlib.publish(path,
+                            serialization.msgpack_serialize(build_state()))
+            on_commit()
 
     def _restore_checkpoint(self, pos: tuple, params_tmpl, opt_tmpl):
         """-> (params, opt, scale_host) — scale_host is the checkpointed
         loss-scale dict (bf16_mixed fits) or None (every other mode, and
-        checkpoints written before the precision param existed)."""
-        with open(self._ckpt_path(*pos), "rb") as f:
-            state = serialization.msgpack_restore(f.read())
+        checkpoints written before the precision param existed). Raises
+        :class:`~..resilience.ckpt.CorruptCheckpoint` when the bytes
+        fail the manifest digest or won't decode — the resume loop falls
+        back to the previous checkpoint."""
+        from ..resilience import ckpt as ckptlib
+        path = self._ckpt_path(*pos)
+        d, name = os.path.split(path)
+        with open(path, "rb") as f:
+            blob = f.read()
+        if not ckptlib.verify_bytes(d, name, blob):
+            raise ckptlib.CorruptCheckpoint(name)
+        try:
+            state = serialization.msgpack_restore(blob)
+        except Exception as e:
+            ckptlib.note_corrupt(name, f"undecodable: {e}")
+            raise ckptlib.CorruptCheckpoint(name) from e
         params = serialization.from_state_dict(params_tmpl, state["params"])
         opt = serialization.from_state_dict(opt_tmpl, state["opt"])
         return params, opt, state.get("scale")
@@ -716,13 +839,38 @@ class TpuLearner(Estimator):
         resume_pos is the ``(epoch, step)`` consensus position restored
         from, or None for a fresh start; scale_state is the checkpointed
         loss-scale recurrence when this fit runs bf16_mixed (else the
-        passed-through value). Shared by fit() and fitStream()."""
-        resume = self._consensus_resume(self._latest_checkpoint(), nproc)
-        if resume is None:
-            return params, opt_state, 0, 0, None, scale_state
+        passed-through value). Candidates are manifest-verified and a
+        restore that still finds corruption (digest mismatch, truncated
+        msgpack) falls back to the NEXT-best checkpoint instead of
+        bricking the fit — on shared storage every process reads the
+        same files, so the fallback lands identically fleet-wide.
+        Shared by fit() and fitStream()."""
+        from ..resilience import ckpt as ckptlib
+        # a previous attempt's async write must land before we list
+        # candidates (elastic re-entry resumes what the writer published)
+        self._ckpt_barrier()
+        d = self.getCheckpointDir()
+        cands = [pos for pos, f in self._ckpt_candidates()
+                 if ckptlib.verify(d, f)] if d else []
         placed = (params, opt_state)
-        params, opt_state, scale_host = self._restore_checkpoint(
-            resume, params, opt_state)
+        resume = restored = None
+        for cand in cands:
+            resume = self._consensus_resume(cand, nproc)
+            if resume is None:
+                break
+            try:
+                restored = self._restore_checkpoint(resume, params,
+                                                    opt_state)
+                break
+            except (ckptlib.CorruptCheckpoint, OSError) as e:
+                log.warning("restore of checkpoint %s failed (%s); "
+                            "trying the previous checkpoint",
+                            _fmt_pos(resume), e)
+                resume = None
+        if resume is None or restored is None:
+            return params, opt_state, 0, 0, None, scale_state
+        self._ckpt_floor = resume    # never pruned while this fit runs
+        params, opt_state, scale_host = restored
         if scale_host is not None and scale_state is not None:
             from .precision import scale_state_from_host
             scale_state = scale_state_from_host(scale_host)
@@ -731,6 +879,19 @@ class TpuLearner(Estimator):
             # shardings (replicated for dp, model/expert axes for tp/ep)
             params = _replace_like(params, placed[0])
             opt_state = _replace_like(opt_state, placed[1])
+        else:
+            # restored leaves are HOST numpy buffers. A donating dispatch
+            # (the bf16_mixed feed/stream step donates (params, opt_state,
+            # scale); the scan path donates (params, opt_state)) would
+            # hand a zero-copy-aliased host buffer to XLA as scratch on
+            # the CPU backend — the corruption class the arrow-fitstream
+            # donation fix covered (see _make_train_step), surfacing as
+            # nondeterministic NaN right after a resume. A jitted copy
+            # materializes the restored state as XLA-owned output
+            # buffers, donation-safe on every backend.
+            params, opt_state = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t))(
+                    (params, opt_state))
         epoch, step = resume
         if step is None:
             log.info("resumed from checkpoint epoch %d", epoch)
@@ -816,15 +977,19 @@ class TpuLearner(Estimator):
 
         return session()
 
+    def _elastic_coordinator(self):
+        from ..resilience.elastic import ElasticFitCoordinator
+        return ElasticFitCoordinator(
+            self, n_hosts=self.getElasticHosts(),
+            min_hosts=self.getElasticMinHosts(),
+            grace=self.getElasticGraceSeconds() or None,
+            max_failures=self.getElasticMaxFailures(),
+            max_hosts=self.getElasticMaxHosts())
+
     def fit(self, df: DataFrame) -> TpuModel:
         with self._slo_session():
             if self.getElastic():
-                from ..resilience.elastic import ElasticFitCoordinator
-                return ElasticFitCoordinator(
-                    self, n_hosts=self.getElasticHosts(),
-                    min_hosts=self.getElasticMinHosts(),
-                    grace=self.getElasticGraceSeconds() or None,
-                    max_failures=self.getElasticMaxFailures()).fit(df)
+                return self._elastic_coordinator().fit(df)
             return self._fit_core(df)
 
     def _fit_core(self, df: DataFrame, devices=None,
@@ -1031,15 +1196,21 @@ class TpuLearner(Estimator):
         import contextlib
         guard = (meshlib.collective_fit_lock if mesh.size > 1
                  else contextlib.nullcontext())
-        with guard, telemetry.trace.span(
-                "fit", model=cfg.get("type"), rows=n,
-                path="scan" if scan_fn is not None else "feed"):
-            params, opt_state, last_loss = self._run_epochs(
-                start_epoch, x, y, n, bs, steps, order_rng=rng_np, mesh=mesh,
-                nproc=nproc, train_step=train_step, params=params,
-                opt_state=opt_state, scan_fn=scan_fn,
-                start_step=start_step, elastic_ctx=elastic_ctx,
-                scale_state=scale_state)
+        try:
+            with guard, telemetry.trace.span(
+                    "fit", model=cfg.get("type"), rows=n,
+                    path="scan" if scan_fn is not None else "feed"):
+                params, opt_state, last_loss = self._run_epochs(
+                    start_epoch, x, y, n, bs, steps, order_rng=rng_np,
+                    mesh=mesh, nproc=nproc, train_step=train_step,
+                    params=params, opt_state=opt_state, scan_fn=scan_fn,
+                    start_step=start_step, elastic_ctx=elastic_ctx,
+                    scale_state=scale_state)
+        finally:
+            # fit-exit barrier: an async checkpoint still in flight must
+            # land before the caller (or an elastic re-entry) reads the
+            # directory — and before a raised error looks "handled"
+            self._ckpt_barrier()
 
         return self._package_model(cfg, params, last_loss)
 
@@ -1073,11 +1244,20 @@ class TpuLearner(Estimator):
         fleet agrees host-side on (any-stream-has-data, bucket size);
         exhausted streams contribute zero-weight dummy batches until the
         longest stream drains — unequal shard sizes never deadlock.
+
+        ``elastic=True`` routes the stream fit through the same
+        :class:`~..resilience.elastic.ElasticFitCoordinator` as fit():
+        a host loss mid-stream re-meshes over the survivors and re-enters
+        from the checkpointed optimizer state (the epoch restarts — a
+        generator cannot seek — so some stream batches are re-seen).
         """
         with self._slo_session():
+            if self.getElastic():
+                return self._elastic_coordinator().fit_stream(batches_fn)
             return self._fit_stream_core(batches_fn)
 
-    def _fit_stream_core(self, batches_fn) -> TpuModel:
+    def _fit_stream_core(self, batches_fn, devices=None,
+                         elastic_ctx=None) -> TpuModel:
         cfg = self._cfg_with_precision(dict(self.getModelConfig()))
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
                 or self.getPipelineParallel() > 1):
@@ -1088,7 +1268,7 @@ class TpuLearner(Estimator):
         nproc = meshlib.effective_process_count()
         if nproc > 1:
             _require_inner_block_local({"tensorParallel": tp})
-        mesh = meshlib.create_mesh(model=tp)
+        mesh = meshlib.create_mesh(model=tp, devices=devices)
         first_iter = iter(batches_fn())
         first = next(first_iter, None)
         if first is not None:
@@ -1130,9 +1310,13 @@ class TpuLearner(Estimator):
             grad_clip=grad_clip), "trainer.step")
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
-        params, opt_state, start_epoch, start_step, _, scale_state = \
-            self._resume_training_state(params, opt_state, nproc,
-                                        scale_state)
+        params, opt_state, start_epoch, start_step, resume_pos, \
+            scale_state = self._resume_training_state(params, opt_state,
+                                                      nproc, scale_state)
+        if elastic_ctx is not None:
+            elastic_ctx.resumed(
+                resume_pos,
+                _params_digest(params) if resume_pos is not None else None)
         if start_step:
             # a stream cannot skip deterministically to step N (the
             # generator is opaque); restart the epoch — the checkpointed
@@ -1180,6 +1364,11 @@ class TpuLearner(Estimator):
                             def dispatch(_a, p=params, o=opt_state,
                                          ss=scale_state, xb=xb, yb=yb,
                                          wb=wb):
+                                if elastic_ctx is not None:
+                                    # host-loss / grow check; both raise
+                                    # non-transient and unwind to the
+                                    # coordinator's re-mesh
+                                    elastic_ctx.check_step()
                                 faults.inject("trainer.step")
                                 if ss is None:
                                     p2, o2, loss = train_step(p, o, xb,
@@ -1191,11 +1380,15 @@ class TpuLearner(Estimator):
                         steps_run += 1
                         if n:
                             n_batches += 1
+                        if elastic_ctx is not None:
+                            elastic_ctx.step_committed(epoch,
+                                                       steps_run - 1)
                         if ckpt_every and steps_run % ckpt_every == 0 \
                                 and jax.process_index() == 0:
                             self._save_checkpoint(epoch, params, opt_state,
                                                   step=steps_run - 1,
-                                                  scale_state=scale_state)
+                                                  scale_state=scale_state,
+                                                  elastic_ctx=elastic_ctx)
                 finally:
                     steps_it.close()
                 if steps_run == 0:
@@ -1215,10 +1408,12 @@ class TpuLearner(Estimator):
                     raise RuntimeError(
                         f"training diverged: epoch {epoch} loss {last_loss} "
                         f"(lr={self.getLearningRate()})")
-                if self.getCheckpointDir():
+                if self.getCheckpointDir() and jax.process_index() == 0:
                     self._save_checkpoint(epoch, params, opt_state,
-                                          scale_state=scale_state)
+                                          scale_state=scale_state,
+                                          elastic_ctx=elastic_ctx)
 
+        self._ckpt_barrier()
         return self._package_model(cfg, params, last_loss)
 
     def _stream_epoch_steps(self, stream, cfg, x0, y0, share, nproc, mesh):
@@ -1395,7 +1590,8 @@ class TpuLearner(Estimator):
                             and jax.process_index() == 0:
                         self._save_checkpoint(epoch, params, opt_state,
                                               step=s,
-                                              scale_state=scale_state)
+                                              scale_state=scale_state,
+                                              elastic_ctx=elastic_ctx)
                     continue
                 # ---- epoch finalize (an early exit below must stop the
                 # producer promptly: the finally closes the prefetcher) ----
@@ -1420,7 +1616,8 @@ class TpuLearner(Estimator):
                                 "resumable."))
                 if self.getCheckpointDir() and jax.process_index() == 0:
                     self._save_checkpoint(epoch, params, opt_state,
-                                          scale_state=scale_state)
+                                          scale_state=scale_state,
+                                          elastic_ctx=elastic_ctx)
         finally:
             it.close()
         return params, opt_state, last_loss
@@ -1526,6 +1723,9 @@ class TpuLearner(Estimator):
                        if last_good is not None
                        else "Set checkpointDir to make divergence resumable."))
             if self.getCheckpointDir():
+                # the scan dispatch donates (params, opt_state): the save
+                # must snapshot inline before the next epoch's dispatch
                 self._save_checkpoint(epoch, params, opt_state,
-                                      scale_state=scale_state)
+                                      scale_state=scale_state,
+                                      state_donated=True)
         return params, opt_state, last_loss

@@ -36,12 +36,15 @@ docs/reliability.md.
 
 from __future__ import annotations
 
-from . import faults
+from . import ckpt, faults
+from .ckpt import AsyncCheckpointWriter
 from .elastic import (ElasticFitCoordinator, ElasticFleetLost,
-                      HostHeartbeat, HostLossError, TrainSupervisor)
+                      HostHeartbeat, HostLossError, HostRejoinError,
+                      TrainSupervisor)
 from .policy import BreakerOpen, CircuitBreaker, RetryPolicy
 from .supervisor import FleetSupervisor
 
-__all__ = ["faults", "BreakerOpen", "CircuitBreaker", "RetryPolicy",
-           "FleetSupervisor", "TrainSupervisor", "ElasticFitCoordinator",
-           "ElasticFleetLost", "HostHeartbeat", "HostLossError"]
+__all__ = ["faults", "ckpt", "BreakerOpen", "CircuitBreaker",
+           "RetryPolicy", "FleetSupervisor", "TrainSupervisor",
+           "ElasticFitCoordinator", "ElasticFleetLost", "HostHeartbeat",
+           "HostLossError", "HostRejoinError", "AsyncCheckpointWriter"]

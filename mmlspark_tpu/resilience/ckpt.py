@@ -1,0 +1,313 @@
+"""Async non-blocking checkpoints with a torn-write-proof commit protocol.
+
+PR 3's step-interval checkpoints made long fits preemption-tolerant, but
+every save stalls the step loop while msgpack hits disk — so
+``checkpointEverySteps`` stays large and a host loss replays a large
+window. This module splits the save into the two halves that actually
+have different costs:
+
+* **snapshot** (caller, synchronous): ``jax.device_get`` the training
+  state into pinned host arrays — cheap, and REQUIRED to be synchronous
+  because the very next optimizer step donates those device buffers;
+* **serialize + publish** (background thread): msgpack the host tree and
+  run the commit protocol below, overlapped with the next steps.
+
+The queue is bounded at depth 1 with **newest-wins coalescing**: when the
+step loop outruns the disk, intermediate snapshots are dropped (counted on
+``mmlspark_ckpt_coalesced_total``) rather than back-pressuring the fit —
+a checkpoint's only job is to bound the replay window, and the newest one
+bounds it best.  :meth:`AsyncCheckpointWriter.wait` is the barrier the
+trainer takes at epoch end and fit exit, so an epoch boundary or a fit
+return never races its own pending write.
+
+Commit protocol (shared by the synchronous path — ``publish()``):
+
+1. write ``<path>.tmp.<pid>`` (fault site ``ckpt.write``), flush + fsync;
+2. ``os.replace`` tmp -> final (fault site ``ckpt.rename``) — atomic, so
+   a *partial* file can never carry the final name;
+3. commit ``manifest.json`` LAST (its own write-then-fsync-then-rename),
+   recording the file's size + sha256.
+
+A crash anywhere in 1-3 therefore leaves either no file, or a complete
+file that is **not in the manifest** — and resume treats "exists but
+unverified" exactly like "corrupt": skip it, warn, count it on
+``mmlspark_ckpt_corrupt_total``, and fall back to the previous
+checkpoint.  The consensus candidate is always a manifest-verified file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import telemetry
+from ..core.utils import get_logger
+from . import faults
+
+log = get_logger("resilience.ckpt")
+
+_m_write_seconds = telemetry.registry.histogram(
+    "mmlspark_ckpt_write_seconds",
+    "background serialize + write + fsync + rename + manifest-commit time "
+    "per published checkpoint")
+_m_coalesced = telemetry.registry.counter(
+    "mmlspark_ckpt_coalesced_total",
+    "checkpoint snapshots dropped by newest-wins coalescing (the step "
+    "loop outran the disk; the newest snapshot bounds the replay window "
+    "best, so nothing durable is lost)")
+_m_corrupt = telemetry.registry.counter(
+    "mmlspark_ckpt_corrupt_total",
+    "checkpoint files skipped at resume because they were partial, "
+    "corrupt, or not committed to the manifest (each skip falls back to "
+    "the previous checkpoint)")
+_m_wait_seconds = telemetry.registry.histogram(
+    "mmlspark_ckpt_wait_seconds",
+    "time the fit actually blocked on the async-checkpoint barrier "
+    "(epoch end / fit exit); ~0 when the disk keeps up")
+
+MANIFEST = "manifest.json"
+
+
+class CorruptCheckpoint(RuntimeError):
+    """A checkpoint file failed content verification (manifest digest
+    mismatch or undecodable payload). Resume catches it and falls back to
+    the previous checkpoint."""
+
+
+def note_corrupt(name: str, reason: str):
+    """Count + trace one corrupt-checkpoint sighting (callers that decode
+    the payload themselves — e.g. a msgpack parse failure on a
+    pre-manifest file — report through here so the counter stays the one
+    place to alert on)."""
+    _m_corrupt.inc()
+    telemetry.trace.instant("ckpt/corrupt", file=name, reason=reason)
+    log.warning("checkpoint %s is corrupt (%s) — falling back to the "
+                "previous checkpoint", name, reason)
+
+
+def manifest_path(directory: str) -> str:
+    return os.path.join(directory, MANIFEST)
+
+
+def load_manifest(directory: str) -> Optional[dict]:
+    """The committed manifest's ``files`` map, or None when the directory
+    predates manifests (every file passes verification then — old
+    checkpoint dirs stay resumable)."""
+    try:
+        with open(manifest_path(directory), "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        return dict(doc.get("files", {}))
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        # an unreadable manifest must not brick the resume outright: warn
+        # and fall back to manifest-less verification
+        log.warning("checkpoint manifest %s unreadable; skipping "
+                    "verification", manifest_path(directory))
+        return None
+
+
+def _commit_manifest(directory: str, files: dict):
+    """Write-then-fsync-then-rename the manifest — the LAST step of the
+    commit protocol, so its presence implies every listed file landed."""
+    path = manifest_path(directory)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "files": files}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def publish(path: str, data: bytes):
+    """Commit one checkpoint file: tmp write + fsync (site ``ckpt.write``),
+    atomic rename (site ``ckpt.rename``), manifest entry committed last."""
+    directory, name = os.path.split(path)
+    t0 = time.perf_counter()
+    with telemetry.trace.span("ckpt/write", file=name, bytes=len(data)):
+        faults.inject("ckpt.write")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.inject("ckpt.rename")
+        os.replace(tmp, path)
+        files = load_manifest(directory) or {}
+        files[name] = {"size": len(data),
+                       "sha256": hashlib.sha256(data).hexdigest()}
+        _commit_manifest(directory, files)
+    _m_write_seconds.observe(time.perf_counter() - t0)
+
+
+def verify(directory: str, name: str) -> bool:
+    """Is ``name`` a legitimate consensus candidate? True when the
+    directory has no manifest (pre-manifest checkpoints), or when the
+    manifest lists the file with a matching on-disk size. A file the
+    manifest doesn't know, or whose size disagrees, is a torn/uncommitted
+    write: count it and skip it."""
+    files = load_manifest(directory)
+    if files is None:
+        return True
+    entry = files.get(name)
+    try:
+        size = os.path.getsize(os.path.join(directory, name))
+    except OSError:
+        return False
+    if entry is None or int(entry.get("size", -1)) != size:
+        _m_corrupt.inc()
+        telemetry.trace.instant("ckpt/corrupt", file=name,
+                                reason="unlisted" if entry is None
+                                else "size")
+        log.warning(
+            "checkpoint %s is %s — skipping it as a resume candidate "
+            "(falling back to the previous checkpoint)", name,
+            "not committed to the manifest (torn write?)" if entry is None
+            else f"{size} bytes but the manifest recorded "
+                 f"{entry.get('size')}")
+        return False
+    return True
+
+
+def verify_bytes(directory: str, name: str, data: bytes) -> bool:
+    """Content check at restore time: the read bytes must hash to the
+    manifest's digest (bit-rot / concurrent-truncation defense beyond the
+    size check)."""
+    files = load_manifest(directory)
+    if files is None or name not in files:
+        return True      # unverifiable dirs already passed verify()
+    digest = files[name].get("sha256")
+    if digest and hashlib.sha256(data).hexdigest() != digest:
+        _m_corrupt.inc()
+        telemetry.trace.instant("ckpt/corrupt", file=name, reason="sha256")
+        log.warning("checkpoint %s content does not match its manifest "
+                    "digest — skipping it", name)
+        return False
+    return True
+
+
+def prune(directory: str, names) -> None:
+    """Remove checkpoint files AND their manifest entries (one manifest
+    commit for the batch). Missing files are fine — another process may
+    have pruned first on shared storage."""
+    names = [n for n in names]
+    if not names:
+        return
+    for n in names:
+        try:
+            os.remove(os.path.join(directory, n))
+        except OSError:
+            pass
+    files = load_manifest(directory)
+    if files:
+        kept = {k: v for k, v in files.items() if k not in set(names)}
+        if len(kept) != len(files):
+            try:
+                _commit_manifest(directory, kept)
+            except OSError as e:
+                log.warning("manifest prune failed (kept stale entries, "
+                            "harmless): %s", e)
+
+
+class AsyncCheckpointWriter:
+    """Depth-1, newest-wins background checkpoint publisher.
+
+    ``submit(path, payload_fn, on_commit)`` enqueues one checkpoint whose
+    bytes are produced by ``payload_fn()`` ON THE WRITER THREAD (that's
+    where the msgpack serialization cost goes); a submit that finds a
+    not-yet-started entry replaces it (newest-wins — the superseded
+    snapshot's ``on_commit`` never fires, mirroring that it never became
+    durable). ``on_commit`` runs on the writer thread strictly AFTER the
+    rename + manifest commit — the elastic journal's
+    ``checkpoint_saved`` hook rides it, so a grow re-mesh can only target
+    checkpoints that are actually on disk.
+
+    A write error is remembered and re-raised at the next :meth:`submit`
+    or :meth:`wait` (the step loop must learn its durability story broke,
+    not train on thinking it has checkpoints it doesn't).
+    """
+
+    def __init__(self, name: str = "ckpt"):
+        self._cond = threading.Condition()
+        self._pending: Optional[tuple] = None  # guarded-by: _cond
+        self._in_flight = False                # guarded-by: _cond
+        self._error: Optional[BaseException] = None  # guarded-by: _cond
+        self._closed = False                   # guarded-by: _cond
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ckpt-writer-{name}")
+        self._thread.start()
+
+    def submit(self, path: str, payload_fn: Callable[[], bytes],
+               on_commit: Optional[Callable[[], None]] = None):
+        with self._cond:
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            coalesced = self._pending is not None
+            self._pending = (path, payload_fn, on_commit)
+            self._cond.notify_all()
+        if coalesced:
+            _m_coalesced.inc()
+            log.info("checkpoint %s coalesced away by a newer snapshot",
+                     os.path.basename(path))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Barrier: block until no checkpoint is pending or in flight.
+        Returns False on timeout. Re-raises a writer-thread error."""
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._in_flight:
+                remain = (None if deadline is None
+                          else deadline - time.monotonic())
+                if remain is not None and remain <= 0:
+                    return False
+                self._cond.wait(remain if remain is not None else 0.5)
+            if self._error is not None:
+                err, self._error = self._error, None
+                raise err
+        _m_wait_seconds.observe(time.perf_counter() - t0)
+        return True
+
+    def close(self):
+        """Flush and stop. Swallows nothing: a pending error surfaces."""
+        try:
+            self.wait()
+        finally:
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+            if self._thread.is_alive():
+                self._thread.join(timeout=5)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait(0.5)
+                if self._pending is None and self._closed:
+                    return
+                entry, self._pending = self._pending, None
+                self._in_flight = True
+            # serialize + IO happen OUTSIDE the lock: submit() stays a
+            # dict swap while a write is in flight
+            path, payload_fn, on_commit = entry
+            try:
+                publish(path, payload_fn())
+                if on_commit is not None:
+                    on_commit()
+            except BaseException as e:
+                log.warning("async checkpoint %s failed: %s",
+                            os.path.basename(path), e)
+                with self._cond:
+                    self._error = e
+            finally:
+                with self._cond:
+                    self._in_flight = False
+                    self._cond.notify_all()

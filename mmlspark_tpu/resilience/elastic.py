@@ -36,10 +36,22 @@ Three pieces:
   (``checkpointEverySteps`` format), so every step that reached a
   checkpoint survives the loss bit-exactly.
 
+The loop also closes the other way — **in-job grow**: a relaunched host
+writes its heartbeat with a ``joining`` flag; the supervisor turns
+sustained freshness through the *rejoin grace* window into a **grow
+verdict** (fault site ``supervisor.rejoin``), and the coordinator admits
+the joiner at the next committed **checkpoint boundary**
+(:class:`HostRejoinError` unwinds the step loop exactly like a loss,
+pointed the other way), re-meshing over survivors + joiner with
+``max_hosts`` capping the pool — replays only, no fleet restart. The
+JAMPI barrier-execution shape again: the consensus checkpoint is the
+barrier a gang-scheduled re-entry targets.
+
 Single-process mode rehearses the full recovery path with *simulated*
 hosts (contiguous device groups, ``mesh.host_device_groups``): killing a
 group's heartbeat exercises verdict -> re-mesh -> resume exactly as a real
-preemption would, which is what the tier-1 chaos test and
+preemption would (and :meth:`ElasticFitCoordinator.relaunch_host` the
+grow half), which is what the tier-1 chaos tests and
 ``bench.py --chaos-train`` drive. Multi-process mode runs the same
 heartbeats and verdicts, but an in-job re-mesh is impossible once
 ``jax.distributed`` has lost a member — there the coordinator's job is to
@@ -92,6 +104,23 @@ _m_stragglers = telemetry.registry.counter(
     "mmlspark_elastic_stragglers_total",
     "hosts flagged anomalously slow by the rolling-MAD step-time "
     "detector (each flag episode counts once)", labels=("host",))
+_m_rejoins = telemetry.registry.counter(
+    "mmlspark_elastic_rejoins_total",
+    "grow verdicts: relaunched hosts whose joining heartbeat stayed "
+    "fresh through the rejoin grace window", labels=("host",))
+_m_grows = telemetry.registry.counter(
+    "mmlspark_elastic_grows_total",
+    "fit recoveries that re-meshed the fleet LARGER (joiners admitted "
+    "at a checkpoint boundary)")
+_m_grow_recovery_seconds = telemetry.registry.histogram(
+    "mmlspark_elastic_grow_recovery_seconds",
+    "grow re-mesh start -> first optimizer step committed on the grown "
+    "mesh (the cost of admitting a rejoined host)")
+_m_heartbeat_errors = telemetry.registry.counter(
+    "mmlspark_elastic_heartbeat_errors_total",
+    "heartbeat writes that exhausted their retry budget (shared-FS "
+    "trouble; the beacon thread stays alive and keeps trying)",
+    labels=("host",))
 
 
 class HostLossError(RuntimeError):
@@ -103,6 +132,20 @@ class HostLossError(RuntimeError):
         self.hosts = sorted(hosts)
         super().__init__(f"host(s) {', '.join(self.hosts)} declared dead "
                          f"mid-fit")
+
+
+class HostRejoinError(RuntimeError):
+    """A relaunched host earned a grow verdict and a checkpoint boundary
+    has committed since: the step loop unwinds so the coordinator can
+    re-mesh over survivors + joiner. NOT an error condition — it is the
+    same unwind mechanism a host loss uses, pointed the other way (the
+    fleet gets bigger). Deliberately not a ConnectionError: the per-step
+    retry must not absorb it."""
+
+    def __init__(self, hosts):
+        self.hosts = sorted(hosts)
+        super().__init__(f"host(s) {', '.join(self.hosts)} rejoining "
+                         f"at checkpoint boundary")
 
 
 class ElasticFleetLost(RuntimeError):
@@ -141,13 +184,24 @@ class HostHeartbeat:
     stops mid-air the same way).
     """
 
-    def __init__(self, host_id: str, directory: str, interval: float):
+    def __init__(self, host_id: str, directory: str, interval: float,
+                 joining: bool = False):
+        from .policy import RetryPolicy
         self.host_id = host_id
         self.directory = directory
         self.interval = interval
         self._lock = threading.Lock()
         self._pos = (0, -1)          # guarded-by: _lock
+        self._joining = joining      # guarded-by: _lock
         self._stop = threading.Event()
+        # transient shared-FS hiccups must not silence the beacon — a
+        # silent beacon IS a death verdict. Retry each write; exhaustion
+        # is counted and survived (the next interval tries again).
+        self._retry = RetryPolicy(name="elastic.heartbeat", max_attempts=3,
+                                  base_delay=min(0.05, interval / 4),
+                                  max_delay=max(0.05, interval / 2),
+                                  retryable=lambda e: isinstance(
+                                      e, (OSError, ValueError)))
         self._thread = threading.Thread(
             target=self._run, daemon=True, name=f"heartbeat-{host_id}")
 
@@ -159,11 +213,20 @@ class HostHeartbeat:
         with self._lock:
             self._pos = (epoch, step)
 
+    def set_joining(self, joining: bool):
+        """Flip the rejoin flag the next write carries. A relaunched host
+        starts with ``joining=True``; the coordinator clears it once the
+        host is admitted back into the mesh."""
+        with self._lock:
+            self._joining = joining
+
     def _write(self):
         with self._lock:
-            epoch, step = self._pos
+            (epoch, step), joining = self._pos, self._joining
         doc = {"host": self.host_id, "time": time.time(),
                "epoch": epoch, "step": step}
+        if joining:
+            doc["joining"] = True
         tmp = f"{self.path}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)
@@ -172,9 +235,11 @@ class HostHeartbeat:
     def _run(self):
         while not self._stop.is_set():
             try:
-                self._write()
-            except OSError as e:   # shared storage blip: skip one beat
-                log.warning("heartbeat %s write failed: %s", self.host_id, e)
+                self._retry.run(lambda _a: self._write())
+            except Exception as e:   # exhausted: count, survive, retry
+                _m_heartbeat_errors.labels(host=self.host_id).inc()
+                log.warning("heartbeat %s write failed after retries: %s",
+                            self.host_id, e)
             self._stop.wait(self.interval)
 
     def start(self) -> "HostHeartbeat":
@@ -219,12 +284,19 @@ class TrainSupervisor:
                  min_hosts: int = 1,
                  probe: Optional[Callable] = None,
                  probe_interval: Optional[float] = None,
-                 anomaly_detector=None):
+                 anomaly_detector=None,
+                 rejoin_grace: Optional[float] = None):
         from ..telemetry.slo import StepTimeAnomalyDetector
         self.host_ids = list(host_ids)
         self.directory = directory
         self.grace = grace if grace is not None else _grace_default()
         self.min_hosts = max(1, min_hosts)
+        #: how long a relaunched host's ``joining`` heartbeat must stay
+        #: fresh before the GROW verdict lands (its own window, symmetric
+        #: to the death grace: a flapping relauncher must not churn the
+        #: mesh). Default: the death grace.
+        self.rejoin_grace = (rejoin_grace if rejoin_grace is not None
+                             else self.grace)
         self._probe = probe or self._probe_file
         self.probe_interval = (probe_interval if probe_interval is not None
                                else max(0.05, self.grace / 4.0))
@@ -237,6 +309,8 @@ class TrainSupervisor:
                         else (anomaly_detector or None))
         self._lock = threading.Lock()
         self._dead: set[str] = set()        # guarded-by: _lock
+        self._joining: dict[str, float] = {}     # guarded-by: _lock
+        self._join_seen: dict[str, float] = {}   # guarded-by: _lock
         self._progress: dict[str, tuple] = {}    # guarded-by: _lock
         self._flagged: set[str] = set()     # guarded-by: _lock
         self._started_at = time.monotonic()
@@ -246,20 +320,29 @@ class TrainSupervisor:
         _m_hosts_alive.set(len(self.host_ids))
 
     # ---- probing ----
+    def _read_doc(self, host_id: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.directory,
+                                   f"hb_{host_id}.json"),
+                      "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def _probe_file(self, host_id: str) -> Optional[float]:
         """Heartbeat age in seconds; None when the file is missing or
         unreadable (counted against the host once the startup grace is
         spent — a host that never wrote at all is as dead as one that
         stopped)."""
-        try:
-            with open(os.path.join(self.directory,
-                                   f"hb_{host_id}.json"),
-                      "r", encoding="utf-8") as f:
-                doc = json.load(f)
-            self._note_progress(host_id, doc)
-            return max(0.0, time.time() - float(doc["time"]))
-        except (OSError, ValueError, KeyError):
+        doc = self._read_doc(host_id)
+        if doc is None:
             return None
+        try:
+            age = max(0.0, time.time() - float(doc["time"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+        self._note_progress(host_id, doc)
+        return age
 
     def _note_progress(self, host_id: str, doc: dict):
         """Feed the anomaly detector from heartbeat progress: successive
@@ -317,7 +400,64 @@ class TrainSupervisor:
                 "%d host(s) remain", host_id,
                 "missing" if age is None else f"{age:.2f}s old",
                 self.grace, alive)
+        self._grow_pass()
         self._straggler_pass()
+
+    def _grow_pass(self):
+        """GROW verdicts — the death pass's mirror. A dead host whose
+        heartbeat file is beating again WITH the ``joining`` flag is a
+        relaunch (not a zombie: sticky death still holds for flagless
+        resurrections); once it has stayed fresh through ``rejoin_grace``
+        the host earns a grow verdict the coordinator can admit at the
+        next checkpoint boundary. Verdict bookkeeping decided under the
+        lock; IO after release."""
+        with self._lock:
+            candidates = [h for h in self._dead if h not in self._joining]
+        verdicts = []
+        for host_id in candidates:
+            faults.inject("supervisor.rejoin")
+            doc = self._read_doc(host_id)
+            fresh = (doc is not None and doc.get("joining")
+                     and time.time() - float(doc.get("time", 0))
+                     <= self.grace)
+            now = time.monotonic()
+            with self._lock:
+                if not fresh:
+                    # stale or flagless: the relaunch flapped (or was a
+                    # zombie); restart its window
+                    self._join_seen.pop(host_id, None)
+                    continue
+                t0 = self._join_seen.setdefault(host_id, now)
+                if now - t0 < self.rejoin_grace:
+                    continue
+                self._join_seen.pop(host_id, None)
+                self._joining[host_id] = now
+            verdicts.append(host_id)
+        for host_id in verdicts:
+            _m_rejoins.labels(host=host_id).inc()
+            telemetry.trace.instant("elastic/rejoin", host=host_id)
+            telemetry.flight.note("elastic/rejoin", host=host_id)
+            log.warning("host %s earned a GROW verdict (joining heartbeat "
+                        "fresh through the %.2fs rejoin window); eligible "
+                        "to re-enter the mesh at the next checkpoint "
+                        "boundary", host_id, self.rejoin_grace)
+
+    def joining_hosts(self) -> dict:
+        """Hosts holding a grow verdict -> verdict time (monotonic). The
+        coordinator admits them at the next checkpoint boundary."""
+        with self._lock:
+            return dict(self._joining)
+
+    def admit(self, host_id: str):
+        """The coordinator admitted a rejoined host back into the mesh:
+        clear its death verdict and grow state so the death pass watches
+        it again."""
+        with self._lock:
+            self._dead.discard(host_id)
+            self._joining.pop(host_id, None)
+            self._join_seen.pop(host_id, None)
+            alive = len(self.host_ids) - len(self._dead)
+        _m_hosts_alive.set(alive)
 
     def _straggler_pass(self):
         """Advisory anomaly verdicts: flag hosts the rolling-MAD detector
@@ -372,7 +512,36 @@ class TrainSupervisor:
                 log.warning("train-supervisor tick failed: %s", e)
             self._stop.wait(self.probe_interval)
 
+    def clear_stale_heartbeats(self):
+        """Remove ``hb_*.json`` ghosts from a PREVIOUS run (older than the
+        grace window): without this a supervisor starting against a reused
+        checkpointDir reads last week's heartbeat and declares an instant
+        death (or an instant zombie) before the relaunched fleet writes
+        its first beat. Fresh files — this run's — are untouched."""
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("hb_") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    stamp = float(json.load(f).get("time", 0))
+                stale = time.time() - stamp > self.grace
+            except (OSError, ValueError, TypeError):
+                stale = True     # unreadable ghosts go too
+            if stale:
+                try:
+                    os.remove(path)
+                    log.info("cleared stale heartbeat %s from a previous "
+                             "run", name)
+                except OSError:
+                    pass
+
     def start(self) -> "TrainSupervisor":
+        self.clear_stale_heartbeats()
         self._thread.start()
         return self
 
@@ -395,12 +564,17 @@ class ElasticStepContext:
         injected ``elastic.step`` fault is a ConnectionError — the
         trainer's retry-once policy absorbs singles, doubles escalate to
         the coordinator's transient classification. A death verdict on a
-        mesh member raises :class:`HostLossError` (non-transient: skips
-        the retry and unwinds to the re-mesh)."""
+        mesh member raises :class:`HostLossError`; a grow verdict with a
+        checkpoint boundary committed behind it raises
+        :class:`HostRejoinError` (both non-transient: they skip the retry
+        and unwind to the coordinator's re-mesh)."""
         faults.inject("elastic.step")
         dead = self._coord.dead_mesh_hosts()
         if dead:
             raise HostLossError(dead)
+        grow = self._coord.pending_grow()
+        if grow:
+            raise HostRejoinError(grow)
 
     def step_committed(self, epoch: int, step: int):
         """The trainer reports each completed optimizer step: advances
@@ -409,11 +583,30 @@ class ElasticStepContext:
         the chaos tests audit for gaps."""
         self._coord.note_step(epoch, step)
 
+    def checkpoint_saved(self, epoch: int, step: Optional[int]):
+        """A checkpoint COMMITTED (rename + manifest durable — on the
+        async path this fires from the writer thread strictly after the
+        commit, never at submit). Checkpoint boundaries are where grow
+        re-meshes become eligible: a joiner admitted here replays ~zero
+        steps."""
+        self._coord.note_checkpoint(epoch, step)
+
     def resumed(self, pos, params_digest: Optional[str]):
         """The trainer reports the checkpoint position (or None for a
         fresh start) and a digest of the restored params — the bit-exact
         resume evidence."""
         self._coord.note_resume(pos, params_digest)
+
+    # ---- in-memory boosting-state candidates (elastic GBDT fits) ----
+    def save_snapshot(self, state):
+        """The GBDT engine's per-iteration boosting-state candidate
+        (newest wins): host-side arrays a re-meshed attempt resumes
+        from. Pair with :meth:`checkpoint_saved` so grow boundaries work
+        for boosted fits too."""
+        self._coord.snapshot = state
+
+    def latest_snapshot(self):
+        return self._coord.snapshot
 
 
 class ElasticFitCoordinator:
@@ -430,39 +623,71 @@ class ElasticFitCoordinator:
     mesh — persistent infrastructure trouble must not loop forever.
     """
 
-    def __init__(self, learner, n_hosts: int = 0,
+    def __init__(self, learner=None, n_hosts: int = 0,
                  min_hosts: int = 1,
                  grace: Optional[float] = None,
                  max_failures: int = 5,
-                 heartbeat_interval: Optional[float] = None):
-        if not learner.getCheckpointDir():
+                 heartbeat_interval: Optional[float] = None,
+                 max_hosts: int = 0,
+                 rejoin_grace: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None):
+        ckdir = checkpoint_dir or (learner.getCheckpointDir()
+                                   if learner is not None else "")
+        if not ckdir:
             raise ValueError(
                 "elastic fit requires checkpointDir: recovery is a resume "
                 "from the consensus checkpoint — without one a host loss "
                 "restarts from scratch, losing every committed step")
         self.learner = learner
+        self.checkpoint_dir = ckdir
         self.grace = grace if grace is not None else _grace_default()
         self.min_hosts = max(1, min_hosts)
         self.max_failures = max(1, max_failures)
-        hb = (heartbeat_interval if heartbeat_interval is not None
-              else _hb_interval_default(self.grace))
+        self._hb_interval = (heartbeat_interval
+                             if heartbeat_interval is not None
+                             else _hb_interval_default(self.grace))
         from ..parallel import mesh as meshlib
         self.groups = dict(meshlib.host_device_groups(n_hosts))
-        self.hb_dir = heartbeat_dir(learner.getCheckpointDir())
-        self.heartbeats = {h: HostHeartbeat(h, self.hb_dir, hb)
+        #: grow ceiling: the mesh never grows past this many hosts
+        #: (0 = the launch fleet size)
+        self.max_hosts = max_hosts or len(self.groups)
+        self.hb_dir = heartbeat_dir(ckdir)
+        self.heartbeats = {h: HostHeartbeat(h, self.hb_dir,
+                                            self._hb_interval)
                            for h in self.groups}
         self.supervisor = TrainSupervisor(
             list(self.groups), self.hb_dir, grace=self.grace,
-            min_hosts=self.min_hosts)
+            min_hosts=self.min_hosts, rejoin_grace=rejoin_grace)
         self.attempts: list[dict] = []   # per-attempt journal (tests/bench)
         self.committed: list[tuple] = []   # (epoch, step) journal
+        self.snapshot = None   # GBDT boosting-state candidate (newest wins)
         self._mesh_hosts: set[str] = set()
         self._pending_recovery_t0: Optional[float] = None
+        self._recovery_kind = "loss"
         self._last_ckpt_pos: Optional[tuple] = None
+        self._last_ckpt_t: Optional[float] = None
 
     # ---- state read by the step hook (fit thread) ----
     def dead_mesh_hosts(self) -> set[str]:
         return self.supervisor.dead_hosts() & self._mesh_hosts
+
+    def pending_grow(self) -> set[str]:
+        """Joiners eligible to enter at THIS step: they hold a grow
+        verdict, a checkpoint boundary has committed since the verdict
+        (so the re-entry replays ~zero steps), and the ``max_hosts``
+        ceiling leaves room. Cheap when nobody is joining: one dict read
+        under the supervisor lock."""
+        join = self.supervisor.joining_hosts()
+        if not join:
+            return set()
+        room = self.max_hosts - len(self._mesh_hosts)
+        if room <= 0:
+            return set()
+        ckpt_t = self._last_ckpt_t
+        eligible = sorted(h for h, t in join.items()
+                          if h not in self._mesh_hosts
+                          and ckpt_t is not None and ckpt_t >= t)
+        return set(eligible[:room])
 
     def note_step(self, epoch: int, step: int):
         self.committed.append((epoch, step))
@@ -471,10 +696,23 @@ class ElasticFitCoordinator:
         if self._pending_recovery_t0 is not None:
             dt = time.monotonic() - self._pending_recovery_t0
             self._pending_recovery_t0 = None
-            _m_recovery_seconds.observe(dt)
-            self.attempts[-1]["recovery_s"] = dt
-            log.info("elastic recovery complete: first step committed "
-                     "%.2fs after the failure", dt)
+            if self._recovery_kind == "grow":
+                _m_grow_recovery_seconds.observe(dt)
+                self.attempts[-1]["grow_recovery_s"] = dt
+                log.info("elastic grow complete: first step committed "
+                         "%.2fs after the grow re-mesh began", dt)
+            else:
+                _m_recovery_seconds.observe(dt)
+                self.attempts[-1]["recovery_s"] = dt
+                log.info("elastic recovery complete: first step committed "
+                         "%.2fs after the failure", dt)
+
+    def note_checkpoint(self, epoch: int, step: Optional[int]):
+        """A checkpoint committed durably (rename + manifest). Marks the
+        grow boundary: verdicts older than this instant become
+        admissible."""
+        self._last_ckpt_pos = (epoch, step)
+        self._last_ckpt_t = time.monotonic()
 
     def note_resume(self, pos, params_digest):
         self._last_ckpt_pos = pos
@@ -496,13 +734,49 @@ class ElasticFitCoordinator:
                 for d in self.groups[h]]
 
     def fit(self, df):
+        """Drive ``learner.fit``'s core through the recovery loop."""
+        return self.run(lambda devices, ctx: self.learner._fit_core(
+            df, devices=devices, elastic_ctx=ctx))
+
+    def fit_stream(self, batches_fn):
+        """Drive ``learner.fitStream``'s core through the recovery loop:
+        a host loss re-meshes and re-enters the stream (the epoch
+        restarts — a generator cannot seek — with the checkpointed
+        optimizer state kept)."""
+        return self.run(lambda devices, ctx: self.learner._fit_stream_core(
+            batches_fn, devices=devices, elastic_ctx=ctx))
+
+    def relaunch_host(self, host_id: str) -> HostHeartbeat:
+        """Simulated-preemption RELAUNCH (single-process failure domains:
+        chaos tests, ``bench.py --chaos-train``): replace a killed host's
+        beacon with a fresh one carrying the ``joining`` flag — exactly
+        the heartbeat a real relaunched host process writes on boot. The
+        supervisor turns its sustained freshness into a grow verdict."""
+        if host_id not in self.groups:
+            raise ValueError(f"unknown host {host_id!r}")
+        old = self.heartbeats.get(host_id)
+        if old is not None:
+            old.kill()
+        hb = HostHeartbeat(host_id, self.hb_dir, self._hb_interval,
+                           joining=True)
+        self.heartbeats[host_id] = hb
+        hb.start()
+        return hb
+
+    def run(self, attempt_fn):
+        """The recovery loop: ``attempt_fn(devices, ctx)`` until it
+        returns. :class:`HostLossError` shrinks the mesh,
+        :class:`HostRejoinError` grows it back (both re-enter from the
+        consensus checkpoint); transient failures without a verdict burn
+        the ``max_failures`` budget on the same mesh."""
         from ..parallel import mesh as meshlib
         if meshlib.effective_process_count() > 1:
             # real multi-process fleet: heartbeats + verdicts run (fast,
             # clean failure instead of a hung collective), but an in-job
             # re-mesh cannot outlive a jax.distributed member loss — the
-            # launcher relaunches smaller and consensus-resume continues
-            return self._fit_multiprocess(df)
+            # launcher relaunches the fleet and consensus-resume
+            # continues (growing back to full size counts as the grow)
+            return self._run_multiprocess(attempt_fn)
         ctx = ElasticStepContext(self)
         for h in self.heartbeats.values():
             h.start()
@@ -517,17 +791,22 @@ class ElasticFitCoordinator:
                     with telemetry.trace.span("elastic/attempt",
                                               hosts=len(self._mesh_hosts),
                                               devices=len(pool)):
-                        return self.learner._fit_core(df, devices=pool,
-                                                      elastic_ctx=ctx)
+                        return attempt_fn(pool, ctx)
                 except HostLossError as e:
                     self._pending_recovery_t0 = time.monotonic()
+                    self._recovery_kind = "loss"
                     self._remesh(e.hosts)
+                except HostRejoinError as e:
+                    self._pending_recovery_t0 = time.monotonic()
+                    self._recovery_kind = "grow"
+                    self._grow(e.hosts)
                 except Exception as e:
                     if not default_transient(e):
                         raise
                     # transient exhaustion with no verdict yet: force a
                     # probe pass — the failure may BE the dying host
                     self._pending_recovery_t0 = time.monotonic()
+                    self._recovery_kind = "loss"
                     self.supervisor.tick()
                     dead = self.dead_mesh_hosts()
                     if dead:
@@ -550,6 +829,36 @@ class ElasticFitCoordinator:
             for h in self.heartbeats.values():
                 h.stop()
 
+    def _grow(self, hosts):
+        """Admit grow-verdict holders back into the mesh (capped by
+        ``max_hosts``) and re-enter the fit: the next attempt's pool is
+        survivors + joiners and resumes from the checkpoint boundary
+        that armed the grow — replays only, no fleet restart."""
+        faults.inject("elastic.remesh")
+        admitted = []
+        for h in sorted(hosts):
+            if len(self.supervisor.alive_hosts()) >= self.max_hosts:
+                log.warning("host %s holds a grow verdict but the fleet "
+                            "is at elasticMaxHosts (%d); leaving it "
+                            "parked", h, self.max_hosts)
+                break
+            self.supervisor.admit(h)
+            hb = self.heartbeats.get(h)
+            if hb is not None:
+                hb.set_joining(False)
+            admitted.append(h)
+        if not admitted:
+            return
+        _m_grows.inc()
+        telemetry.trace.instant("elastic/grow",
+                                joined=",".join(admitted),
+                                alive=len(self.supervisor.alive_hosts()))
+        telemetry.flight.note("elastic/grow", joined=admitted)
+        log.warning(
+            "growing the mesh: host(s) %s rejoin at checkpoint %s; "
+            "%d host(s) in the pool", admitted, self._last_ckpt_pos,
+            len(self.supervisor.alive_hosts()))
+
     def _remesh(self, dead_hosts, cause=None):
         faults.inject("elastic.remesh")
         if self.supervisor.decision() == "restart":
@@ -569,7 +878,7 @@ class ElasticFitCoordinator:
             len(self.supervisor.alive_hosts()),
             f" (trigger: {cause!r})" if cause is not None else "")
 
-    def _fit_multiprocess(self, df):
+    def _run_multiprocess(self, attempt_fn):
         import jax
         host_id = f"host{jax.process_index()}"
         hb = self.heartbeats.get(host_id)
@@ -581,7 +890,7 @@ class ElasticFitCoordinator:
         try:
             self.attempts.append({"hosts": sorted(self.groups),
                                   "devices": len(jax.devices())})
-            return self.learner._fit_core(df, elastic_ctx=ctx)
+            return attempt_fn(None, ctx)
         finally:
             self.supervisor.stop()
             if hb is not None:

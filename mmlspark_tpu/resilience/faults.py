@@ -32,7 +32,8 @@ Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``fleet.respond``, ``fleet.transform``, ``serving.transform``,
 ``http.request``, ``powerbi.post``, ``dataplane.put``,
 ``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
-``supervisor.heartbeat``, ``elastic.step``, ``elastic.remesh``.
+``supervisor.heartbeat``, ``supervisor.rejoin``, ``elastic.step``,
+``elastic.remesh``, ``ckpt.write``, ``ckpt.rename``.
 """
 
 from __future__ import annotations
@@ -62,8 +63,8 @@ KINDS = ("error", "delay")
 SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
          "serving.transform", "http.request", "powerbi.post",
          "dataplane.put", "dataplane.allgather", "trainer.step",
-         "supervisor.probe", "supervisor.heartbeat", "elastic.step",
-         "elastic.remesh")
+         "supervisor.probe", "supervisor.heartbeat", "supervisor.rejoin",
+         "elastic.step", "elastic.remesh", "ckpt.write", "ckpt.rename")
 
 
 class InjectedFault(ConnectionError):

@@ -562,9 +562,10 @@ def test_trainer_kill_and_resume_from_step_checkpoint(tmp_path,
     # resumed at step 4: exactly 4 of the 8 steps dispatched in the refit
     step_hist = telemetry.snapshot()["mmlspark_trainer_step_seconds"]
     assert step_hist["series"][0]["count"] == 4
-    # the epoch-final checkpoint pruned its step checkpoints
+    # the epoch-final checkpoint pruned its step checkpoints (the
+    # manifest rides along — it vouches for the survivor)
     names = sorted(os.listdir(ck))
-    assert names == ["ckpt_00000.msgpack"]
+    assert names == ["ckpt_00000.msgpack", "manifest.json"]
     assert learner._latest_checkpoint() == (0, None)
 
 
@@ -800,3 +801,528 @@ def test_elastic_fit_survives_host_kill(tmp_path, telemetry_on):
     # the epoch-final checkpoint pruned its step checkpoints
     assert sorted(f for f in os.listdir(ck) if f.endswith(".msgpack")) \
         == ["ckpt_00000.msgpack"]
+
+
+# ------------------------------------- async checkpoints + commit protocol
+
+class TestAsyncCheckpointWriter:
+    """resilience/ckpt.py: depth-1 newest-wins queue, wait barrier,
+    manifest-last commit protocol."""
+
+    def test_publish_commits_manifest_last(self, tmp_path):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        ckpt.publish(os.path.join(d, "ckpt_00000.msgpack"), b"x" * 64)
+        files = ckpt.load_manifest(d)
+        assert files["ckpt_00000.msgpack"]["size"] == 64
+        assert ckpt.verify(d, "ckpt_00000.msgpack")
+
+    def test_newest_wins_coalescing(self, tmp_path, telemetry_on):
+        from mmlspark_tpu.resilience.ckpt import AsyncCheckpointWriter
+        d = str(tmp_path)
+        written = []
+
+        def slow_payload(tag):
+            def fn():
+                time.sleep(0.15)
+                written.append(tag)
+                return tag.encode()
+            return fn
+
+        w = AsyncCheckpointWriter("t")
+        try:
+            # first starts immediately; 2 and 3 land while it is in
+            # flight -> 2 is coalesced away, 3 survives
+            w.submit(os.path.join(d, "ckpt_00001.msgpack"),
+                     slow_payload("one"))
+            time.sleep(0.03)          # let the worker pick up "one"
+            w.submit(os.path.join(d, "ckpt_00002.msgpack"),
+                     slow_payload("two"))
+            w.submit(os.path.join(d, "ckpt_00003.msgpack"),
+                     slow_payload("three"))
+            assert w.wait(timeout=10)
+        finally:
+            w.close()
+        assert written == ["one", "three"]
+        names = sorted(f for f in os.listdir(d) if f.endswith(".msgpack"))
+        assert names == ["ckpt_00001.msgpack", "ckpt_00003.msgpack"]
+        snap = telemetry.snapshot()
+        assert snap["mmlspark_ckpt_coalesced_total"]["series"][0]["value"] \
+            == 1
+
+    def test_writer_error_surfaces_at_wait(self, tmp_path):
+        from mmlspark_tpu.resilience.ckpt import AsyncCheckpointWriter
+        faults.configure("ckpt.write:error:1.0", seed=0)
+        w = AsyncCheckpointWriter("t")
+        try:
+            w.submit(str(tmp_path / "ckpt_00000.msgpack"), lambda: b"x")
+            with pytest.raises(ConnectionError):
+                w.wait(timeout=10)
+        finally:
+            faults.clear()
+            w.close()
+        # the failed write published nothing
+        assert not (tmp_path / "ckpt_00000.msgpack").exists()
+
+    @pytest.mark.chaos
+    def test_crash_at_rename_leaves_no_candidate(self, tmp_path,
+                                                 telemetry_on):
+        """A fault at ckpt.rename (crash between write and publish):
+        the final name never appears, the manifest is untouched, and the
+        previous checkpoint remains the consensus candidate."""
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        ckpt.publish(os.path.join(d, "ckpt_00000_s0000001.msgpack"),
+                     b"good")
+        faults.configure("ckpt.rename:error:1.0", seed=0)
+        try:
+            with pytest.raises(ConnectionError):
+                ckpt.publish(
+                    os.path.join(d, "ckpt_00000_s0000003.msgpack"),
+                    b"doomed")
+        finally:
+            faults.clear()
+        assert not os.path.exists(
+            os.path.join(d, "ckpt_00000_s0000003.msgpack"))
+        assert "ckpt_00000_s0000003.msgpack" not in ckpt.load_manifest(d)
+        assert ckpt.verify(d, "ckpt_00000_s0000001.msgpack")
+
+
+@pytest.mark.chaos
+def test_torn_checkpoint_skipped_at_resume(tmp_path, telemetry_on):
+    """A ckpt file the manifest never vouched for (rename landed, crash
+    before the manifest commit) must not become the consensus candidate:
+    resume skips it, counts it corrupt, and falls back."""
+    ck = str(tmp_path / "ck")
+    df = _toy_df(32)                       # 4 steps -> ckpts at s1, s3
+    faults.configure("trainer.step:error:1.0:3", seed=0)   # die at step 3
+    with pytest.raises(ConnectionError):
+        _toy_learner(ck).fit(df)
+    faults.clear()
+    learner = _toy_learner(ck)
+    assert learner._latest_checkpoint() == (0, 1)
+    # forge a NEWER checkpoint that skipped the manifest commit
+    with open(os.path.join(ck, "ckpt_00000_s0000003.msgpack"), "wb") as f:
+        f.write(b"torn garbage")
+    assert learner._latest_checkpoint() == (0, 1)     # skipped, not picked
+    snap = telemetry.snapshot()
+    assert snap["mmlspark_ckpt_corrupt_total"]["series"][0]["value"] >= 1
+    # and the refit trains through from the good checkpoint
+    model = learner.fit(df)
+    assert np.isfinite(model._final_loss)
+
+
+@pytest.mark.chaos
+def test_corrupt_checkpoint_content_falls_back(tmp_path, telemetry_on):
+    """Manifest-listed but content-corrupt (bit rot / truncation after
+    commit): the sha check at restore time rejects it and the resume
+    falls back to the previous checkpoint instead of crashing."""
+    ck = str(tmp_path / "ck")
+    df = _toy_df(32)
+    faults.configure("trainer.step:error:1.0:3", seed=0)
+    with pytest.raises(ConnectionError):
+        _toy_learner(ck).fit(df)
+    faults.clear()
+    # corrupt the newest checkpoint IN PLACE, fixing up the manifest size
+    # so only the content hash can catch it
+    from mmlspark_tpu.resilience import ckpt as ckptlib
+    name = "ckpt_00000_s0000001.msgpack"
+    size = os.path.getsize(os.path.join(ck, name))
+    with open(os.path.join(ck, name), "wb") as f:
+        f.write(b"\xff" * size)
+    learner = _toy_learner(ck)
+    assert learner._latest_checkpoint() == (0, 1)   # size still matches
+    model = learner.fit(df)                         # sha rejects -> fresh
+    assert np.isfinite(model._final_loss)
+    snap = telemetry.snapshot()
+    assert snap["mmlspark_ckpt_corrupt_total"]["series"][0]["value"] >= 1
+
+
+def test_step_checkpoint_retention_keep_last_k(tmp_path):
+    """checkpointKeepSteps bounds a long fit's step-ckpt accumulation:
+    only the newest K survive as new ones commit."""
+    ck = str(tmp_path / "ck")
+    df = _toy_df(128)                      # 16 steps, ckpt every 2
+    faults.configure("trainer.step:error:1.0:14", seed=0)  # die at s14
+    with pytest.raises(ConnectionError):
+        _toy_learner(ck).fit(df)           # keep default: 3
+    faults.clear()
+    steps = sorted(f for f in os.listdir(ck)
+                   if f.endswith(".msgpack") and "_s" in f)
+    assert steps == ["ckpt_00000_s%07d.msgpack" % s for s in (9, 11, 13)]
+    # and the retained set resumes fine
+    model = _toy_learner(ck).fit(df)
+    assert np.isfinite(model._final_loss)
+
+
+@pytest.mark.chaos
+def test_async_checkpoint_kill_and_resume(tmp_path, telemetry_on):
+    """asyncCheckpoint=True preserves the kill-and-resume contract: the
+    background-published checkpoints are manifest-verified and the refit
+    resumes from the newest committed one."""
+    ck = str(tmp_path / "ck")
+    df = _toy_df(64)
+    faults.configure("trainer.step:error:1.0:5", seed=0)
+    with pytest.raises(ConnectionError):
+        _toy_learner(ck).setAsyncCheckpoint(True).fit(df)
+    faults.clear()
+    learner = _toy_learner(ck).setAsyncCheckpoint(True)
+    pos = learner._latest_checkpoint()
+    assert pos is not None and pos[1] is not None
+    from mmlspark_tpu.resilience import ckpt as ckptlib
+    assert ckptlib.load_manifest(ck)       # commits went through the protocol
+    model = learner.fit(df)
+    assert np.isfinite(model._final_loss)
+
+
+# ---------------------------------------------- heartbeat hardening + grow
+
+def test_heartbeat_write_retry_and_errors_counter(tmp_path, telemetry_on):
+    """A shared-FS outage must not silently kill the beacon thread: the
+    write retries, exhaustion is counted, and the beacon resumes once
+    storage heals."""
+    from mmlspark_tpu.resilience.elastic import HostHeartbeat
+    d = str(tmp_path / "hb")
+    hb = HostHeartbeat("hostX", d, interval=0.03).start()
+    try:
+        time.sleep(0.05)
+        assert os.path.exists(hb.path)
+        # simulate the outage: the directory becomes unwritable (a file
+        # squats on its name)
+        import shutil
+        shutil.rmtree(d)
+        with open(d, "w") as f:
+            f.write("squatter")
+        deadline = time.time() + 5
+        snap = {}
+        while time.time() < deadline:
+            snap = telemetry.snapshot()
+            series = snap.get("mmlspark_elastic_heartbeat_errors_total",
+                              {}).get("series", [])
+            if any(s["value"] > 0 for s in series):
+                break
+            time.sleep(0.02)
+        series = snap["mmlspark_elastic_heartbeat_errors_total"]["series"]
+        assert any(s["labels"]["host"] == "hostX" and s["value"] > 0
+                   for s in series)
+        assert hb._thread.is_alive()       # the beacon survived
+        # storage heals -> beats resume
+        os.remove(d)
+        os.makedirs(d)
+        deadline = time.time() + 5
+        while time.time() < deadline and not os.path.exists(hb.path):
+            time.sleep(0.02)
+        assert os.path.exists(hb.path)
+    finally:
+        hb.stop()
+
+
+def test_supervisor_clears_stale_heartbeats(tmp_path):
+    """hb_*.json ghosts from a previous run must not produce instant
+    verdicts on a reused checkpointDir."""
+    from mmlspark_tpu.resilience.elastic import TrainSupervisor
+    d = str(tmp_path)
+    with open(os.path.join(d, "hb_host0.json"), "w") as f:
+        json.dump({"host": "host0", "time": time.time() - 3600,
+                   "epoch": 4, "step": 9}, f)
+    fresh = {"host": "host1", "time": time.time(), "epoch": 0, "step": 0}
+    with open(os.path.join(d, "hb_host1.json"), "w") as f:
+        json.dump(fresh, f)
+    sup = TrainSupervisor(["host0", "host1"], d, grace=60.0)
+    sup.clear_stale_heartbeats()
+    assert not os.path.exists(os.path.join(d, "hb_host0.json"))  # ghost
+    assert os.path.exists(os.path.join(d, "hb_host1.json"))      # fresh
+    sup.tick()          # missing file is inside the startup grace: alive
+    assert sup.dead_hosts() == set()
+
+
+class TestGrowVerdicts:
+    """The death pass's mirror: joining heartbeats -> grow verdicts."""
+
+    def _dead_sup(self, d, **kw):
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        sup = TrainSupervisor(["host0", "host1"], d, grace=1.0, **kw)
+        sup._dead.add("host1")
+        return sup
+
+    def _write_hb(self, d, host, joining, age=0.0):
+        with open(os.path.join(d, f"hb_{host}.json"), "w") as f:
+            json.dump({"host": host, "time": time.time() - age,
+                       "epoch": 0, "step": 0,
+                       **({"joining": True} if joining else {})}, f)
+
+    def test_flagless_zombie_stays_dead(self, tmp_path):
+        d = str(tmp_path)
+        sup = self._dead_sup(d, rejoin_grace=0.0)
+        self._write_hb(d, "host1", joining=False)    # zombie, no flag
+        sup.tick()
+        assert sup.joining_hosts() == {}
+        assert sup.dead_hosts() == {"host1"}
+
+    def test_joining_heartbeat_earns_grow_verdict(self, tmp_path):
+        d = str(tmp_path)
+        sup = self._dead_sup(d, rejoin_grace=0.0)
+        self._write_hb(d, "host1", joining=True)
+        sup.tick()
+        assert set(sup.joining_hosts()) == {"host1"}
+        # verdict is NOT an admit: still dead until the coordinator
+        # admits at a checkpoint boundary
+        assert sup.dead_hosts() == {"host1"}
+        sup.admit("host1")
+        assert sup.dead_hosts() == set()
+        assert sup.joining_hosts() == {}
+
+    def test_rejoin_grace_window(self, tmp_path):
+        d = str(tmp_path)
+        sup = self._dead_sup(d, rejoin_grace=0.2)
+        self._write_hb(d, "host1", joining=True)
+        sup.tick()
+        assert sup.joining_hosts() == {}       # window not yet served
+        time.sleep(0.25)
+        self._write_hb(d, "host1", joining=True)   # still fresh
+        sup.tick()
+        assert set(sup.joining_hosts()) == {"host1"}
+
+    def test_stale_joining_heartbeat_restarts_window(self, tmp_path):
+        d = str(tmp_path)
+        sup = self._dead_sup(d, rejoin_grace=0.2)
+        self._write_hb(d, "host1", joining=True)
+        sup.tick()
+        time.sleep(0.25)
+        self._write_hb(d, "host1", joining=True, age=5.0)   # went stale
+        sup.tick()
+        assert sup.joining_hosts() == {}       # flap: window restarted
+
+    def test_rejoin_fault_site(self, tmp_path, telemetry_on):
+        d = str(tmp_path)
+        sup = self._dead_sup(d, rejoin_grace=0.0)
+        self._write_hb(d, "host1", joining=True)
+        faults.configure("supervisor.rejoin:error:1.0", seed=0)
+        with pytest.raises(ConnectionError):
+            sup._grow_pass()
+
+
+@pytest.mark.chaos
+def test_elastic_fit_grows_back_after_relaunch(tmp_path, telemetry_on):
+    """THE grow guarantee: a host killed mid-fit shrinks the mesh; its
+    relaunch (joining heartbeat) earns a grow verdict and the mesh grows
+    back to full size at the next checkpoint boundary — no fleet
+    restart, every step committed, replays only."""
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+
+    ck = str(tmp_path / "ck")
+    df = _toy_df(64)                      # 8 steps/epoch
+    learner = _elastic_learner(ck, epochs=2).setAsyncCheckpoint(True)
+    faults.configure("trainer.step:delay:1.0:0.08", seed=3)  # pace the fit
+    coord = ElasticFitCoordinator(learner, n_hosts=4, grace=0.3,
+                                  heartbeat_interval=0.05,
+                                  rejoin_grace=0.1)
+    done = threading.Event()
+
+    def chaos_script():
+        # kill host2 at the first step checkpoint, relaunch it once the
+        # shrink re-mesh is underway
+        while not done.is_set():
+            if os.path.isdir(ck) and any(
+                    "_s" in f for f in os.listdir(ck)
+                    if f.endswith(".msgpack")):
+                coord.heartbeats["host2"].kill()
+                break
+            time.sleep(0.005)
+        while not done.is_set():
+            if len(coord.attempts) >= 2:
+                coord.relaunch_host("host2")
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=chaos_script, daemon=True)
+    t.start()
+    try:
+        model = coord.fit(df)
+    finally:
+        done.set()
+        t.join(timeout=5)
+        faults.clear()
+    assert np.isfinite(model._final_loss)
+
+    # shrink happened, then grow: the final attempt runs on all 4 hosts
+    # and host2 is alive again
+    assert len(coord.attempts) >= 3
+    assert coord.attempts[-1]["hosts"] == ["host0", "host1", "host2",
+                                           "host3"]
+    assert coord.attempts[-1]["devices"] == 8
+    assert coord.supervisor.dead_hosts() == set()
+    grow = next(a for a in coord.attempts if "grow_recovery_s" in a)
+    assert grow["grow_recovery_s"] > 0
+    snap = telemetry.snapshot()
+    assert snap["mmlspark_elastic_grows_total"]["series"][0]["value"] >= 1
+    rejoins = snap["mmlspark_elastic_rejoins_total"]["series"]
+    assert [s["labels"]["host"] for s in rejoins if s["value"] > 0] \
+        == ["host2"]
+    # zero lost committed steps across both epochs (replays allowed)
+    assert {(e, s) for (e, s) in coord.committed} \
+        >= {(e, s) for e in range(2) for s in range(8)}
+
+
+@pytest.mark.chaos
+def test_elastic_max_hosts_caps_grow(tmp_path):
+    """A joiner beyond elasticMaxHosts stays parked: pending_grow
+    reports nobody while the pool is at the ceiling."""
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+    coord = ElasticFitCoordinator(_elastic_learner(str(tmp_path / "ck")),
+                                  n_hosts=4, grace=60.0, max_hosts=3)
+    coord.supervisor._dead.add("host3")
+    coord._mesh_hosts = {"host0", "host1", "host2"}
+    coord.supervisor._joining["host3"] = 0.0
+    coord.note_checkpoint(0, 5)            # boundary committed
+    assert coord.pending_grow() == set()   # at the cap: parked
+    coord.max_hosts = 4
+    assert coord.pending_grow() == {"host3"}
+
+
+@pytest.mark.chaos
+def test_elastic_fitstream_survives_host_kill(tmp_path, telemetry_on):
+    """fitStream routed through the elastic coordinator: a host killed
+    mid-stream re-meshes over the survivors and the fit completes (the
+    interrupted epoch restarts from the checkpointed optimizer state)."""
+    rng = np.random.default_rng(0)
+    n = 64
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+
+    def batches():
+        for i in range(0, n, 8):
+            time.sleep(0.04)               # pace past the verdict window
+            yield x[i:i + 8], y[i:i + 8]
+
+    ck = str(tmp_path / "ck")
+    learner = (_elastic_learner(ck, epochs=2)
+               .setElastic(True).setElasticHosts(4)
+               .setElasticGraceSeconds(0.3))
+    coords = []
+    orig = learner._elastic_coordinator
+
+    def capture():
+        c = orig()
+        c._hb_interval = 0.05
+        for h in c.heartbeats.values():
+            h.interval = 0.05
+        coords.append(c)
+        return c
+
+    learner._elastic_coordinator = capture
+    done = threading.Event()
+
+    def killer():
+        while not done.is_set():
+            if coords and len(coords[0].committed) >= 2:
+                coords[0].heartbeats["host2"].kill()
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+    try:
+        model = learner.fitStream(lambda: batches())
+    finally:
+        done.set()
+        t.join(timeout=5)
+    assert np.isfinite(model._final_loss)
+    coord = coords[0]
+    assert coord.supervisor.dead_hosts() == {"host2"}
+    assert len(coord.attempts) >= 2
+    assert coord.attempts[-1]["hosts"] == ["host0", "host1", "host3"]
+    snap = telemetry.snapshot()
+    assert snap["mmlspark_elastic_remeshes_total"]["series"][0]["value"] \
+        >= 1
+
+
+@pytest.mark.chaos
+def test_elastic_gbdt_kill_and_resume(tmp_path):
+    """The boosting loop through ElasticStepContext: a host killed
+    mid-fit re-meshes and the fit resumes from the per-iteration
+    boosting snapshot — the full ensemble trains, trees built before the
+    kill survive bit-exactly."""
+    from mmlspark_tpu.models.gbdt.engine import (GBDTParams, fit_gbdt,
+                                                 fit_gbdt_elastic)
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+    from mmlspark_tpu.parallel import mesh as meshlib
+
+    rng = np.random.default_rng(0)
+    n, d = 1024, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.float32)
+    p = GBDTParams(num_iterations=10, max_depth=3, objective="binary",
+                   tree_learner="data")
+    ck = str(tmp_path / "ck")
+    coord = ElasticFitCoordinator(checkpoint_dir=ck, n_hosts=4, grace=0.3,
+                                  heartbeat_interval=0.05)
+    # pace iterations so the kill lands mid-boosting
+    faults.configure("elastic.step:delay:1.0:0.06", seed=0)
+    done = threading.Event()
+
+    def killer():
+        while not done.is_set():
+            if len(coord.committed) >= 2:      # >= 2 iterations done
+                coord.heartbeats["host2"].kill()
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=killer, daemon=True)
+    t.start()
+
+    def attempt(devices, ctx):
+        mesh = meshlib.create_mesh(devices=devices)
+        xp, n_real = meshlib.pad_batch_to_devices(x, mesh)
+        yp = np.concatenate([y, np.zeros(len(xp) - n_real, y.dtype)])
+        w = np.concatenate([np.ones(n_real, np.float32),
+                            np.zeros(len(xp) - n_real, np.float32)])
+        return fit_gbdt(xp, yp, p, mesh=mesh, sample_weight=w,
+                        elastic_ctx=ctx)
+
+    try:
+        ens = coord.run(attempt)
+    finally:
+        done.set()
+        t.join(timeout=5)
+        faults.clear()
+    assert coord.supervisor.dead_hosts() == {"host2"}
+    assert len(coord.attempts) >= 2
+    # the resumed attempt re-entered mid-boosting, not from scratch
+    resumed = coord.attempts[-1]
+    assert resumed["resume_pos"] is not None
+    assert resumed["resume_pos"][1] >= 1
+    # the full ensemble trained and the pre-kill trees survived
+    # bit-exactly (the snapshot's prefix IS the final ensemble's prefix)
+    assert ens.leaf.shape[0] == 10
+    k = resumed["resume_pos"][1] + 1
+    snap_leaves = coord.snapshot["leaves"][:k]
+    for i in range(k):
+        np.testing.assert_array_equal(np.asarray(ens.leaf)[i],
+                                      np.asarray(snap_leaves[i]))
+    from mmlspark_tpu.models.gbdt.engine import predict
+    prob = predict(ens, x)
+    pred = (prob[:, 1] if prob.ndim == 2 else prob) > 0.5
+    assert (pred.astype(np.float32) == y).mean() > 0.8
+
+
+@pytest.mark.chaos
+def test_elastic_gbdt_stage_routing(tmp_path):
+    """elasticConfig on the LightGBM stage routes the fit through the
+    coordinator (clean run: pass-through, same-quality model)."""
+    from mmlspark_tpu.models.gbdt.stages import LightGBMClassifier
+
+    rng = np.random.default_rng(0)
+    n = 9000                               # above the small-fit fallback
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = (x[:, 0] + x[:, 1] > 0).astype(np.float64)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": y})
+    model = (LightGBMClassifier()
+             .setNumIterations(5).setNumLeaves(4)
+             .setElasticConfig({"checkpointDir": str(tmp_path / "ck"),
+                                "hosts": 4, "graceSeconds": 5.0})
+             .fit(df))
+    out = model.transform(df)
+    pred = np.asarray(out.col("prediction"))
+    assert (pred == y).mean() > 0.8
