@@ -240,15 +240,79 @@ def _async_ckpt_comparison():
     return out
 
 
+def _straggler_scenario():
+    """Proactive-eviction chaos scenario: a 4-host fit where one host's
+    heartbeat progress is throttled 5x (a delayed-but-alive straggler,
+    paced by a ``delay`` fault at ``elastic.step``). The rolling-MAD
+    detector flags it, the sustained flag promotes to an EVICT verdict,
+    and the coordinator drops the slow host at the next committed
+    checkpoint boundary — verdict->first-step-on-the-smaller-mesh is
+    ``chaos_straggler_recovery_seconds``."""
+    import tempfile
+    import threading
+
+    import jax
+    from mmlspark_tpu import DataFrame
+    from mmlspark_tpu.core.utils import object_column
+    from mmlspark_tpu.models import TpuLearner
+    from mmlspark_tpu.resilience import faults
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+
+    n_hosts = min(4, len(jax.devices()))
+    rng = np.random.default_rng(1)
+    n, bs, epochs = 512, 16, 3                 # 32 steps/epoch
+    x = rng.normal(size=(n, 16)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": y})
+    ck = tempfile.mkdtemp(prefix="chaos_straggler_")
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [32, 16],
+                                "num_classes": 2})
+               .setEpochs(epochs).setBatchSize(bs).setLearningRate(0.05)
+               .setDeviceDataCap(1)
+               .setCheckpointDir(ck).setCheckpointEverySteps(4))
+    # delay (NOT error) at elastic.step: the fleet is healthy, just
+    # paced — the one slow host is simulated by throttling its
+    # heartbeat progress 5x below
+    faults.configure("elastic.step:delay:1.0:0.04", seed=11)
+    coord = ElasticFitCoordinator(learner, n_hosts=n_hosts, grace=0.4,
+                                  heartbeat_interval=0.05,
+                                  evict_after=2)
+    victim = f"host{n_hosts - 1}"       # never host0: the coordinator
+    coord.heartbeats[victim].throttle(5)
+    t0 = time.perf_counter()
+    try:
+        model = coord.fit(df)
+    finally:
+        faults.clear()
+    dt = time.perf_counter() - t0
+    recovery = next((a["evict_recovery_s"] for a in coord.attempts
+                     if "evict_recovery_s" in a), None)
+    evicted = sorted(coord.supervisor.dead_hosts())
+    assert np.isfinite(model._final_loss)
+    return {
+        "steps_per_sec": round(len(coord.committed) / dt, 1),
+        "evicted": evicted,
+        "attempts": len(coord.attempts),
+        "metric": _with_baseline({
+            "metric": "chaos_straggler_recovery_seconds",
+            "value": None if recovery is None else round(recovery, 3),
+            "unit": "s", "vs_baseline": None}),
+    }
+
+
 def chaos_train():
     """Elastic-training chaos scenario: a 4-host (simulated device-group)
     fit with 10% injected step faults loses one host mid-run (shrink
     re-mesh), then the victim RELAUNCHES with a joining heartbeat and
-    grows the mesh back at the next checkpoint boundary. Reports the
-    verdict->recovered time for both directions plus the async-ckpt
-    step-time comparison; the last printed line is one mmlspark-bench/v1
-    document the perf gate tracks (chaos_train_recovery_seconds,
-    chaos_grow_recovery_seconds)."""
+    grows the mesh back at the next checkpoint boundary; a second fit
+    EVICTS a delayed-but-alive straggler at a checkpoint boundary.
+    Reports the verdict->recovered time for all three directions plus
+    the async-ckpt step-time comparison; the last printed line is one
+    mmlspark-bench/v1 document the perf gate tracks
+    (chaos_train_recovery_seconds, chaos_grow_recovery_seconds,
+    chaos_straggler_recovery_seconds)."""
     # the scenario needs >= 4 devices to host 4 failure domains; on the
     # CPU backend force the virtual device count BEFORE jax imports
     flags = os.environ.get("XLA_FLAGS", "")
@@ -328,6 +392,7 @@ def chaos_train():
     replayed = steps_total - epochs * (n // bs)
     assert np.isfinite(model._final_loss)
     async_cmp = _async_ckpt_comparison()
+    straggler = _straggler_scenario()
     metrics = [
         _with_baseline({
             "metric": "chaos_train_recovery_seconds",
@@ -338,6 +403,7 @@ def chaos_train():
             "value": (None if grow_recovery is None
                       else round(grow_recovery, 3)),
             "unit": "s", "vs_baseline": None}),
+        straggler.pop("metric"),
     ]
     doc = {
         "schema": SCHEMA,
@@ -350,6 +416,7 @@ def chaos_train():
         "attempts": len(coord.attempts),
         "dead": sorted(coord.supervisor.dead_hosts()),
         "async_ckpt": async_cmp,
+        "straggler": straggler,
         "metrics": metrics,
     }
     print(json.dumps(doc))

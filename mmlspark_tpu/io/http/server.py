@@ -279,6 +279,14 @@ class HTTPSource:
             # supervisor (or k8s) sees budget burn without a new endpoint
             out["slo"] = self.slo.healthz()
             out["ok"] = out["ok"] and out["slo"]["ok"]
+        # an elastic fit running in this process surfaces its fleet
+        # state on the same probe: hosts alive, stragglers, pending
+        # evict/grow verdicts, rendezvous generation — an operator sees
+        # fleet health without scraping metrics
+        from ...resilience.elastic import fleet_health
+        fleet = fleet_health()
+        if fleet is not None:
+            out["elastic"] = fleet
         return out
 
     def getBatch(self, max_rows: int = 1024,

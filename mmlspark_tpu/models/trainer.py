@@ -510,6 +510,17 @@ class TpuLearner(Estimator):
         "checkpoint an elastic fit last resumed from — the consensus "
         "floor — is never pruned. Bounds a long fit's msgpack "
         "accumulation at K files per in-flight epoch", default=3, min=1)
+    checkpointShards = IntParam(
+        "split each checkpoint into this many byte-balanced shard files "
+        "(0/1 = one msgpack). Multi-process fleets write ONE shard per "
+        "host (this param arms the mode; the shard count is the process "
+        "count) so no host ever serializes the whole model; the "
+        "coordinator commits the manifest LAST, after verifying every "
+        "shard's size+sha256 — a torn shard disqualifies the whole "
+        "candidate and resume falls back to the previous committed "
+        "checkpoint. Shard count is recorded in the manifest, so an "
+        "N-shard checkpoint resumes onto any mesh size", default=0,
+        min=0)
     tensorParallel = IntParam("size of the model (TP) mesh axis", default=1,
                               min=1)
     sequenceParallel = IntParam("size of the sequence (SP) mesh axis "
@@ -612,6 +623,16 @@ class TpuLearner(Estimator):
         "checkpoint boundary only while the pool is below this many "
         "hosts (0 = the launch fleet size). Shrink is unaffected",
         default=0, min=0)
+    stragglerEvictAfter = IntParam(
+        "promote a straggler verdict (rolling-MAD step-time anomaly, "
+        "advisory by default) into a proactive EVICT after this many "
+        "consecutive flagged supervisor passes: the slow host is "
+        "dropped at the next committed checkpoint boundary — the same "
+        "unwind path as a host loss, fired BEFORE the slow-then-dead "
+        "host actually dies — and rejoins through the grow path once "
+        "recovered. Floors: survivors must satisfy elasticMinHosts and "
+        "the coordinator host is never evicted. 0 = advisory only",
+        default=0, min=0)
     sloConfig = DictParam(
         "declarative SLO config evaluated DURING this fit "
         "(telemetry.slo): either a full {'objectives': [...], "
@@ -691,16 +712,43 @@ class TpuLearner(Estimator):
         epoch boundaries, fit exit, and before any resume read. A
         writer-thread error re-raises here — unless another exception is
         already unwinding (a HostLossError mid-recovery must not be
-        masked by a failed background write; it is logged instead)."""
+        masked by a failed background write; it is logged instead).
+        Elastic multi-process fits bound the wait: a writer snapshotting
+        the output of a collective whose peer died blocks FOREVER (the
+        buffers never materialize), so past the bound the writer is
+        orphaned (daemon thread) and recovery proceeds — the
+        manifest-last protocol guarantees its partial write can never
+        become a resume candidate."""
         import sys
+        import threading
+        # an ORPHANED elastic attempt thread (abandoned while pinned in
+        # a dead collective) must not touch the live writer when its
+        # collective finally times out and it unwinds
+        active = getattr(self, "_active_fit_thread", None)
+        if active is not None \
+                and active is not threading.current_thread():
+            return
         w = getattr(self, "_ckpt_writer_inst", None)
         if w is None:
             return
+        timeout = (10.0 if getattr(self, "_elastic_multiproc", False)
+                   else None)
+
+        def _bounded_wait():
+            if w.wait(timeout=timeout):
+                return True
+            log.warning("async checkpoint writer stalled past %.0fs "
+                        "(dead-collective snapshot?); abandoning it — "
+                        "uncommitted writes can never become resume "
+                        "candidates", timeout)
+            self._ckpt_writer_inst = None
+            return False
+
         if sys.exc_info()[0] is None:
-            w.wait()
+            _bounded_wait()
             return
         try:
-            w.wait()
+            _bounded_wait()
         except Exception as e:
             log.warning("async checkpoint failure surfaced while another "
                         "error unwinds (kept secondary): %s", e)
@@ -720,6 +768,15 @@ class TpuLearner(Estimator):
         names = [f"ckpt_{epoch:05d}_s{s:07d}.msgpack" for s in drop
                  if floor is None or (epoch, s) != tuple(floor)]
         ckptlib.prune(d, names)
+
+    def _ckpt_should_write(self) -> bool:
+        """Does THIS process take part in checkpoint saves? Process 0
+        always (it owns the single-file commit); on a sharded
+        multi-process fleet every process does — each writes its own
+        shard, and only process 0 commits the head + manifest."""
+        return jax.process_index() == 0 or (
+            self.getCheckpointShards() > 0
+            and meshlib.effective_process_count() > 1)
 
     def _save_checkpoint(self, epoch: int, params, opt_state,
                          step: Optional[int] = None, scale_state=None,
@@ -755,6 +812,22 @@ class TpuLearner(Estimator):
             state_donated = scale_state is not None
         path = self._ckpt_path(epoch, step)
         keep = self.getCheckpointKeepSteps()
+        nproc = meshlib.effective_process_count()
+        # multi-process fleets snapshot INLINE even when nothing is
+        # donated: a writer-thread materialization would block on the
+        # step's collective output while the fit thread keeps enqueueing
+        # more collectives — concurrent tag-matched gloo ops from a deep
+        # async queue can wedge cross-rank. The inline device_get is the
+        # per-save materialization barrier that keeps the in-flight
+        # depth bounded (the posture every multi-host save had before
+        # sharding); serialization + IO still overlap on the writer.
+        state_donated = state_donated or nproc > 1
+        cfg_shards = self.getCheckpointShards()
+        # multi-process fleets shard per host (no host serializes the
+        # whole model); single-process splits into the configured count
+        n_shards = (nproc if (cfg_shards and nproc > 1)
+                    else (cfg_shards if cfg_shards > 1 else 0))
+        rank = jax.process_index() if nproc > 1 else 0
 
         def on_commit():
             # runs strictly AFTER the rename + manifest commit (writer
@@ -771,21 +844,106 @@ class TpuLearner(Estimator):
             if elastic_ctx is not None:
                 elastic_ctx.checkpoint_saved(epoch, step)
 
-        if self.getAsyncCheckpoint():
-            if state_donated:
-                state = build_state()     # inline: donation is imminent
-                payload = (lambda:
-                           serialization.msgpack_serialize(state))
+        # elastic multi-process fits route EVERY save through the async
+        # writer: a synchronous snapshot materializes device buffers on
+        # the fit thread, and a peer dying mid-collective would block
+        # that thread forever — on the writer thread the stall is
+        # bounded + abandoned by _ckpt_barrier instead
+        use_async = (self.getAsyncCheckpoint()
+                     or getattr(self, "_elastic_multiproc", False))
+
+        if not n_shards:
+            if use_async:
+                if state_donated:
+                    state = build_state()   # inline: donation is imminent
+                    payload = (lambda:
+                               serialization.msgpack_serialize(state))
+                else:
+                    payload = (lambda: serialization.msgpack_serialize(
+                        build_state()))
+                self._ckpt_writer().submit(path, payload,
+                                           on_commit=on_commit)
+                if step is None:
+                    self._ckpt_barrier()  # epoch boundaries stay ordered
             else:
-                payload = (lambda:
-                           serialization.msgpack_serialize(build_state()))
-            self._ckpt_writer().submit(path, payload, on_commit=on_commit)
-            if step is None:
-                self._ckpt_barrier()   # epoch boundaries stay ordered
+                ckptlib.publish(
+                    path, serialization.msgpack_serialize(build_state()))
+                on_commit()
+            return
+
+        # ---- sharded save: byte-balanced leaf partition of the full
+        # state dict; every host computes the identical split (same
+        # replicated state, sorted keys), so host i serializes shard i
+        # alone. Commit protocol: shard files first (fsync+rename, site
+        # ckpt.shard), then the coordinator verifies all shards and
+        # commits head + manifest LAST.
+        base = os.path.basename(path)
+
+        def build_flat():
+            return ckptlib.flatten_state(
+                serialization.to_state_dict(build_state()))
+
+        def split(flat):
+            keys = sorted(flat)
+            sizes = [getattr(flat[k], "nbytes", 64) for k in keys]
+            return keys, ckptlib.partition_leaves(sizes, n_shards)
+
+        shard_names = [ckptlib.shard_name(base, i) for i in range(n_shards)]
+
+        committed = {"ok": True}
+        if nproc > 1:
+            def payload_fn(flat=None):
+                flat = build_flat() if flat is None else flat
+                keys, parts = split(flat)
+                return serialization.msgpack_serialize(
+                    {keys[i]: flat[keys[i]] for i in parts[rank]})
+
+            def publish_fn(p, payload):
+                ckptlib.write_shard(
+                    os.path.join(os.path.dirname(p),
+                                 ckptlib.shard_name(base, rank)), payload)
+                if rank == 0:
+                    # a peer's newest-wins writer may have coalesced this
+                    # snapshot away: skip the commit (no manifest entry
+                    # -> never a candidate) instead of stalling the fit
+                    if ckptlib.await_shards(os.path.dirname(p),
+                                            shard_names, timeout=30.0):
+                        ckptlib.commit_sharded(p, shard_names)
+                    else:
+                        committed["ok"] = False
+                        log.warning("sharded checkpoint %s left "
+                                    "uncommitted (peer shard missing)",
+                                    base)
         else:
-            ckptlib.publish(path,
-                            serialization.msgpack_serialize(build_state()))
-            on_commit()
+            def payload_fn(flat=None):
+                flat = build_flat() if flat is None else flat
+                keys, parts = split(flat)
+                return [serialization.msgpack_serialize(
+                    {keys[i]: flat[keys[i]] for i in idxs})
+                    for idxs in parts]
+
+            publish_fn = ckptlib.publish_sharded
+
+        def on_commit_sharded():
+            # only a commit that actually landed (head + manifest) may
+            # advance the floor and fire the elastic boundary hook
+            if rank == 0 and committed["ok"]:
+                on_commit()
+
+        if use_async:
+            if state_donated:
+                flat = build_flat()       # inline: donation is imminent
+                payload = (lambda flat=flat: payload_fn(flat))
+            else:
+                payload = payload_fn
+            self._ckpt_writer().submit(path, payload,
+                                       on_commit=on_commit_sharded,
+                                       publish_fn=publish_fn)
+            if step is None:
+                self._ckpt_barrier()
+        else:
+            publish_fn(path, payload_fn())
+            on_commit_sharded()
 
     def _restore_checkpoint(self, pos: tuple, params_tmpl, opt_tmpl):
         """-> (params, opt, scale_host) — scale_host is the checkpointed
@@ -801,8 +959,21 @@ class TpuLearner(Estimator):
             blob = f.read()
         if not ckptlib.verify_bytes(d, name, blob):
             raise ckptlib.CorruptCheckpoint(name)
+        shards = ckptlib.parse_head(blob)
         try:
-            state = serialization.msgpack_restore(blob)
+            if shards is not None:
+                # sharded checkpoint: content-verify + merge every shard
+                # and rebuild the state dict; the shard count came from
+                # the manifest, not the current mesh, so an N-shard save
+                # restores onto any fleet size
+                flat: dict = {}
+                for sblob in ckptlib.read_shards(d, shards):
+                    flat.update(serialization.msgpack_restore(sblob))
+                state = ckptlib.unflatten_state(flat)
+            else:
+                state = serialization.msgpack_restore(blob)
+        except ckptlib.CorruptCheckpoint:
+            raise
         except Exception as e:
             ckptlib.note_corrupt(name, f"undecodable: {e}")
             raise ckptlib.CorruptCheckpoint(name) from e
@@ -984,7 +1155,8 @@ class TpuLearner(Estimator):
             min_hosts=self.getElasticMinHosts(),
             grace=self.getElasticGraceSeconds() or None,
             max_failures=self.getElasticMaxFailures(),
-            max_hosts=self.getElasticMaxHosts())
+            max_hosts=self.getElasticMaxHosts(),
+            evict_after=self.getStragglerEvictAfter())
 
     def fit(self, df: DataFrame) -> TpuModel:
         with self._slo_session():
@@ -1003,6 +1175,11 @@ class TpuLearner(Estimator):
         # distributed path and tests already configure it)
         from ..parallel.distributed import configure_xla_cache
         configure_xla_cache()
+        # rendezvous-armed fleets: snapshots go to the writer thread and
+        # stalled writers are abandoned (see _save_checkpoint/_ckpt_barrier)
+        self._elastic_multiproc = bool(
+            elastic_ctx is not None
+            and getattr(elastic_ctx._coord, "_multiproc", False))
         cfg = self._cfg_with_precision(dict(self.getModelConfig()))
         x = _prep_input(df, self.getFeaturesCol(), tuple(self.getInputShape()))
         if cfg.get("type") in TOKEN_MODELS:
@@ -1194,8 +1371,14 @@ class TpuLearner(Estimator):
         # interleave collective programs across the same devices — same
         # deadlock guard as the GBDT fit path (parallel/mesh.py)
         import contextlib
-        guard = (meshlib.collective_fit_lock if mesh.size > 1
-                 else contextlib.nullcontext())
+        # elastic multi-process attempts run on abandonable threads; an
+        # orphaned (pinned-in-dead-collective) attempt may still hold the
+        # reentrant fit lock, and it can never issue a collective on the
+        # NEW backend — skip the lock there, keep it everywhere else
+        guard = (contextlib.nullcontext()
+                 if getattr(self, "_elastic_multiproc", False)
+                 else (meshlib.collective_fit_lock if mesh.size > 1
+                       else contextlib.nullcontext()))
         try:
             with guard, telemetry.trace.span(
                     "fit", model=cfg.get("type"), rows=n,
@@ -1258,6 +1441,9 @@ class TpuLearner(Estimator):
 
     def _fit_stream_core(self, batches_fn, devices=None,
                          elastic_ctx=None) -> TpuModel:
+        self._elastic_multiproc = bool(
+            elastic_ctx is not None
+            and getattr(elastic_ctx._coord, "_multiproc", False))
         cfg = self._cfg_with_precision(dict(self.getModelConfig()))
         if (self.getSequenceParallel() > 1 or self.getExpertParallel() > 1
                 or self.getPipelineParallel() > 1):
@@ -1328,8 +1514,14 @@ class TpuLearner(Estimator):
         from ..parallel import prefetch as prefetchlib
         axis = mesh.shape["data"]
         import contextlib
-        guard = (meshlib.collective_fit_lock if mesh.size > 1
-                 else contextlib.nullcontext())
+        # elastic multi-process attempts run on abandonable threads; an
+        # orphaned (pinned-in-dead-collective) attempt may still hold the
+        # reentrant fit lock, and it can never issue a collective on the
+        # NEW backend — skip the lock there, keep it everywhere else
+        guard = (contextlib.nullcontext()
+                 if getattr(self, "_elastic_multiproc", False)
+                 else (meshlib.collective_fit_lock if mesh.size > 1
+                       else contextlib.nullcontext()))
         last_loss = None
         skipped_seen = 0
         with guard:
@@ -1384,7 +1576,7 @@ class TpuLearner(Estimator):
                             elastic_ctx.step_committed(epoch,
                                                        steps_run - 1)
                         if ckpt_every and steps_run % ckpt_every == 0 \
-                                and jax.process_index() == 0:
+                                and self._ckpt_should_write():
                             self._save_checkpoint(epoch, params, opt_state,
                                                   step=steps_run - 1,
                                                   scale_state=scale_state,
@@ -1408,7 +1600,7 @@ class TpuLearner(Estimator):
                     raise RuntimeError(
                         f"training diverged: epoch {epoch} loss {last_loss} "
                         f"(lr={self.getLearningRate()})")
-                if self.getCheckpointDir() and jax.process_index() == 0:
+                if self.getCheckpointDir() and self._ckpt_should_write():
                     self._save_checkpoint(epoch, params, opt_state,
                                           scale_state=scale_state,
                                           elastic_ctx=elastic_ctx)
@@ -1587,7 +1779,7 @@ class TpuLearner(Estimator):
                     elastic_ctx.step_committed(epoch, s)
                 if s < steps - 1:
                     if ckpt_every and (s + 1) % ckpt_every == 0 \
-                            and jax.process_index() == 0:
+                            and self._ckpt_should_write():
                         self._save_checkpoint(epoch, params, opt_state,
                                               step=s,
                                               scale_state=scale_state,
@@ -1614,7 +1806,7 @@ class TpuLearner(Estimator):
                            f"resumes there." if last_good is not None
                            else "Set checkpointDir to make divergence "
                                 "resumable."))
-                if self.getCheckpointDir() and jax.process_index() == 0:
+                if self.getCheckpointDir() and self._ckpt_should_write():
                     self._save_checkpoint(epoch, params, opt_state,
                                           scale_state=scale_state,
                                           elastic_ctx=elastic_ctx)

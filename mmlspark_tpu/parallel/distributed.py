@@ -28,15 +28,28 @@ two-process test in tests/test_parallel_depth.py).
 
 from __future__ import annotations
 
+import json
 import os
+import socket
+import time
 from typing import Optional, Sequence
 
 import jax
 
+from .. import telemetry
 from ..core.utils import get_logger
 from . import mesh as meshlib
 
 log = get_logger("distributed")
+
+_m_generation = telemetry.registry.gauge(
+    "mmlspark_rendezvous_generation",
+    "the jax.distributed incarnation this process is currently joined "
+    "to (bumped by every elastic re-rendezvous; 0 = never rendezvoused)")
+_m_rendezvous = telemetry.registry.counter(
+    "mmlspark_rendezvous_total",
+    "re-rendezvous joins completed (coordinator-service restart + "
+    "barrier re-entry into a new generation)")
 
 # launcher-agnostic env contract (set by the Spark-executor / TPU-VM launcher)
 ENV_COORDINATOR = "MMLTPU_COORDINATOR"       # "host:port" of process 0
@@ -166,6 +179,448 @@ def shutdown() -> None:
     if _initialized:
         jax.distributed.shutdown()
         _initialized = False
+
+
+# ---- elastic re-rendezvous -------------------------------------------------
+#
+# The fail-fast model above is right for fixed fleets: a dead peer takes
+# the job down inside the heartbeat bound and the launcher relaunches at
+# full size. Elastic fleets want the JAMPI barrier-re-entry shape instead
+# (PAPERS.md arxiv 2007.01811): the survivors tear the coordination
+# service down, restart it on the surviving lowest-rank host, and every
+# member re-enters the rendezvous barrier under a NEW generation — so a
+# kill -9'd process can relaunch and join the *same running fit*, and a
+# straggler can be evicted without losing the fleet.
+#
+# The generation is carried by an atomically-renamed ``rendezvous.json``
+# on the job's shared checkpoint storage (the same trust anchor the
+# consensus checkpoints use): {generation, address, ranks}. Only the
+# leader (lowest-rank surviving host) writes it; everyone else polls.
+# A process may only ever JOIN a generation strictly newer than the one
+# it last held AND that names it in ``ranks`` — a stale-generation
+# process can therefore never join the wrong incarnation; it parks in
+# the joining-heartbeat path until a future generation includes it.
+#
+# Teardown deliberately does NOT call client.shutdown(): with a dead
+# peer the coordination-service shutdown barrier aborts the process
+# (client.h LogFatal). Instead the dead generation's client/service are
+# LEAKED (bounded by the number of re-rendezvous events), the cached XLA
+# backends are dropped, and the new generation's client is built with a
+# benign missed-heartbeat callback + shutdown_on_destruction=False so
+# neither the leak nor a later peer death can terminate the process —
+# the elastic runtime's own heartbeat verdicts are the failure signal.
+
+RENDEZVOUS_DOC = "rendezvous.json"
+
+ENV_HOST_ADDRESS = "MMLTPU_HOST_ADDRESS"     # advertised rendezvous addr
+ENV_REJOIN_TIMEOUT = "MMLTPU_REJOIN_TIMEOUT"  # seconds to wait for a
+DEFAULT_REJOIN_TIMEOUT = 120.0                # generation that names us
+
+_leaked_incarnations: list = []   # dead generations' client/service pairs
+_rdzv_coordinator: Optional["RendezvousCoordinator"] = None
+
+
+class RendezvousError(RuntimeError):
+    """A re-rendezvous attempt failed (proposal raced, barrier timed
+    out, init refused). Retried with backoff by the caller; exhaustion
+    falls back to relaunch-at-full-size (ElasticFleetLost)."""
+
+
+def rendezvous_coordinator() -> Optional["RendezvousCoordinator"]:
+    """The process-wide rendezvous coordinator, armed by
+    :func:`elastic_initialize` (None = fixed-fleet mode: a member loss
+    fails fast and the launcher relaunches)."""
+    return _rdzv_coordinator
+
+
+def _advertised_address() -> str:
+    """The address peers can reach THIS host on (the new coordinator
+    service binds here after a leader takeover)."""
+    addr = os.environ.get(ENV_HOST_ADDRESS)
+    if addr:
+        return addr
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _init_elastic_client(address: str, num_processes: int, process_id: int,
+                         init_timeout: int):
+    """Stand up one generation's coordination service (leader) + client,
+    with the survivable failure posture: the ELASTIC runtime's own file
+    heartbeats are the failure detector, so the coordination service's
+    redundant one is configured effectively inert (a peer death must
+    never let the service flag an error back into surviving clients —
+    the default client reaction to a polled error is process
+    termination), and the client is built with shutdown_on_destruction
+    off plus a log-only missed-heartbeat callback as the last line of
+    defense."""
+    from jax._src import distributed as dist_internal
+    from jax._src.lib import xla_extension as xe
+    st = dist_internal.global_state
+    if st.client is not None:
+        raise RendezvousError("previous incarnation still attached; "
+                              "teardown_for_rendezvous() first")
+    # ~11 days of missed heartbeats before the redundant detector acts
+    hb_interval, hb_tolerance = 10, 100_000
+    if process_id == 0:
+        port = address.rsplit(":", 1)[1]
+        st.service = xe.get_distributed_runtime_service(
+            f"[::]:{port}", num_processes,
+            heartbeat_interval=hb_interval,
+            max_missing_heartbeats=hb_tolerance)
+
+    def _on_peer_trouble(*status):
+        log.warning("coordination-service error (peer died or network "
+                    "trouble); elastic heartbeat verdicts drive the "
+                    "recovery: %s", status)
+
+    st.client = xe.get_distributed_runtime_client(
+        address, process_id, init_timeout=init_timeout,
+        shutdown_timeout=10,
+        heartbeat_interval=hb_interval,
+        max_missing_heartbeats=hb_tolerance,
+        missed_heartbeat_callback=_on_peer_trouble,
+        shutdown_on_destruction=False, use_compression=True)
+    st.client.connect()
+    st.process_id = process_id
+    st.num_processes = num_processes
+    st.coordinator_address = address
+    _register_exit_detach()
+    global _initialized
+    _initialized = True
+
+
+_exit_detach_registered = False
+
+
+def _register_exit_detach():
+    """jax registers an atexit ``clean_up`` that runs the coordination
+    shutdown BARRIER — against an elastic fleet whose members exit at
+    different times (a peer may be long dead) that barrier hangs or
+    aborts. Our handler registers LATER, so it runs FIRST (atexit is
+    LIFO): when the fleet looks healthy (every current-generation
+    peer's heartbeat file is fresh — everyone is exiting through the
+    same barrier), shut down gracefully inside the 10 s bound; when a
+    peer is dead, DETACH instead — an abrupt disconnect must never let
+    the coordination service flag an error back into this (or another)
+    exiting process, because the error-poll callback crossing into
+    Python during interpreter teardown aborts the process."""
+    global _exit_detach_registered
+    if _exit_detach_registered:
+        return
+    _exit_detach_registered = True
+    import atexit
+    from jax._src import distributed as dist_internal
+
+    def _detach():
+        st = dist_internal.global_state
+        client, service = st.client, st.service
+        st.client = None
+        st.service = None
+        st.preemption_sync_manager = None
+        if client is None:
+            return
+        rdzv = _rdzv_coordinator
+        healthy = True
+        if rdzv is not None and rdzv.ranks:
+            now = time.time()
+            for h in rdzv.ranks:
+                if h == rdzv.host_id:
+                    continue
+                try:
+                    fresh = now - os.path.getmtime(os.path.join(
+                        rdzv.directory, f"hb_{h}.json")) <= 10.0
+                except OSError:
+                    fresh = False
+                if not fresh:
+                    healthy = False
+                    break
+        if healthy:
+            try:
+                client.shutdown()
+                if service is not None:
+                    service.shutdown()
+                return
+            except Exception:
+                pass
+        _leaked_incarnations.append((client, service))
+
+    atexit.register(_detach)
+
+
+def teardown_for_rendezvous() -> None:
+    """Detach from the current (dead) incarnation WITHOUT the shutdown
+    barrier, and drop the cached XLA backends so the next collective
+    program instantiates against the new generation's KV store. The old
+    client/service objects are leaked on purpose — destroying them runs
+    the fatal shutdown path."""
+    from jax._src import distributed as dist_internal
+    from jax._src import xla_bridge
+    st = dist_internal.global_state
+    _leaked_incarnations.append((st.client, st.service))
+    st.client = None
+    st.service = None
+    st.preemption_sync_manager = None
+    st.coordinator_address = None
+    st.process_id = 0
+    st.num_processes = 1
+    xla_bridge._clear_backends()
+    jax.clear_caches()
+    global _initialized
+    _initialized = False
+
+
+class RendezvousCoordinator:
+    """Generation-stamped membership + barrier re-entry for one elastic
+    job (one instance per process; ``host_id`` is the process's STABLE
+    identity — its launch rank — which survives re-ranking across
+    generations)."""
+
+    def __init__(self, directory: str, host_id: str,
+                 init_timeout: Optional[int] = None):
+        self.directory = directory
+        self.host_id = host_id
+        self.generation = 0
+        self.ranks: dict[str, int] = {}
+        #: the PROCESS-LEVEL heartbeat beacon (started by
+        #: elastic_initialize, reused by the fit coordinator): the host
+        #: must never go silent between joining a generation and the fit
+        #: loop taking over, or peers re-issue a death verdict into the
+        #: gap
+        self.heartbeat = None
+        self.init_timeout = (init_timeout if init_timeout is not None
+                             else int(os.environ.get(
+                                 ENV_INIT_TIMEOUT, DEFAULT_INIT_TIMEOUT)))
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, RENDEZVOUS_DOC)
+
+    def read(self) -> Optional[dict]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            if not isinstance(doc.get("generation"), int):
+                return None
+            return doc
+        except (OSError, ValueError):
+            return None
+
+    def propose(self, hosts, unwind_at: Optional[tuple] = None) -> dict:
+        """Leader-side: mint the next generation over ``hosts`` (ranks
+        assigned in sorted host order, so the lowest surviving host is
+        rank 0 and carries the restarted coordinator service) and commit
+        the doc atomically. ``unwind_at`` tells still-stepping members
+        the (epoch, step) after which they must unwind and join —
+        the deterministic grow/evict boundary."""
+        from ..resilience import faults
+        faults.inject("distributed.rendezvous")
+        hosts = sorted(set(hosts))
+        if self.host_id != hosts[0]:
+            raise RendezvousError(
+                f"{self.host_id} proposed a generation but {hosts[0]} is "
+                f"the surviving leader")
+        cur = self.read()
+        gen = max(self.generation,
+                  cur["generation"] if cur else 0) + 1
+        doc = {"generation": gen,
+               "address": f"{_advertised_address()}:{_free_port()}",
+               "ranks": {h: i for i, h in enumerate(hosts)},
+               "num_processes": len(hosts),
+               "time": time.time()}
+        if unwind_at is not None:
+            doc["unwind_at"] = list(unwind_at)
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        log.warning("rendezvous generation %d proposed: %d host(s) %s at "
+                    "%s", gen, len(hosts), hosts, doc["address"])
+        return doc
+
+    def await_membership(self, min_generation: int,
+                         timeout: Optional[float] = None) -> dict:
+        """Follower-side: poll the doc until a generation >=
+        ``min_generation`` names this host. A doc that omits us (we were
+        evicted, or the leader hasn't seen our joining heartbeat yet)
+        keeps us parked — the stale-generation guard."""
+        from ..resilience import faults
+        faults.inject("distributed.rendezvous")
+        if timeout is None:
+            timeout = float(os.environ.get(ENV_REJOIN_TIMEOUT,
+                                           DEFAULT_REJOIN_TIMEOUT))
+        deadline = time.monotonic() + timeout
+        while True:
+            doc = self.read()
+            if (doc and doc["generation"] >= min_generation
+                    and self.host_id in doc.get("ranks", {})):
+                return doc
+            if time.monotonic() >= deadline:
+                raise RendezvousError(
+                    f"no rendezvous generation >= {min_generation} named "
+                    f"{self.host_id} within {timeout:.0f}s")
+            time.sleep(0.05)
+
+    def join(self, doc: dict) -> None:
+        """Tear down the old incarnation and enter ``doc``'s: restart /
+        connect the coordination service, then barrier re-entry so every
+        member is known present before the fit re-enters. Refuses a doc
+        whose generation is not strictly newer than the one this process
+        last held."""
+        gen = int(doc["generation"])
+        if gen <= self.generation:
+            raise RendezvousError(
+                f"stale generation {gen} (this process already held "
+                f"{self.generation}) — refusing to join an old "
+                f"incarnation")
+        rank = doc["ranks"].get(self.host_id)
+        if rank is None:
+            raise RendezvousError(
+                f"generation {gen} does not include {self.host_id}")
+        with telemetry.trace.span("distributed/rendezvous",
+                                  generation=gen, rank=rank,
+                                  hosts=len(doc["ranks"])):
+            # previous incarnation attached? detach WITHOUT touching
+            # jax.devices()/process_count() — those would instantiate a
+            # backend before the new generation's client exists
+            from jax._src import distributed as dist_internal
+            if dist_internal.global_state.client is not None:
+                teardown_for_rendezvous()
+            _enable_cpu_collectives()
+            _init_elastic_client(doc["address"], int(doc["num_processes"]),
+                                 int(rank), self.init_timeout)
+            # barrier re-entry: every member of the new generation checks
+            # in before anyone dispatches a collective
+            dist_internal.global_state.client.wait_at_barrier(
+                f"mmlspark-rdzv-{gen}", int(self.init_timeout * 1000))
+        self.generation = gen
+        self.ranks = dict(doc["ranks"])
+        _m_generation.set(gen)
+        _m_rendezvous.inc()
+        telemetry.flight.note("distributed/rendezvous", generation=gen,
+                              rank=rank, hosts=len(doc["ranks"]))
+        log.warning("joined rendezvous generation %d as rank %d/%d "
+                    "(%d local / %d global devices)", gen, rank,
+                    int(doc["num_processes"]), jax.local_device_count(),
+                    jax.device_count())
+
+
+def _incarnation_live(directory: str, doc: dict, self_host: str,
+                      window: float = 10.0) -> bool:
+    """Is the doc's incarnation still running? True when any OTHER
+    member's heartbeat file was modified within ``window`` seconds
+    (reader-side FS mtime — no writer wall-clock trust). A ``joining``
+    heartbeat does NOT count: it is a parked waiter, not a running
+    member — two relaunched processes must not each mistake the other
+    for a live fit and park forever."""
+    now = time.time()
+    for host in doc.get("ranks", {}):
+        if host == self_host:
+            continue
+        path = os.path.join(directory, f"hb_{host}.json")
+        try:
+            mtime = os.path.getmtime(path)
+            with open(path, "r", encoding="utf-8") as f:
+                member_doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if now - mtime <= window and not member_doc.get("joining"):
+            return True
+    return False
+
+
+def elastic_initialize(checkpoint_dir: str,
+                       host_id: Optional[str] = None,
+                       rejoin_timeout: Optional[float] = None) -> bool:
+    """Elastic-fleet entry point: join (or REJOIN) the job's current
+    incarnation through the shared-storage rendezvous protocol instead
+    of the fixed-fleet env contract. Every launch and relaunch calls
+    this; the three cases resolve themselves:
+
+    * **fresh job** (no rendezvous doc): the env-contract leader
+      (process 0) proposes generation 1 over the launch fleet; everyone
+      joins it. Falls back to single-process mode (returns False) when
+      the env contract is absent.
+    * **rejoin** (doc present, incarnation live, we're not in it): this
+      is a relaunched/evicted host. Write a ``joining`` heartbeat and
+      park until the running fit's leader admits us into a future
+      generation at a checkpoint boundary, then join it.
+    * **full relaunch** (doc present, incarnation dead): the launcher
+      restarted the whole fleet; process 0 proposes generation N+1 over
+      the launch fleet and consensus-resume carries the run over.
+
+    Returns True when a distributed incarnation was joined."""
+    global _rdzv_coordinator
+    addr = os.environ.get(ENV_COORDINATOR)
+    n_env = int(os.environ.get(ENV_NUM_PROCESSES, "0") or 0)
+    pid_env = int(os.environ.get(ENV_PROCESS_ID, "0") or 0)
+    if host_id is None:
+        host_id = meshlib.stable_host_id()
+    from ..resilience.elastic import heartbeat_dir
+    hb_dir = heartbeat_dir(checkpoint_dir)
+    os.makedirs(hb_dir, exist_ok=True)
+    configure_xla_cache()
+    rdzv = RendezvousCoordinator(hb_dir, host_id)
+    from ..resilience.elastic import (HostHeartbeat, _hb_interval_default,
+                                      _grace_default)
+    hb = HostHeartbeat(host_id, hb_dir,
+                       _hb_interval_default(_grace_default()))
+    doc = rdzv.read()
+    launch_hosts = [f"host{i}" for i in range(n_env)]
+    if doc is None:
+        if not addr or n_env <= 1:
+            return False                    # single-process mode
+        if pid_env == 0:
+            doc = rdzv.propose(launch_hosts)
+        else:
+            doc = rdzv.await_membership(1, timeout=rejoin_timeout)
+        hb.start()
+        rdzv.join(doc)
+    elif _incarnation_live(hb_dir, doc, host_id):
+        # REJOIN a running fit: park behind a joining heartbeat until a
+        # generation names us (the grow path's checkpoint boundary).
+        # Even when the live doc still names this host (killed and
+        # relaunched before the leader noticed), the OLD incarnation's
+        # connections are gone — only a fresh generation is joinable;
+        # the joining flag self-reports the restart so the leader's
+        # death pass drops the old membership promptly.
+        hb.set_joining(True)
+        hb.start()
+        log.warning("rendezvous doc generation %d is live; %s parks "
+                    "with a joining heartbeat until readmitted",
+                    doc["generation"], host_id)
+        target = rdzv.await_membership(doc["generation"] + 1,
+                                       timeout=rejoin_timeout)
+        rdzv.join(target)
+        hb.set_joining(False)
+    else:
+        # dead incarnation: full-fleet relaunch over the env contract
+        if not addr or n_env <= 1:
+            return False
+        if pid_env == 0:
+            doc = rdzv.propose(launch_hosts)
+        else:
+            doc = rdzv.await_membership(doc["generation"] + 1,
+                                        timeout=rejoin_timeout)
+        hb.start()
+        rdzv.join(doc)
+    # the beacon OUTLIVES this call (the fit coordinator reuses it):
+    # between generations and fits the host must keep proving liveness
+    hb.set_generation(rdzv.generation)
+    rdzv.heartbeat = hb
+    _rdzv_coordinator = rdzv
+    return True
 
 
 def global_mesh(axes: Optional[dict[str, int]] = None) -> "jax.sharding.Mesh":

@@ -148,6 +148,21 @@ def make_mesh(axes: dict[str, int],
     return Mesh(dev_array, tuple(axes.keys()))
 
 
+def stable_host_id() -> str:
+    """This process's STABLE elastic host identity: its LAUNCH rank
+    (``MMLTPU_PROCESS_ID``) when the launcher's env contract set one,
+    else the current ``jax.process_index()``. Heartbeat files, death/
+    evict verdicts, and rendezvous ranks all key on this id — and it
+    must survive re-ranking across rendezvous generations (a survivor
+    that becomes rank 0 of a shrunken incarnation keeps the host id it
+    launched with)."""
+    import os
+    v = os.environ.get("MMLTPU_PROCESS_ID", "")
+    if v.isdigit():
+        return f"host{int(v)}"
+    return f"host{jax.process_index()}"
+
+
 def host_device_groups(n_groups: int = 0) -> list[tuple[str, list]]:
     """Partition the visible devices into named "host" groups — the failure
     domains elastic training (resilience/elastic.py) supervises and

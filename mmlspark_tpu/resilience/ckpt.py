@@ -33,6 +33,22 @@ file that is **not in the manifest** — and resume treats "exists but
 unverified" exactly like "corrupt": skip it, warn, count it on
 ``mmlspark_ckpt_corrupt_total``, and fall back to the previous
 checkpoint.  The consensus candidate is always a manifest-verified file.
+
+**Sharded checkpoints** extend the same protocol to models too big for
+one host's msgpack: the training state (flattened to ``path -> leaf``)
+is split into N byte-balanced shards, each committed as its own
+``<stem>.shard_<i>.msgpack`` file (fault site ``ckpt.shard``, same
+tmp-write + fsync + rename discipline, NO per-shard manifest entry), a
+small **head** file under the canonical ``ckpt_E[_sS].msgpack`` name
+records the shard list, and the manifest — still committed LAST, by the
+coordinator, after every shard is verified present with size + sha256 —
+becomes the multi-shard commit record (the head's manifest entry carries
+a ``shards`` map). Resume reads the head, then every shard (content-
+hashed against the manifest), and reassembles the tree; shard count is
+recorded in the manifest, so an N-shard checkpoint restores onto any
+mesh size. **A torn shard disqualifies the whole candidate**: verify()
+fails the head, the resume falls back to the previous committed
+checkpoint, and the skip is counted.
 """
 
 from __future__ import annotations
@@ -68,6 +84,10 @@ _m_wait_seconds = telemetry.registry.histogram(
     "mmlspark_ckpt_wait_seconds",
     "time the fit actually blocked on the async-checkpoint barrier "
     "(epoch end / fit exit); ~0 when the disk keeps up")
+_m_shards_written = telemetry.registry.counter(
+    "mmlspark_ckpt_shards_written_total",
+    "checkpoint shard files committed (tmp-write + fsync + rename; the "
+    "head + manifest commit follows once every shard landed)")
 
 MANIFEST = "manifest.json"
 
@@ -147,9 +167,11 @@ def publish(path: str, data: bytes):
 def verify(directory: str, name: str) -> bool:
     """Is ``name`` a legitimate consensus candidate? True when the
     directory has no manifest (pre-manifest checkpoints), or when the
-    manifest lists the file with a matching on-disk size. A file the
-    manifest doesn't know, or whose size disagrees, is a torn/uncommitted
-    write: count it and skip it."""
+    manifest lists the file with a matching on-disk size — and, for a
+    sharded checkpoint, every shard the head's manifest entry records is
+    present with its committed size. A file the manifest doesn't know,
+    a size that disagrees, or ANY torn/missing shard disqualifies the
+    whole candidate: count it and skip it."""
     files = load_manifest(directory)
     if files is None:
         return True
@@ -170,17 +192,233 @@ def verify(directory: str, name: str) -> bool:
             else f"{size} bytes but the manifest recorded "
                  f"{entry.get('size')}")
         return False
+    for sname, sentry in (entry.get("shards") or {}).items():
+        try:
+            ssize = os.path.getsize(os.path.join(directory, sname))
+        except OSError:
+            ssize = -1
+        if int(sentry.get("size", -1)) != ssize:
+            _m_corrupt.inc()
+            telemetry.trace.instant("ckpt/corrupt", file=sname,
+                                    reason="shard")
+            log.warning(
+                "checkpoint %s shard %s is %s — the torn shard "
+                "disqualifies the whole candidate (falling back to the "
+                "previous checkpoint)", name, sname,
+                "missing" if ssize < 0
+                else f"{ssize} bytes vs {sentry.get('size')} committed")
+            return False
     return True
+
+
+# ---- sharded checkpoints ---------------------------------------------------
+
+def shard_name(name: str, index: int) -> str:
+    """``ckpt_E[_sS].msgpack`` -> ``ckpt_E[_sS].shard_<i>.msgpack``. The
+    shard suffix keeps the stem non-numeric, so shard files are never
+    mistaken for standalone resume candidates by the trainer's
+    checkpoint-name parser."""
+    stem = name[:-len(".msgpack")] if name.endswith(".msgpack") else name
+    return f"{stem}.shard_{index}.msgpack"
+
+
+def write_shard(path: str, data: bytes):
+    """Commit ONE shard file: tmp write + fsync (fault site
+    ``ckpt.shard``) then atomic rename. Deliberately no manifest entry —
+    a shard only becomes part of a durable checkpoint when the
+    coordinator's head + manifest commit (``commit_sharded``) lands
+    after verifying every shard."""
+    name = os.path.basename(path)
+    with telemetry.trace.span("ckpt/write", file=name, bytes=len(data)):
+        faults.inject("ckpt.shard")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    _m_shards_written.inc()
+
+
+def head_payload(shard_names) -> bytes:
+    """The head file's bytes: a tiny JSON document naming the shards.
+    Committed under the canonical checkpoint name so the existing
+    candidate discovery finds sharded checkpoints unchanged."""
+    return json.dumps({"sharded": {"version": 1,
+                                   "shards": list(shard_names)}},
+                      sort_keys=True).encode("utf-8")
+
+
+def parse_head(data: bytes):
+    """The shard list when ``data`` is a sharded-checkpoint head, else
+    None (a regular msgpack checkpoint)."""
+    if not data.startswith(b'{"sharded"'):
+        return None
+    try:
+        return list(json.loads(data.decode("utf-8"))["sharded"]["shards"])
+    except (ValueError, KeyError, TypeError):
+        return None
+
+
+def await_shards(directory: str, names, timeout: float = 60.0) -> bool:
+    """Coordinator-side barrier before the head + manifest commit: every
+    shard file must be present (rename is atomic, so presence implies a
+    complete, fsynced write). Multi-host sharded saves call this on the
+    coordinator while peers publish their own shards to shared storage."""
+    deadline = time.monotonic() + timeout
+    while True:
+        missing = [n for n in names
+                   if not os.path.exists(os.path.join(directory, n))]
+        if not missing:
+            return True
+        if time.monotonic() >= deadline:
+            log.warning("sharded checkpoint commit timed out waiting for "
+                        "shard(s) %s", missing)
+            return False
+        time.sleep(0.02)
+
+
+def commit_sharded(path: str, shard_names) -> None:
+    """The coordinator's LAST step of a sharded save: verify every shard
+    on disk (size + sha256 recorded into the manifest), publish the head
+    under the canonical name, then commit the manifest whose head entry
+    carries the ``shards`` map. Raises OSError when a shard vanished —
+    the save fails loudly rather than committing a torn record."""
+    directory, name = os.path.split(path)
+    shards = {}
+    for sname in shard_names:
+        with open(os.path.join(directory, sname), "rb") as f:
+            blob = f.read()
+        shards[sname] = {"size": len(blob),
+                         "sha256": hashlib.sha256(blob).hexdigest()}
+    data = head_payload(shard_names)
+    with telemetry.trace.span("ckpt/write", file=name, bytes=len(data),
+                              shards=len(shards)):
+        faults.inject("ckpt.write")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.inject("ckpt.rename")
+        os.replace(tmp, path)
+        files = load_manifest(directory) or {}
+        files[name] = {"size": len(data),
+                       "sha256": hashlib.sha256(data).hexdigest(),
+                       "shards": shards}
+        _commit_manifest(directory, files)
+
+
+def publish_sharded(path: str, shard_payloads) -> None:
+    """Single-writer sharded commit (single-process fits, simulated
+    hosts): write every shard, then run the coordinator's head +
+    manifest commit. One host's failure domain, N files — the layout is
+    identical to the multi-host case, so resume code has one path."""
+    t0 = time.perf_counter()
+    names = []
+    for i, data in enumerate(shard_payloads):
+        sname = shard_name(os.path.basename(path), i)
+        write_shard(os.path.join(os.path.dirname(path), sname), data)
+        names.append(sname)
+    commit_sharded(path, names)
+    _m_write_seconds.observe(time.perf_counter() - t0)
+
+
+def read_shards(directory: str, shard_names) -> list:
+    """Read + content-verify every shard of a committed checkpoint.
+    Raises :class:`CorruptCheckpoint` on a digest mismatch — resume
+    falls back to the previous candidate."""
+    blobs = []
+    for sname in shard_names:
+        try:
+            with open(os.path.join(directory, sname), "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            note_corrupt(sname, f"shard unreadable: {e}")
+            raise CorruptCheckpoint(sname) from e
+        if not verify_bytes(directory, sname, blob):
+            raise CorruptCheckpoint(sname)
+        blobs.append(blob)
+    return blobs
+
+
+_EMPTY = "__mmlspark_empty_dict__"
+
+
+def flatten_state(nested, _prefix=()) -> dict:
+    """Flatten a flax state dict into ``{"a/b/c": leaf}`` (empty dicts
+    kept via a sentinel so the round trip is exact) — the unit sharded
+    checkpoints partition."""
+    out = {}
+    if isinstance(nested, dict):
+        if not nested:
+            out["/".join(_prefix)] = _EMPTY
+        for k, v in nested.items():
+            out.update(flatten_state(v, _prefix + (str(k),)))
+        return out
+    out["/".join(_prefix)] = nested
+    return out
+
+
+def unflatten_state(flat: dict):
+    """Inverse of :func:`flatten_state`."""
+    nested: dict = {}
+    for key in sorted(flat):
+        val = flat[key]
+        parts = key.split("/")
+        d = nested
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = {} if (isinstance(val, str) and val == _EMPTY) \
+            else val
+    return nested
+
+
+def partition_leaves(sizes, n_shards: int) -> list:
+    """Contiguous partition of ``len(sizes)`` leaves into ``n_shards``
+    byte-balanced groups (greedy cut at the running-total boundaries).
+    Deterministic given (sizes, n_shards) — every host computes the
+    identical split, so host i can serialize shard i alone."""
+    n_shards = max(1, min(int(n_shards), max(1, len(sizes))))
+    total = float(sum(sizes)) or 1.0
+    bounds = []
+    acc = 0.0
+    cut = 1
+    for i, s in enumerate(sizes):
+        acc += s
+        while cut < n_shards and acc >= total * cut / n_shards:
+            bounds.append(i + 1)
+            cut += 1
+    starts = [0] + bounds
+    ends = bounds + [len(sizes)]
+    return [list(range(a, b)) for a, b in zip(starts, ends)]
+
+
+def _manifest_entry(files: dict, name: str) -> Optional[dict]:
+    """The manifest record for ``name``: a top-level file entry, or a
+    shard entry found under some head's ``shards`` map."""
+    entry = files.get(name)
+    if entry is not None:
+        return entry
+    for head in files.values():
+        sentry = (head.get("shards") or {}).get(name)
+        if sentry is not None:
+            return sentry
+    return None
 
 
 def verify_bytes(directory: str, name: str, data: bytes) -> bool:
     """Content check at restore time: the read bytes must hash to the
     manifest's digest (bit-rot / concurrent-truncation defense beyond the
-    size check)."""
+    size check). Shard files resolve their digest through the head's
+    ``shards`` map."""
     files = load_manifest(directory)
-    if files is None or name not in files:
+    if files is None:
         return True      # unverifiable dirs already passed verify()
-    digest = files[name].get("sha256")
+    entry = _manifest_entry(files, name)
+    if entry is None:
+        return True
+    digest = entry.get("sha256")
     if digest and hashlib.sha256(data).hexdigest() != digest:
         _m_corrupt.inc()
         telemetry.trace.instant("ckpt/corrupt", file=name, reason="sha256")
@@ -192,17 +430,21 @@ def verify_bytes(directory: str, name: str, data: bytes) -> bool:
 
 def prune(directory: str, names) -> None:
     """Remove checkpoint files AND their manifest entries (one manifest
-    commit for the batch). Missing files are fine — another process may
-    have pruned first on shared storage."""
+    commit for the batch). A sharded checkpoint's head takes its shard
+    files with it. Missing files are fine — another process may have
+    pruned first on shared storage."""
     names = [n for n in names]
     if not names:
         return
+    files = load_manifest(directory)
+    for n in list(names):
+        entry = (files or {}).get(n) or {}
+        names.extend((entry.get("shards") or {}).keys())
     for n in names:
         try:
             os.remove(os.path.join(directory, n))
         except OSError:
             pass
-    files = load_manifest(directory)
     if files:
         kept = {k: v for k, v in files.items() if k not in set(names)}
         if len(kept) != len(files):
@@ -242,7 +484,11 @@ class AsyncCheckpointWriter:
         self._thread.start()
 
     def submit(self, path: str, payload_fn: Callable[[], bytes],
-               on_commit: Optional[Callable[[], None]] = None):
+               on_commit: Optional[Callable[[], None]] = None,
+               publish_fn: Optional[Callable] = None):
+        """``publish_fn(path, payload)`` overrides the single-file
+        :func:`publish` commit — sharded saves pass their own commit
+        (per-rank shard write, coordinator head + manifest)."""
         with self._cond:
             if self._error is not None:
                 err, self._error = self._error, None
@@ -250,7 +496,7 @@ class AsyncCheckpointWriter:
             if self._closed:
                 raise RuntimeError("AsyncCheckpointWriter is closed")
             coalesced = self._pending is not None
-            self._pending = (path, payload_fn, on_commit)
+            self._pending = (path, payload_fn, on_commit, publish_fn)
             self._cond.notify_all()
         if coalesced:
             _m_coalesced.inc()
@@ -297,9 +543,9 @@ class AsyncCheckpointWriter:
                 self._in_flight = True
             # serialize + IO happen OUTSIDE the lock: submit() stays a
             # dict swap while a write is in flight
-            path, payload_fn, on_commit = entry
+            path, payload_fn, on_commit, publish_fn = entry
             try:
-                publish(path, payload_fn())
+                (publish_fn or publish)(path, payload_fn())
                 if on_commit is not None:
                     on_commit()
             except BaseException as e:

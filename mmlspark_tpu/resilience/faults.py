@@ -33,7 +33,8 @@ Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``http.request``, ``powerbi.post``, ``dataplane.put``,
 ``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
 ``supervisor.heartbeat``, ``supervisor.rejoin``, ``elastic.step``,
-``elastic.remesh``, ``ckpt.write``, ``ckpt.rename``.
+``elastic.remesh``, ``elastic.evict``, ``distributed.rendezvous``,
+``ckpt.write``, ``ckpt.rename``, ``ckpt.shard``.
 """
 
 from __future__ import annotations
@@ -64,7 +65,9 @@ SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
          "serving.transform", "http.request", "powerbi.post",
          "dataplane.put", "dataplane.allgather", "trainer.step",
          "supervisor.probe", "supervisor.heartbeat", "supervisor.rejoin",
-         "elastic.step", "elastic.remesh", "ckpt.write", "ckpt.rename")
+         "elastic.step", "elastic.remesh", "elastic.evict",
+         "distributed.rendezvous", "ckpt.write", "ckpt.rename",
+         "ckpt.shard")
 
 
 class InjectedFault(ConnectionError):

@@ -459,6 +459,13 @@ class StepTimeAnomalyDetector:
         return {"host_median_s": {h: round(v, 6) for h, v in med.items()},
                 "stragglers": sorted(bad)}
 
+    def forget(self, host: str):
+        """Drop one host's window (an evicted host's samples are stale
+        the moment it leaves the mesh — keeping them would hold its
+        straggler flag forever and block its rejoin)."""
+        with self._lock:
+            self._samples.pop(host, None)
+
     def clear(self):
         with self._lock:
             self._samples.clear()

@@ -97,6 +97,157 @@ def _drain(p, timeout):
         return out, err + "\n<killed: timeout>"
 
 
+_RDZV_WORKER = r'''
+import os
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from mmlspark_tpu import DataFrame
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.models.trainer import TpuLearner, _params_digest
+from mmlspark_tpu.parallel import distributed as dist
+
+ck = os.environ["TEST_CKPT_DIR"]
+# the elastic entry point: fresh launch -> generation 1; a RELAUNCHED
+# process parks behind a joining heartbeat and joins the generation the
+# running fit's leader mints for it — same job, no full-size relaunch
+assert dist.elastic_initialize(ck) is True
+rdzv = dist.rendezvous_coordinator()
+print(f"JOINED_GEN={rdzv.generation}", flush=True)
+pid = int(os.environ["MMLTPU_PROCESS_ID"])
+
+rng = np.random.default_rng(7 + pid)
+n = 64
+x = rng.normal(size=(n, 4)).astype(np.float32)
+y = (x[:, 0] > 0).astype(np.int64)
+df = DataFrame({"features": object_column([r for r in x]), "label": y})
+
+learner = (TpuLearner()
+           .setModelConfig({"type": "mlp", "hidden": [4],
+                            "num_classes": 2})
+           .setEpochs(2).setBatchSize(16).setLearningRate(0.05)
+           .setShuffle(False)
+           .setDeviceDataCap(1)             # the per-step feed path
+           .setCheckpointDir(ck).setCheckpointEverySteps(2)
+           .setCheckpointShards(1)          # one shard PER HOST
+           .setElastic(True).setElasticMinHosts(2)
+           .setElasticGraceSeconds(1.0))
+model = learner.fit(df)
+print(f"FINAL_GEN={rdzv.generation}", flush=True)
+print(f"DIGEST={_params_digest(model.getModelParams())}", flush=True)
+print("ELASTIC_MP_OK", flush=True)
+'''
+
+
+def test_two_process_kill9_rerendezvous_same_fit_bitexact(tmp_path):
+    """THE re-rendezvous acceptance: kill -9 one process mid-fit and
+    relaunch it; it parks behind a joining heartbeat and joins the NEXT
+    rendezvous generation (coordinator-service restart on a fresh port,
+    barrier re-entry) instead of forcing a full-size relaunch-from-
+    scratch. The survivor takes whichever of the two legitimate paths
+    its timing allows: a CLEAN unwind (heartbeat verdict between
+    dispatches) waits below min_hosts and re-rendezvouses IN-JOB, while
+    an attempt PINNED inside the dead collective fails FAST
+    (ElasticFleetLost — XLA's collective timeout is ~30 min) and its
+    relaunch rejoins the same rendezvous lineage at generation+1.
+    min_hosts=2 means no step ever runs on a shrunken fleet, so the
+    final digest is BIT-EXACT against an uninterrupted 2-process run.
+    Checkpoints are sharded one-per-host (each process writes its own
+    shard; rank 0 commits head+manifest last)."""
+    worker = tmp_path / "rdzv_worker.py"
+    worker.write_text(_RDZV_WORKER)
+    ck = tmp_path / "ck"
+
+    env_extra = {"MMLTPU_HOST_ADDRESS": "127.0.0.1",
+                 "MMLTPU_REJOIN_TIMEOUT": "120"}
+
+    def launch(ck_dir, pid, port, faults=""):
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   MMLTPU_COORDINATOR=f"127.0.0.1:{port}",
+                   MMLTPU_NUM_PROCESSES="2",
+                   MMLTPU_PROCESS_ID=str(pid),
+                   MMLTPU_INIT_TIMEOUT="60",
+                   TEST_CKPT_DIR=str(ck_dir), **env_extra)
+        env.pop("JAX_PLATFORMS", None)
+        if faults:
+            env["MMLSPARK_TPU_FAULTS"] = faults
+        else:
+            env.pop("MMLSPARK_TPU_FAULTS", None)
+        return subprocess.Popen(
+            [sys.executable, str(worker)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    # paced fit so the kill lands mid-epoch, after a step checkpoint
+    pace = "trainer.step:delay:1.0:0.1"
+    lead = launch(ck, 0, port, faults=pace)
+    victim = launch(ck, 1, port, faults=pace)
+    deadline = time.monotonic() + 120
+    killed = False
+    while time.monotonic() < deadline:
+        if ck.is_dir() and any("_s" in f and "shard" not in f
+                               for f in os.listdir(ck)
+                               if f.endswith(".msgpack")):
+            os.kill(victim.pid, signal.SIGKILL)
+            killed = True
+            break
+        if victim.poll() is not None or lead.poll() is not None:
+            break
+        time.sleep(0.02)
+    assert killed, "no step checkpoint appeared to time the kill against"
+    _drain(victim, timeout=30)
+
+    # relaunch the victim: it must rejoin via the rendezvous lineage
+    rejoin = launch(ck, 1, port, faults=pace)
+    out_l, err_l = _drain(lead, timeout=180)
+    if lead.returncode != 0:
+        # the survivor was PINNED inside the dead collective: it must
+        # have failed FAST (ElasticFleetLost pointing at relaunch), not
+        # sat out XLA's ~30-minute collective timeout — and its
+        # relaunch re-enters the same rendezvous lineage
+        assert "ElasticFleetLost" in err_l or "rendezvous" in err_l, \
+            (out_l[-1000:], err_l[-1500:])
+        lead = launch(ck, 0, port, faults=pace)
+        out_l, err_l = _drain(lead, timeout=300)
+    out_r, err_r = _drain(rejoin, timeout=300)
+    assert lead.returncode == 0, (out_l[-1500:], err_l[-1500:])
+    assert rejoin.returncode == 0, (out_r[-1500:], err_r[-1500:])
+    assert "ELASTIC_MP_OK" in out_l and "ELASTIC_MP_OK" in out_r
+
+    def field(out, key):
+        return [ln.split("=", 1)[1] for ln in out.splitlines()
+                if ln.startswith(key + "=")]
+
+    # the generation ADVANCED (barrier re-entry into a new incarnation)
+    # and both processes agree on it
+    assert int(field(out_l, "FINAL_GEN")[-1]) >= 2, out_l[-800:]
+    assert field(out_l, "FINAL_GEN")[-1] == field(out_r, "FINAL_GEN")[-1]
+    # the rejoiner joined a LATER generation than launch (it parked, it
+    # did not restart the job from scratch)
+    assert int(field(out_r, "JOINED_GEN")[-1]) >= 2
+    digest = field(out_l, "DIGEST")[0]
+    assert field(out_r, "DIGEST")[0] == digest
+
+    # ---- baseline: uninterrupted 2-process elastic fit, fresh dir ----
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port2 = s.getsockname()[1]
+    procs = [launch(tmp_path / "ck_clean", i, port2) for i in range(2)]
+    base = None
+    for p in procs:
+        out, err = _drain(p, timeout=300)
+        assert p.returncode == 0, (out[-1500:], err[-1500:])
+        base = base or field(out, "DIGEST")[0]
+        assert field(out, "DIGEST")[0] == base
+    # THE acceptance: kill -9 + relaunch + re-rendezvous INTO THE SAME
+    # FIT is bit-exact vs never losing the process at all
+    assert base == digest
+
+
 def test_two_process_preemption_kill9_relaunch_bitexact(tmp_path):
     worker = tmp_path / "elastic_worker.py"
     worker.write_text(_WORKER)

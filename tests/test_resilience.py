@@ -1019,12 +1019,18 @@ def test_heartbeat_write_retry_and_errors_counter(tmp_path, telemetry_on):
 
 def test_supervisor_clears_stale_heartbeats(tmp_path):
     """hb_*.json ghosts from a previous run must not produce instant
-    verdicts on a reused checkpointDir."""
+    verdicts on a reused checkpointDir. Staleness is judged by the
+    file's MTIME (the filesystem's clock), never the dead writer's wall
+    clock — a ghost from a skew-ahead host still clears."""
     from mmlspark_tpu.resilience.elastic import TrainSupervisor
     d = str(tmp_path)
-    with open(os.path.join(d, "hb_host0.json"), "w") as f:
-        json.dump({"host": "host0", "time": time.time() - 3600,
+    ghost = os.path.join(d, "hb_host0.json")
+    with open(ghost, "w") as f:
+        # a skewed writer stamped a FUTURE wall time; only the mtime
+        # tells the truth
+        json.dump({"host": "host0", "time": time.time() + 3600,
                    "epoch": 4, "step": 9}, f)
+    os.utime(ghost, (time.time() - 3600, time.time() - 3600))
     fresh = {"host": "host1", "time": time.time(), "epoch": 0, "step": 0}
     with open(os.path.join(d, "hb_host1.json"), "w") as f:
         json.dump(fresh, f)
@@ -1326,3 +1332,576 @@ def test_elastic_gbdt_stage_routing(tmp_path):
     out = model.transform(df)
     pred = np.asarray(out.col("prediction"))
     assert (pred == y).mean() > 0.8
+
+
+# ------------------------------ seq heartbeats: clock-skew-proof verdicts
+
+class TestSeqHeartbeats:
+    """Death/grow freshness rides reader-observed seq advancement, not
+    the writer's wall clock — one skewed host can neither be falsely
+    killed nor kept as a ghost."""
+
+    def _write(self, d, host, seq, wall_offset=0.0, joining=False):
+        doc = {"host": host, "seq": seq, "time": time.time() + wall_offset,
+               "epoch": 0, "step": seq}
+        if joining:
+            doc["joining"] = True
+        with open(os.path.join(d, f"hb_{host}.json"), "w") as f:
+            json.dump(doc, f)
+
+    def test_skewed_wall_clock_does_not_kill_a_beating_host(self, tmp_path):
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        d = str(tmp_path)
+        sup = TrainSupervisor(["host0"], d, grace=0.5)
+        # the writer's clock is an HOUR behind — wall-based freshness
+        # would declare it dead instantly; seq keeps advancing
+        for seq in range(3):
+            self._write(d, "host0", seq, wall_offset=-3600.0)
+            sup.tick()
+            time.sleep(0.05)
+        assert sup.dead_hosts() == set()
+
+    def test_stalled_seq_dies_despite_fresh_wall_time(self, tmp_path):
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        d = str(tmp_path)
+        sup = TrainSupervisor(["host0"], d, grace=0.15)
+        # the writer's clock runs AHEAD: wall-based freshness would keep
+        # this ghost alive forever; its seq never advances
+        self._write(d, "host0", 7, wall_offset=+3600.0)
+        sup.tick()
+        assert sup.dead_hosts() == set()       # first sighting: fresh
+        time.sleep(0.25)
+        self._write(d, "host0", 7, wall_offset=+3600.0)   # same seq
+        sup.tick()
+        assert sup.dead_hosts() == {"host0"}
+
+    def test_grow_freshness_uses_seq(self, tmp_path):
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        d = str(tmp_path)
+        sup = TrainSupervisor(["host0", "host1"], d, grace=5.0,
+                              rejoin_grace=0.0)
+        sup._dead.add("host1")
+        # joining doc with an ancient wall time but a fresh seq: the
+        # grow verdict must land (first sighting = fresh)
+        self._write(d, "host1", 3, wall_offset=-3600.0, joining=True)
+        sup.tick()
+        assert set(sup.joining_hosts()) == {"host1"}
+
+    def test_heartbeat_docs_carry_seq_and_generation(self, tmp_path):
+        from mmlspark_tpu.resilience.elastic import HostHeartbeat
+        hb = HostHeartbeat("hostX", str(tmp_path), interval=0.02)
+        hb.set_generation(4)
+        hb.start()
+        try:
+            time.sleep(0.1)
+            doc = json.load(open(hb.path))
+            assert doc["seq"] >= 1
+            assert doc["generation"] == 4
+        finally:
+            hb.stop()
+        seq1 = doc["seq"]
+        doc2 = json.load(open(hb.path))
+        assert doc2["seq"] >= seq1            # monotonic
+
+    def test_relaunched_inmesh_host_self_reports_via_joining(self, tmp_path):
+        """A mesh member whose heartbeat starts carrying the joining
+        flag is a fresh process (killed + relaunched inside the grace
+        window): the death pass must drop the OLD membership even though
+        the file is beating."""
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        d = str(tmp_path)
+        sup = TrainSupervisor(["host0"], d, grace=60.0)
+        self._write(d, "host0", 1)
+        sup.tick()
+        assert sup.dead_hosts() == set()
+        self._write(d, "host0", 2, joining=True)   # relaunch self-report
+        sup.tick()
+        assert sup.dead_hosts() == {"host0"}
+
+
+# ----------------------------------------- straggler EVICTION (proactive)
+
+class TestEvictVerdicts:
+    """Sustained straggler flags promote to evict verdicts, subject to
+    the floors: consecutive-pass count, min_hosts, never the
+    coordinator host."""
+
+    def _sup(self, d, hosts=4, evict_after=2, min_hosts=1):
+        from mmlspark_tpu.resilience.elastic import TrainSupervisor
+        ids = [f"host{i}" for i in range(hosts)]
+        sup = TrainSupervisor(ids, d, grace=60.0, min_hosts=min_hosts,
+                              evict_after=evict_after,
+                              probe=lambda h: 0.0)
+        return sup
+
+    def _feed_straggler(self, sup, victim="host2", ratio=5.0):
+        for _ in range(16):
+            for i in range(len(sup.host_ids)):
+                h = f"host{i}"
+                sup.anomaly.observe(h, 0.5 if h == victim else 0.1)
+
+    def test_consecutive_flags_promote_to_evict(self, tmp_path):
+        sup = self._sup(str(tmp_path), evict_after=3)
+        self._feed_straggler(sup)
+        sup.tick()
+        assert sup.straggler_hosts() == {"host2"}
+        assert sup.evict_verdicts() == {}       # 1 < evict_after
+        sup.tick()
+        assert sup.evict_verdicts() == {}       # 2 < evict_after
+        sup.tick()
+        assert set(sup.evict_verdicts()) == {"host2"}
+        assert sup.dead_hosts() == set()        # a verdict is not a drop
+
+    def test_advisory_only_when_evict_after_zero(self, tmp_path):
+        sup = self._sup(str(tmp_path), evict_after=0)
+        self._feed_straggler(sup)
+        for _ in range(5):
+            sup.tick()
+        assert sup.straggler_hosts() == {"host2"}
+        assert sup.evict_verdicts() == {}
+
+    def test_flag_gap_resets_the_streak(self, tmp_path):
+        sup = self._sup(str(tmp_path), evict_after=2)
+        self._feed_straggler(sup)
+        sup.tick()
+        # recovery: refill the victim's window with healthy samples
+        for _ in range(64):
+            sup.anomaly.observe("host2", 0.1)
+        sup.tick()                              # unflagged: streak reset
+        assert sup.straggler_hosts() == set()
+        self._feed_straggler(sup)
+        sup.tick()
+        assert sup.evict_verdicts() == {}       # streak restarted at 1
+
+    def test_coordinator_host_is_never_evicted(self, tmp_path):
+        sup = self._sup(str(tmp_path), evict_after=1)
+        self._feed_straggler(sup, victim="host0")   # lowest alive
+        for _ in range(4):
+            sup.tick()
+        assert sup.straggler_hosts() == {"host0"}   # advisory only
+        assert sup.evict_verdicts() == {}
+
+    def test_min_hosts_floor_blocks_evict(self, tmp_path):
+        sup = self._sup(str(tmp_path), hosts=2, evict_after=1,
+                        min_hosts=2)
+        self._feed_straggler(sup, victim="host1")
+        for _ in range(4):
+            sup.tick()
+        assert sup.evict_verdicts() == {}
+
+    def test_mark_evicted_clears_straggler_state(self, tmp_path,
+                                                 telemetry_on):
+        sup = self._sup(str(tmp_path), evict_after=1)
+        self._feed_straggler(sup)
+        sup.tick()
+        assert set(sup.evict_verdicts()) == {"host2"}
+        sup.mark_evicted("host2")
+        assert sup.dead_hosts() == {"host2"}
+        assert sup.evict_verdicts() == {}
+        assert sup.straggler_hosts() == set()
+        # detector window forgotten: a rejoin starts clean
+        assert "host2" not in sup.anomaly.report()["host_median_s"]
+        snap = telemetry.snapshot()
+        ev = snap["mmlspark_elastic_evictions_total"]["series"]
+        assert [s["labels"]["host"] for s in ev if s["value"] > 0] \
+            == ["host2"]
+
+    def test_pending_evict_arms_only_after_checkpoint_boundary(
+            self, tmp_path):
+        from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+        coord = ElasticFitCoordinator(
+            _elastic_learner(str(tmp_path / "ck")), n_hosts=4,
+            grace=60.0, evict_after=1)
+        coord._mesh_hosts = {"host0", "host1", "host2", "host3"}
+        coord.supervisor._evict["host2"] = time.monotonic()
+        assert coord.pending_evict() == set()      # no boundary yet
+        coord.note_checkpoint(0, 5)
+        assert coord.pending_evict() == {"host2"}
+
+    def test_evict_fault_site(self, tmp_path, telemetry_on):
+        from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+        coord = ElasticFitCoordinator(
+            _elastic_learner(str(tmp_path / "ck")), n_hosts=4,
+            grace=60.0)
+        coord._mesh_hosts = {"host0", "host1", "host2", "host3"}
+        faults.configure("elastic.evict:error:1.0", seed=0)
+        with pytest.raises(ConnectionError):
+            coord._evict({"host2"})
+
+
+@pytest.mark.chaos
+def test_elastic_straggler_evict_and_rejoin(tmp_path, telemetry_on):
+    """THE proactive-eviction guarantee, end to end, with SHARDED
+    checkpoints: a delayed-but-alive host (heartbeat progress throttled
+    5x while a ``delay`` fault at ``elastic.step`` paces the fleet) is
+    flagged by the rolling-MAD detector, promoted to an evict verdict
+    after 2 consecutive passes, and dropped at a committed checkpoint
+    boundary — the 4-shard checkpoint written on the 4-host mesh resumes
+    on the 3-host mesh (write on N, resume on N-1), bit-exact against
+    the committed shards (replays only, no lost steps). Once its cadence
+    recovers the evicted host rejoins through the ordinary grow path and
+    the fit finishes on the full fleet."""
+    from flax import serialization
+    from mmlspark_tpu.models.trainer import TpuLearner, _params_digest
+    from mmlspark_tpu.resilience import ckpt as ckptlib
+    from mmlspark_tpu.resilience.elastic import ElasticFitCoordinator
+
+    ck = str(tmp_path / "ck")
+    rng = np.random.default_rng(1)
+    n = 256
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    df = DataFrame({"features": object_column([r for r in x]),
+                    "label": y})
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [4],
+                                "num_classes": 2})
+               .setEpochs(3).setBatchSize(8).setLearningRate(0.05)
+               .setDeviceDataCap(1)
+               .setCheckpointDir(ck).setCheckpointEverySteps(4)
+               .setCheckpointShards(4))
+    faults.configure("elastic.step:delay:1.0:0.04", seed=11)
+    coord = ElasticFitCoordinator(learner, n_hosts=4, grace=0.4,
+                                  heartbeat_interval=0.05,
+                                  rejoin_grace=0.1, evict_after=2)
+    coord.heartbeats["host3"].throttle(5)
+
+    ckpt_snaps = {}
+    done = threading.Event()
+
+    def chaos_script():
+        # snapshot every committed shard set (pruning races the
+        # assertions below), and relaunch the victim HEALTHY once the
+        # evict re-mesh is underway
+        relaunched = False
+        while not done.is_set():
+            for f in (os.listdir(ck) if os.path.isdir(ck) else []):
+                if f.endswith(".msgpack") and f not in ckpt_snaps:
+                    try:
+                        ckpt_snaps[f] = open(os.path.join(ck, f),
+                                             "rb").read()
+                    except OSError:
+                        continue
+            if not relaunched and "host3" in coord.supervisor.dead_hosts():
+                coord.relaunch_host("host3")   # cadence recovered
+                relaunched = True
+            time.sleep(0.005)
+
+    t = threading.Thread(target=chaos_script, daemon=True)
+    t.start()
+    try:
+        model = coord.fit(df)
+    finally:
+        done.set()
+        t.join(timeout=5)
+    assert np.isfinite(model._final_loss)
+
+    # the straggler was EVICTED (proactively — it never died) and then
+    # readmitted through the grow path
+    snap = telemetry.snapshot()
+    ev = snap["mmlspark_elastic_evictions_total"]["series"]
+    assert [s["labels"]["host"] for s in ev if s["value"] > 0] \
+        == ["host3"]
+    assert snap["mmlspark_elastic_grows_total"]["series"][0]["value"] >= 1
+    assert coord.supervisor.dead_hosts() == set()
+    assert coord.attempts[-1]["hosts"] == ["host0", "host1", "host2",
+                                           "host3"]
+    evict_rec = next(a for a in coord.attempts if "evict_recovery_s" in a)
+    assert evict_rec["evict_recovery_s"] > 0
+
+    # replays-only: every step of every epoch committed at least once
+    assert {(e, s) for (e, s) in coord.committed} \
+        >= {(e, s) for e in range(3) for s in range(32)}
+
+    # bit-exact sharded resume: the post-evict attempt restored params
+    # whose digest equals the digest of the committed shard set it
+    # resumed from (4 shards written on the 4-host mesh, reassembled on
+    # the 3-host mesh)
+    final = evict_rec
+    assert final["resume_pos"] is not None
+    epoch, step = final["resume_pos"]
+    name = (f"ckpt_{epoch:05d}.msgpack" if step is None
+            else f"ckpt_{epoch:05d}_s{step:07d}.msgpack")
+    assert ckptlib.parse_head(ckpt_snaps[name]) is not None
+    flat = {}
+    for sname in ckptlib.parse_head(ckpt_snaps[name]):
+        flat.update(serialization.msgpack_restore(ckpt_snaps[sname]))
+    state = ckptlib.unflatten_state(flat)
+    assert _params_digest(state["params"]) == final["resume_digest"]
+
+
+# ------------------------------------------------ sharded checkpoint unit
+
+class TestShardedCheckpoints:
+    def _state(self):
+        rng = np.random.default_rng(0)
+        return {"params": {"dense": {"kernel": rng.normal(
+                    size=(16, 8)).astype(np.float32),
+                    "bias": rng.normal(size=(8,)).astype(np.float32)}},
+                "opt": {"0": {"mu": rng.normal(size=(16, 8)).astype(
+                    np.float32)}, "1": {}}}
+
+    def test_flatten_round_trip_keeps_empty_dicts(self):
+        from mmlspark_tpu.resilience import ckpt
+        flat = ckpt.flatten_state(self._state())
+        back = ckpt.unflatten_state(flat)
+        assert back["opt"]["1"] == {}
+        np.testing.assert_array_equal(
+            back["params"]["dense"]["kernel"],
+            self._state()["params"]["dense"]["kernel"])
+
+    def test_partition_is_deterministic_and_covers(self):
+        from mmlspark_tpu.resilience import ckpt
+        sizes = [100, 1, 1, 100, 50, 50, 1]
+        parts = ckpt.partition_leaves(sizes, 3)
+        assert parts == ckpt.partition_leaves(sizes, 3)
+        assert sorted(i for p in parts for i in p) == list(range(7))
+        assert len(parts) == 3
+
+    def test_publish_sharded_commit_and_verify(self, tmp_path):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        path = os.path.join(d, "ckpt_00001_s0000003.msgpack")
+        ckpt.publish_sharded(path, [b"shard-a" * 10, b"shard-b" * 20])
+        # head under the canonical name + 2 shard files + manifest
+        assert ckpt.parse_head(open(path, "rb").read()) == \
+            ["ckpt_00001_s0000003.shard_0.msgpack",
+             "ckpt_00001_s0000003.shard_1.msgpack"]
+        assert ckpt.verify(d, "ckpt_00001_s0000003.msgpack")
+        files = ckpt.load_manifest(d)
+        entry = files["ckpt_00001_s0000003.msgpack"]
+        assert len(entry["shards"]) == 2
+        blobs = ckpt.read_shards(
+            d, ckpt.parse_head(open(path, "rb").read()))
+        assert blobs == [b"shard-a" * 10, b"shard-b" * 20]
+
+    def test_torn_shard_disqualifies_whole_candidate(self, tmp_path,
+                                                     telemetry_on):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        ckpt.publish_sharded(os.path.join(d, "ckpt_00001.msgpack"),
+                             [b"old-a", b"old-b"])
+        ckpt.publish_sharded(os.path.join(d, "ckpt_00002.msgpack"),
+                             [b"new-a", b"new-b"])
+        # tear the newest candidate's second shard (truncation)
+        with open(os.path.join(d, "ckpt_00002.shard_1.msgpack"),
+                  "wb") as f:
+            f.write(b"n")
+        assert not ckpt.verify(d, "ckpt_00002.msgpack")
+        assert ckpt.verify(d, "ckpt_00001.msgpack")   # fallback intact
+        snap = telemetry.snapshot()
+        assert snap["mmlspark_ckpt_corrupt_total"]["series"][0]["value"] \
+            >= 1
+        assert snap["mmlspark_ckpt_shards_written_total"]["series"][0][
+            "value"] == 4
+
+    def test_missing_shard_disqualifies(self, tmp_path, telemetry_on):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        ckpt.publish_sharded(os.path.join(d, "ckpt_00001.msgpack"),
+                             [b"a", b"b", b"c"])
+        os.remove(os.path.join(d, "ckpt_00001.shard_2.msgpack"))
+        assert not ckpt.verify(d, "ckpt_00001.msgpack")
+
+    def test_shard_content_hash_checked_at_read(self, tmp_path):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        path = os.path.join(d, "ckpt_00001.msgpack")
+        ckpt.publish_sharded(path, [b"aaaa", b"bbbb"])
+        # same-size corruption: size verify passes, sha256 must not
+        with open(os.path.join(d, "ckpt_00001.shard_0.msgpack"),
+                  "wb") as f:
+            f.write(b"zzzz")
+        assert ckpt.verify(d, "ckpt_00001.msgpack")   # sizes still match
+        with pytest.raises(ckpt.CorruptCheckpoint):
+            ckpt.read_shards(d, ["ckpt_00001.shard_0.msgpack",
+                                 "ckpt_00001.shard_1.msgpack"])
+
+    def test_prune_takes_shards_with_the_head(self, tmp_path):
+        from mmlspark_tpu.resilience import ckpt
+        d = str(tmp_path)
+        ckpt.publish_sharded(os.path.join(d, "ckpt_00001.msgpack"),
+                             [b"a", b"b"])
+        ckpt.prune(d, ["ckpt_00001.msgpack"])
+        assert [f for f in os.listdir(d) if f.endswith(".msgpack")] == []
+
+    def test_shard_fault_site(self, tmp_path):
+        from mmlspark_tpu.resilience import ckpt
+        faults.configure("ckpt.shard:error:1.0", seed=0)
+        with pytest.raises(ConnectionError):
+            ckpt.write_shard(str(tmp_path / "ckpt_00001.shard_0.msgpack"),
+                             b"x")
+
+    def test_trainer_sharded_kill_and_resume(self, tmp_path):
+        """A plain (non-elastic) fit with checkpointShards: the 3-shard
+        checkpoint restores bit-exact into a resumed fit."""
+        from mmlspark_tpu.models.trainer import TpuLearner, _params_digest
+
+        def learner():
+            return (TpuLearner()
+                    .setModelConfig({"type": "mlp", "hidden": [4],
+                                     "num_classes": 2})
+                    .setEpochs(2).setBatchSize(8).setLearningRate(0.05)
+                    .setShuffle(False).setDeviceDataCap(1)
+                    .setCheckpointDir(str(tmp_path / "ck"))
+                    .setCheckpointShards(3))
+        df = _toy_df(64)
+        baseline = learner().setCheckpointDir(
+            str(tmp_path / "ck_base")).fit(df)
+        # interrupted run: epoch 0 only, then a fresh learner resumes
+        first = learner().setEpochs(1).fit(df)
+        assert os.path.exists(
+            str(tmp_path / "ck" / "ckpt_00000.shard_0.msgpack"))
+        resumed = learner().fit(df)
+        assert _params_digest(resumed.getModelParams()) == \
+            _params_digest(baseline.getModelParams())
+
+
+# --------------------------------------------- fleet health on /healthz
+
+def test_fleet_health_surfaces_on_healthz(tmp_path):
+    """An operator watching /healthz sees the elastic fleet: hosts
+    alive, stragglers, pending verdicts, rendezvous generation."""
+    from mmlspark_tpu.io.http.server import HTTPSource
+    from mmlspark_tpu.resilience.elastic import (ElasticFitCoordinator,
+                                                 _register_fleet,
+                                                 _unregister_fleet,
+                                                 fleet_health)
+    assert fleet_health() is None
+    coord = ElasticFitCoordinator(_elastic_learner(str(tmp_path / "ck")),
+                                  n_hosts=4, grace=60.0, evict_after=2)
+    coord._mesh_hosts = {"host0", "host1", "host2", "host3"}
+    coord.supervisor._dead.add("host3")
+    coord.supervisor._flagged.add("host2")
+    coord.supervisor._evict["host2"] = 0.0
+    coord.supervisor._joining["host3"] = 0.0
+    _register_fleet(coord)
+    try:
+        h = fleet_health()
+        assert h["hosts_alive"] == 3
+        assert h["dead"] == ["host3"]
+        assert h["stragglers"] == ["host2"]
+        assert h["pending_evict"] == ["host2"]
+        assert h["pending_grow"] == ["host3"]
+        assert h["rendezvous_generation"] == 0
+        src = HTTPSource(name="t", host="127.0.0.1", port=0)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                src.url + "healthz", timeout=5).read())
+            assert body["elastic"]["hosts_alive"] == 3
+            assert body["elastic"]["pending_evict"] == ["host2"]
+        finally:
+            src.close()
+    finally:
+        _unregister_fleet(coord)
+    assert fleet_health() is None
+
+
+# ------------------------------------- rendezvous protocol (generation)
+
+class TestRendezvousProtocol:
+    """Doc election, generation monotonicity, stale-generation refusal,
+    and the deterministic unwind point — all unit-level (the real
+    2-process teardown/re-init lives in test_elastic_multiproc.py's
+    slow tier)."""
+
+    def _rdzv(self, d, host="host0"):
+        from mmlspark_tpu.parallel.distributed import RendezvousCoordinator
+        return RendezvousCoordinator(str(d), host)
+
+    def test_propose_and_read(self, tmp_path):
+        r = self._rdzv(tmp_path)
+        doc = r.propose(["host0", "host1"])
+        assert doc["generation"] == 1
+        assert doc["ranks"] == {"host0": 0, "host1": 1}
+        assert r.read()["generation"] == 1
+        doc2 = r.propose(["host0"])
+        assert doc2["generation"] == 2        # monotonic past the doc
+
+    def test_only_the_leader_may_propose(self, tmp_path):
+        from mmlspark_tpu.parallel.distributed import RendezvousError
+        r = self._rdzv(tmp_path, host="host1")
+        with pytest.raises(RendezvousError, match="leader"):
+            r.propose(["host0", "host1"])
+
+    def test_await_membership_parks_until_named(self, tmp_path):
+        from mmlspark_tpu.parallel.distributed import RendezvousError
+        r = self._rdzv(tmp_path, host="host2")
+        leader = self._rdzv(tmp_path, host="host0")
+        leader.propose(["host0", "host1"])    # gen 1: host2 NOT named
+        with pytest.raises(RendezvousError, match="named"):
+            r.await_membership(1, timeout=0.3)
+        leader.propose(["host0", "host1", "host2"])
+        doc = r.await_membership(2, timeout=1.0)
+        assert doc["ranks"]["host2"] == 2
+
+    def test_stale_generation_can_never_be_joined(self, tmp_path):
+        from mmlspark_tpu.parallel.distributed import RendezvousError
+        r = self._rdzv(tmp_path)
+        doc = r.propose(["host0", "host1"])
+        r.generation = 5                      # we already held gen 5
+        with pytest.raises(RendezvousError, match="[Ss]tale"):
+            r.join(doc)                       # gen 1 < 5: refused
+
+    def test_join_refuses_a_doc_that_omits_us(self, tmp_path):
+        from mmlspark_tpu.parallel.distributed import RendezvousError
+        r = self._rdzv(tmp_path, host="host9")
+        leader = self._rdzv(tmp_path, host="host0")
+        doc = leader.propose(["host0", "host1"])
+        with pytest.raises(RendezvousError, match="include"):
+            r.join(doc)
+
+    def test_rendezvous_fault_site(self, tmp_path):
+        faults.configure("distributed.rendezvous:error:1.0", seed=0)
+        r = self._rdzv(tmp_path)
+        with pytest.raises(ConnectionError):
+            r.propose(["host0"])
+
+    def test_deterministic_unwind_at_boundary(self, tmp_path):
+        """check_rendezvous raises RendezvousPending exactly when the
+        committed step reaches the doc's unwind_at — the same step on
+        every process."""
+        from mmlspark_tpu.resilience.elastic import (ElasticFitCoordinator,
+                                                     RendezvousPending)
+        coord = ElasticFitCoordinator(
+            _elastic_learner(str(tmp_path / "ck")), n_hosts=2,
+            grace=60.0)
+        rdzv = self._rdzv(tmp_path / "ck" / "heartbeats", host="host1")
+        leader = self._rdzv(tmp_path / "ck" / "heartbeats", host="host0")
+        os.makedirs(str(tmp_path / "ck" / "heartbeats"), exist_ok=True)
+        coord._rdzv = rdzv
+        coord._multiproc = True
+        coord._mesh_hosts = {"host0", "host1"}
+        coord.check_rendezvous(0, 3)          # no doc: no-op
+        leader.propose(["host0", "host1"], unwind_at=(0, 6))
+        coord.check_rendezvous(0, 4)          # before the boundary
+        coord.check_rendezvous(0, 5)
+        time.sleep(0.06)                      # past the stat throttle
+        with pytest.raises(RendezvousPending):
+            coord.check_rendezvous(0, 6)
+
+    @pytest.mark.chaos
+    def test_rendezvous_failure_falls_back_to_full_relaunch(
+            self, tmp_path, telemetry_on):
+        """Injected faults at distributed.rendezvous: the cycle retries
+        with backoff and then falls back to relaunch-at-full-size
+        (ElasticFleetLost) instead of hanging the fleet."""
+        from mmlspark_tpu.resilience.elastic import (ElasticFitCoordinator,
+                                                     ElasticFleetLost)
+        coord = ElasticFitCoordinator(
+            _elastic_learner(str(tmp_path / "ck")), n_hosts=2,
+            grace=60.0, max_failures=2)
+        rdzv = self._rdzv(tmp_path / "ck" / "heartbeats", host="host0")
+        os.makedirs(str(tmp_path / "ck" / "heartbeats"), exist_ok=True)
+        coord._rdzv = rdzv
+        coord._multiproc = True
+        coord._mesh_hosts = {"host0", "host1"}
+        hb = coord.heartbeats["host0"]
+        faults.configure("distributed.rendezvous:error:1.0", seed=0)
+        t0 = time.monotonic()
+        with pytest.raises(ElasticFleetLost, match="relaunch"):
+            coord._rendezvous_cycle(hb)
+        # retried with backoff (2 attempts -> at least one 0.2s sleep)
+        assert time.monotonic() - t0 >= 0.2
+        assert faults.snapshot()["distributed.rendezvous"][0][
+            "injected"] >= 2
