@@ -1,24 +1,38 @@
 """graftlint: framework-aware static analysis for mmlspark_tpu.
 
-Three rule families encode the invariants the test suite cannot see
-(they only bite at TPU scale or under production concurrency):
+Five rule families encode the invariants the test suite cannot see
+(they only bite at TPU scale, under production concurrency, or when the
+power goes out mid-commit):
 
 * **jit-safety** — host syncs / Python control flow on traced values,
   set-order iteration and jit-in-loop recompile hazards, missing
   ``donate_argnums`` on documented-donated buffers, unseeded RNGs in
   library code;
+* **donation** — an interprocedural taint walk from host-buffer origins
+  (``np.*``, arrow/zero-copy decoders, checkpoint restores) to donated
+  argument positions of jitted dispatches (the PR 7 arrow-fitstream /
+  PR 9 post-resume corruption class), plus use-after-donate; the
+  runtime twin is :mod:`mmlspark_tpu.analysis.sanitize`
+  (``MMLSPARK_TPU_SANITIZE=donation``);
+* **protocol** — collectives whose axis is absent from the enclosing
+  shard_map spec, collectives under per-rank-divergent conditions,
+  blocking calls on attempt/watcher threads, and commit-ordering
+  violations (rename before fsync, manifest before payload);
 * **concurrency** — a lock-order graph over every ``with <lock>:`` scope
   (cycles, same-lock reacquire), blocking calls made while holding a
   lock, and ``# guarded-by:`` field annotations checked at every
   mutation site;
 * **consistency** — metric/span names vs the ``docs/observability.md``
-  catalogues, ``faults.inject`` sites vs the ``SITES`` registry, and
-  committed codegen artifacts (stubs / R wrappers / API docs) vs
-  regeneration.
+  catalogues, ``faults.inject`` sites vs the ``SITES`` registry,
+  chaos coverage (every site exercised by a test, every retry policy
+  injectable, no IO path without a site), and committed codegen
+  artifacts (stubs / R wrappers / API docs) vs regeneration.
 
 Run it as ``python -m mmlspark_tpu.analysis`` (console script:
 ``graftlint``); CI runs it via ``tests/test_analysis.py`` and fails on
 any finding not grandfathered in ``tools/graftlint_baseline.json``.
+``--sarif OUT`` emits SARIF 2.1.0 for code-scanning UIs;
+``--changed-only`` reuses content-hash-keyed cached results.
 Suppress a single site with ``# graftlint: disable=<rule>``. See
 ``docs/static-analysis.md``.
 """

@@ -3,6 +3,9 @@
 Exit codes: 0 — clean (every finding baselined or none), 1 — new
 findings, 2 — usage error. ``--format json`` emits a machine-readable
 document (what CI annotations and the flight recorder embed);
+``--sarif OUT`` additionally writes a SARIF 2.1.0 log (what CI code-
+scanning UIs ingest); ``--changed-only`` reuses cached results for
+files whose content hash is unchanged (``.graftlint/cache.json``);
 ``--write-baseline`` grandfathers the current findings.
 """
 
@@ -50,6 +53,17 @@ def main(argv=None) -> int:
                     help="skip the import-based codegen-sync check")
     ap.add_argument("--root", default=None,
                     help="repo root for relative paths and docs lookup")
+    ap.add_argument("--sarif", default=None, metavar="OUT",
+                    help="also write a SARIF 2.1.0 log to OUT (CI "
+                         "code-scanning ingestion)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="incremental mode: reuse cached findings for "
+                         "files whose content hash is unchanged "
+                         "(implies --no-codegen; cache under "
+                         ".graftlint/)")
+    ap.add_argument("--cache", default=None,
+                    help="cache file for --changed-only (default: "
+                         "<root>/.graftlint/cache.json)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -68,11 +82,20 @@ def main(argv=None) -> int:
         baseline = None
     rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
              if args.rules else None)
-    options = {"codegen": not args.no_codegen and not args.paths}
+    options = {"codegen": (not args.no_codegen and not args.paths
+                           and not args.changed_only)}
 
-    findings = run_analysis(paths, root=root, baseline=baseline,
-                            rules=rules, options=options)
+    stats = None
+    if args.changed_only:
+        from .incremental import run_changed_only
+        findings, stats = run_changed_only(
+            paths, root=root, baseline=baseline, rules=rules,
+            options=options, cache_path=args.cache)
+    else:
+        findings = run_analysis(paths, root=root, baseline=baseline,
+                                rules=rules, options=options)
     new = [f for f in findings if not f.baselined]
+    _observe_findings(findings)
 
     if args.write_baseline:
         path = args.baseline or default_baseline
@@ -80,21 +103,54 @@ def main(argv=None) -> int:
         print(f"graftlint: wrote {len(findings)} finding(s) to {path}")
         return 0
 
+    if args.sarif:
+        from .sarif import to_sarif
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            json.dump(to_sarif(findings), f, indent=2)
+
     if args.format == "json":
-        print(json.dumps({
+        doc = {
             "findings": [f.to_json() for f in findings],
             "total": len(findings),
             "new": len(new),
             "baselined": len(findings) - len(new),
-        }, indent=2))
+        }
+        if stats is not None:
+            doc["incremental"] = stats
+        print(json.dumps(doc, indent=2))
     else:
         for f in findings:
             print(f.render())
         n_base = len(findings) - len(new)
+        inc = (f" [incremental: {stats['analyzed_files']} analyzed, "
+               f"{stats['reused_files']} cached]" if stats else "")
         print(f"graftlint: {len(new)} finding(s)"
               + (f" ({n_base} baselined)" if n_base else "")
+              + inc
               + (" — FAIL" if new else " — ok"))
     return 1 if new else 0
+
+
+def _observe_findings(findings) -> None:
+    """Per-family finding counts onto the telemetry registry, so CI
+    wrappers that scrape /metrics (or embed a snapshot in the flight
+    bundle) can chart graftlint findings by family over time."""
+    try:
+        from .. import telemetry
+        from .core import all_rules as _rules
+        fam = {r.name: r.family for r in _rules()}
+        counts: dict = {}
+        for f in findings:
+            counts[fam.get(f.rule, "unknown")] = \
+                counts.get(fam.get(f.rule, "unknown"), 0) + 1
+        g = telemetry.registry.gauge(
+            "mmlspark_graftlint_findings",
+            "graftlint findings by rule family at the last analyzer "
+            "run (baselined findings included)", labels=("family",))
+        for family, n in counts.items():
+            g.labels(family=family).set(n)
+    except Exception:    # telemetry must never fail the analyzer
+        pass
 
 
 if __name__ == "__main__":

@@ -18,6 +18,24 @@
   from the live Param registry (Python signatures are the single source
   of truth). Import-based; disable with ``options={"codegen": False}``
   (fixture projects) or ``--no-codegen``.
+
+Chaos-coverage rules (a fault-injection framework only pays for itself
+when every recovery path it guards is actually rehearsed):
+
+* ``chaos-test-coverage`` — every site registered in ``faults.SITES``
+  must appear in at least one file under ``tests/`` (a grep-backed
+  index): a site no test ever arms is a recovery path that has never
+  run.
+* ``chaos-retry-path`` — every ``RetryPolicy(...)`` / breaker
+  construction in library code must live in a module with a
+  ``faults.inject`` site on its IO path: a retry loop whose failure
+  mode can't be injected is untestable by construction.
+* ``chaos-io-site`` — IO call sites without a reachable fault site:
+  outbound network calls (urlopen / requests / socket connects) whose
+  enclosing class (or module, for top-level functions) never calls
+  ``faults.inject``; HTTP handler classes (``do_GET``/``do_POST``)
+  with no injection point; artifact writes under ``codegen/`` without
+  a site. New IO paths must register a site as they land.
 """
 
 from __future__ import annotations
@@ -146,7 +164,8 @@ def _recorded_spans(project: Project):
 
 
 @rule("metric-catalogue", "consistency",
-      "registered metric names vs the docs/observability.md catalogue")
+      "registered metric names vs the docs/observability.md catalogue",
+      scope="project")
 def check_metric_catalogue(project: Project) -> Iterable[Finding]:
     regs = list(_registered_metrics(project))
     if not regs:
@@ -190,7 +209,8 @@ def check_metric_catalogue(project: Project) -> Iterable[Finding]:
 
 
 @rule("span-catalogue", "consistency",
-      "recorded span/instant names vs the docs span catalogue")
+      "recorded span/instant names vs the docs span catalogue",
+      scope="project")
 def check_span_catalogue(project: Project) -> Iterable[Finding]:
     spans = list(_recorded_spans(project))
     if not spans:
@@ -236,7 +256,8 @@ def check_span_catalogue(project: Project) -> Iterable[Finding]:
 
 
 @rule("fault-site", "consistency",
-      "faults.inject sites vs the SITES registry in resilience/faults.py")
+      "faults.inject sites vs the SITES registry in resilience/faults.py",
+      scope="project")
 def check_fault_sites(project: Project) -> Iterable[Finding]:
     # registered sites: the SITES tuple in a module named faults.py
     registered: set[str] = set()
@@ -302,7 +323,8 @@ def check_fault_sites(project: Project) -> Iterable[Finding]:
 
 
 @rule("codegen-sync", "consistency",
-      "committed stubs/R/docs-api artifacts vs regeneration")
+      "committed stubs/R/docs-api artifacts vs regeneration",
+      scope="project")
 def check_codegen(project: Project) -> Iterable[Finding]:
     if not project.options.get("codegen", False):
         return
@@ -376,3 +398,222 @@ def check_codegen(project: Project) -> Iterable[Finding]:
                             f"{'...' if len(stale) > 5 else ''})",
                     hint="run `python -m mmlspark_tpu.codegen` and commit "
                          "the result")
+
+
+# ---------------------------------------------------------- chaos coverage
+
+def _tests_dir(project: Project) -> Optional[str]:
+    p = project.options.get("tests_dir")
+    if p:
+        return p if os.path.isdir(p) else None
+    p = os.path.join(_repo_root(project), "tests")
+    return p if os.path.isdir(p) else None
+
+
+def _tests_index(tests_dir: str) -> str:
+    """The concatenated text of every test file — the grep-backed index
+    the coverage rule matches site names against."""
+    chunks = []
+    for base, dirs, names in os.walk(tests_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for n in sorted(names):
+            if n.endswith(".py"):
+                try:
+                    with open(os.path.join(base, n), encoding="utf-8") as f:
+                        chunks.append(f.read())
+                except OSError:
+                    continue
+    return "\n".join(chunks)
+
+
+def _sites_registry(project: Project):
+    """(SourceFile, SITES assign node, {site names}) from faults.py."""
+    for sf in project.files:
+        if not sf.rel.endswith("faults.py"):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "SITES"
+                    for t in node.targets):
+                sites = {sub.value for sub in ast.walk(node.value)
+                         if isinstance(sub, ast.Constant)
+                         and isinstance(sub.value, str)}
+                return sf, node, sites
+    return None, None, set()
+
+
+@rule("chaos-test-coverage", "consistency",
+      "every faults.SITES entry must be exercised by at least one test",
+      scope="project")
+def check_chaos_test_coverage(project: Project) -> Iterable[Finding]:
+    sf, node, sites = _sites_registry(project)
+    if sf is None or not sites:
+        return
+    tests = _tests_dir(project)
+    if tests is None:
+        return          # fixture projects without a tests tree
+    index = _tests_index(tests)
+    for site in sorted(sites):
+        if site in index:
+            continue
+        f = sf.finding(
+            "chaos-test-coverage", node,
+            f"fault site `{site}` is registered but no file under "
+            f"tests/ ever names it — the recovery path it guards has "
+            f"never been rehearsed",
+            hint="add a chaos test that arms the site "
+                 "(faults.configure(f'{site}:error:1.0')) and asserts "
+                 "the recovery behavior",
+            context="SITES")
+        if f:
+            yield f
+
+
+_POLICY_CTORS = {"RetryPolicy", "CircuitBreaker"}
+
+
+def _module_has_inject(sf: SourceFile) -> bool:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            dn = dotted(node.func)
+            if dn is not None and dn.rsplit(".", 1)[-1] == "inject":
+                return True
+    return False
+
+
+def _is_test_rel(rel: str) -> bool:
+    parts = rel.split("/")
+    return (any(p in ("tests", "testing", "fixtures") for p in parts)
+            or parts[-1].startswith("test_"))
+
+
+@rule("chaos-retry-path", "consistency",
+      "RetryPolicy/breaker constructions in modules with no fault site "
+      "on their IO path")
+def check_chaos_retry_path(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_rel(sf.rel) or sf.rel.endswith("resilience/policy.py"):
+            continue    # the defining module ships no IO of its own
+        has_inject = _module_has_inject(sf)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted(node.func)
+            if dn is None or dn.rsplit(".", 1)[-1] not in _POLICY_CTORS:
+                continue
+            if has_inject:
+                continue
+            f = sf.finding(
+                "chaos-retry-path", node,
+                f"`{dn.rsplit('.', 1)[-1]}` constructed in a module with "
+                f"no faults.inject site — the failure mode this policy "
+                f"guards cannot be injected, so its recovery path is "
+                f"untestable",
+                hint="add a faults.inject(\"<site>\") on the IO path the "
+                     "policy retries (and register the site in "
+                     "resilience/faults.py SITES)",
+                context=sf.rel)
+            if f:
+                yield f
+
+
+_NET_CALLS = {"urllib.request.urlopen", "urlopen", "requests.get",
+              "requests.post", "requests.put", "requests.delete",
+              "requests.head", "requests.request",
+              "socket.create_connection"}
+_HANDLER_METHODS = {"do_GET", "do_POST"}
+
+
+def _enclosing_scopes(sf: SourceFile):
+    """Yield (node, enclosing ClassDef or None) for every Call/def."""
+    out = []
+
+    def walk(node, cls):
+        for child in ast.iter_child_nodes(node):
+            ncls = child if isinstance(child, ast.ClassDef) else cls
+            out.append((child, cls))
+            walk(child, ncls)
+
+    walk(sf.tree, None)
+    return out
+
+
+def _scope_has_inject(scope_node) -> bool:
+    for node in ast.walk(scope_node):
+        if isinstance(node, ast.Call):
+            dn = dotted(node.func)
+            if dn is not None and dn.rsplit(".", 1)[-1] == "inject":
+                return True
+    return False
+
+
+@rule("chaos-io-site", "consistency",
+      "IO call sites (network / HTTP handlers / codegen writes) with no "
+      "fault-injection site in scope")
+def check_chaos_io_site(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_rel(sf.rel) or "/analysis/" in "/" + sf.rel:
+            continue
+        module_inject = _module_has_inject(sf)
+        for node, cls in _enclosing_scopes(sf):
+            # 1) HTTP handler methods: the handler class must carry a site
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in _HANDLER_METHODS and cls is not None:
+                if not _scope_has_inject(cls):
+                    f = sf.finding(
+                        "chaos-io-site", node,
+                        f"HTTP handler `{cls.name}.{node.name}` serves "
+                        f"responses with no faults.inject site in its "
+                        f"class — the handler's failure behavior can't "
+                        f"be chaos-tested",
+                        hint="inject a registered site at the top of the "
+                             "handler (e.g. `http.debug`) and answer "
+                             "injected faults with a 5xx",
+                        context=f"{cls.name}.{node.name}")
+                    if f:
+                        yield f
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted(node.func)
+            term = dn.rsplit(".", 1)[-1] if dn else ""
+            # 2) outbound network calls: class (or module) must inject
+            if (dn in _NET_CALLS or term == "urlopen"):
+                covered = (module_inject if cls is None
+                           else _scope_has_inject(cls))
+                if not covered:
+                    f = sf.finding(
+                        "chaos-io-site", node,
+                        f"outbound network call `{dn}` with no "
+                        f"faults.inject site in its enclosing "
+                        f"{'class' if cls is not None else 'module'} — "
+                        f"a new IO path landed without a registered "
+                        f"fault site",
+                        hint="register a site in resilience/faults.py "
+                             "SITES and inject it next to the call",
+                        context=cls.name if cls is not None else sf.rel)
+                    if f:
+                        yield f
+            # 3) artifact writes in codegen modules
+            elif term == "open" and "/codegen/" in "/" + sf.rel:
+                mode = None
+                if len(node.args) >= 2 and isinstance(node.args[1],
+                                                      ast.Constant):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                       ast.Constant):
+                        mode = kw.value.value
+                if isinstance(mode, str) and "w" in mode \
+                        and not module_inject:
+                    f = sf.finding(
+                        "chaos-io-site", node,
+                        "codegen artifact write with no faults.inject "
+                        "site in the module — generated-file IO "
+                        "failures (full disk, readonly checkout) have "
+                        "no rehearsed recovery",
+                        hint="route writes through a helper that "
+                             "injects `codegen.write`",
+                        context=sf.rel)
+                    if f:
+                        yield f

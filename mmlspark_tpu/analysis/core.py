@@ -166,22 +166,29 @@ class Rule:
     family: str
     doc: str
     run: Callable[[Project], Iterable[Finding]]
+    #: ``file`` — findings depend only on one module's source, so the
+    #: incremental mode may reuse cached results for unchanged files;
+    #: ``project`` — findings depend on cross-file state (lock graphs,
+    #: docs catalogues, the tests/ index), re-run whenever anything in
+    #: the digest changes.
+    scope: str = "file"
 
 
 _RULES: list[Rule] = []
 
 
-def rule(name: str, family: str, doc: str):
+def rule(name: str, family: str, doc: str, scope: str = "file"):
     """Register a rule runner: ``fn(project) -> Iterable[Finding]``."""
     def deco(fn):
-        _RULES.append(Rule(name, family, doc, fn))
+        _RULES.append(Rule(name, family, doc, fn, scope))
         return fn
     return deco
 
 
 def all_rules() -> list[Rule]:
     # importing the families registers their rules
-    from . import jit_safety, concurrency, consistency  # noqa: F401
+    from . import (jit_safety, concurrency, consistency,  # noqa: F401
+                   donation, protocol)  # noqa: F401
     return list(_RULES)
 
 

@@ -1,0 +1,478 @@
+"""Donation/aliasing dataflow rules: host buffers must never be donated.
+
+The repo shipped the same bug class twice before these rules existed.
+PR 7's arrow-fitstream corruption: the fitStream step donated its batch
+buffers, and on the CPU backend ``jax.device_put`` of a numpy array can
+alias the host buffer ZERO-COPY — donating it hands memory the host
+allocator still owns back to XLA as scratch, and training corrupts
+nondeterministically. PR 9's post-resume NaN: restored checkpoints are
+host-numpy trees, and the donating mixed-precision dispatch handed those
+aliased buffers straight to XLA. Both cost a full debugging cycle; both
+are the SAME dataflow fact — *a host-owned buffer reached a donated
+argument position* — which a taint walk can see statically.
+
+* ``donation-host-alias`` — a value whose provenance is a host buffer
+  (``np.*`` constructors and ops, ``.to_numpy()``/arrow zero-copy
+  decoders, ``msgpack``/``pickle`` decodes, checkpoint-restore helpers,
+  ``jax.device_get``) reaches a donated argument position of a call to
+  a function known to be jitted with ``donate_argnums``.
+  ``jax.device_put`` does NOT launder the taint (that is exactly the
+  zero-copy alias); calling through a jitted function DOES — "material-
+  ized through a jitted copy" is the sanctioned sanitizer (the jit's
+  outputs are XLA-owned buffers), and so do ``jnp.*`` constructors.
+* ``donation-use-after-donate`` — a buffer passed at a donated position
+  is read again after the dispatch (including on the next iteration of
+  an enclosing loop) without being rebound: the buffer now belongs to
+  XLA and may already hold the step's outputs.
+
+The dynamic complement is :mod:`mmlspark_tpu.analysis.sanitize`
+(``MMLSPARK_TPU_SANITIZE=donation``): donated host-aliased inputs are
+poisoned after dispatch so anything the static walk misses fails loudly
+instead of corrupting silently.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+
+#: calls producing static (never-buffer) results regardless of args
+_UNTAINT_CALLS = {"len", "isinstance", "type", "getattr", "hasattr",
+                  "range", "enumerate", "zip", "int", "float", "bool",
+                  "str", "sorted", "min", "max", "sum"}
+#: attribute reads that are metadata, not the buffer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval",
+                 "nbytes", "itemsize"}
+
+#: dotted-name prefixes whose call results live in HOST memory
+_HOST_PREFIXES = ("np.", "numpy.", "onp.", "msgpack.", "pickle.",
+                  "pd.", "pandas.", "pa.", "pyarrow.")
+#: attribute calls that decode/expose a host buffer (arrow & friends)
+_HOST_METHODS = {"to_numpy", "to_pandas", "numpy", "tobytes", "unpackb"}
+#: function-name shapes that return restored (host) checkpoint state
+_RESTORE_RE = re.compile(r"restore|read_shards|unpackb|from_msgpack"
+                         r"|frombuffer|load_state")
+#: jit spellings whose wrapping both donates and sanitizes
+_JIT_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+
+_PARTIALS = ("functools.partial", "partial")
+
+
+def _is_test_path(rel: str) -> bool:
+    parts = rel.split("/")
+    return (any(p in ("tests", "testing", "fixtures") for p in parts)
+            or parts[-1].startswith("test_"))
+
+
+def _const_argnums(call: ast.Call) -> Optional[frozenset]:
+    """The literal ``donate_argnums`` positions of a jit call, or None
+    when absent/non-literal (a computed tuple can't be checked here —
+    the runtime sanitizer covers it)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        out = set()
+        for sub in ast.walk(kw.value):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, int):
+                out.add(sub.value)
+        return frozenset(out) if out else None
+    return None
+
+
+def _collect_donators(sf: SourceFile) -> dict[str, frozenset]:
+    """``{callable_name: donated_positions}`` for every name in this
+    module bound to a jitted-with-donation callable: module/local
+    ``name = jax.jit(f, donate_argnums=...)`` assignments and
+    ``@partial(jax.jit, donate_argnums=...)`` decorated defs."""
+    out: dict[str, frozenset] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if dotted(call.func) in _JIT_NAMES:
+                nums = _const_argnums(call)
+                if nums:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = nums
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                dn = dotted(dec.func)
+                if dn in _PARTIALS and dec.args \
+                        and dotted(dec.args[0]) in _JIT_NAMES:
+                    nums = _const_argnums(dec)
+                    if nums:
+                        out[node.name] = nums
+                elif dn in _JIT_NAMES:
+                    nums = _const_argnums(dec)
+                    if nums:
+                        out[node.name] = nums
+    return out
+
+
+def _direct_donating_call(call: ast.Call) -> Optional[frozenset]:
+    """``jax.jit(f, donate_argnums=(0,))(x)`` — the wrapper applied
+    inline."""
+    if isinstance(call.func, ast.Call) \
+            and dotted(call.func.func) in _JIT_NAMES:
+        return _const_argnums(call.func)
+    return None
+
+
+def _collect_host_returners(sf: SourceFile) -> set:
+    """Module-local functions whose return value is host-tainted (a
+    one-level interprocedural summary: calls to these names are host
+    origins at their call sites — how ``_restore_checkpoint``-style
+    helpers propagate)."""
+    out: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        taint = _HostTaint(set(), {}, out)
+        returns_host = False
+        for st in ast.walk(node):
+            if isinstance(st, ast.Assign):
+                t = taint.expr(st.value)
+                for target in st.targets:
+                    taint.assign(target, t)
+            elif isinstance(st, ast.Return) and st.value is not None:
+                if taint.expr(st.value):
+                    returns_host = True
+        if returns_host or _RESTORE_RE.search(node.name):
+            out.add(node.name)
+    return out
+
+
+class _HostTaint:
+    """Lexical host-buffer provenance over one function body."""
+
+    def __init__(self, tainted: set, jitted_names: dict,
+                 host_returners: set):
+        self.names = set(tainted)
+        #: every name bound to a jax.jit(...) result (donating or not):
+        #: calls through them MATERIALIZE — output buffers are XLA-owned
+        self.jitted = set(jitted_names)
+        self.host_returners = set(host_returners)
+
+    def _call_taint(self, node: ast.Call) -> bool:
+        fname = dotted(node.func)
+        term = fname.rsplit(".", 1)[-1] if fname else ""
+        # sanitizers first: jitted-call outputs are device-owned
+        if fname in _JIT_NAMES or term in self.jitted \
+                or _direct_donating_call(node) is not None \
+                or (isinstance(node.func, ast.Call)
+                    and dotted(node.func.func) in _JIT_NAMES):
+            return False
+        if fname and (fname.startswith("jnp.")
+                      or fname.startswith("jax.numpy.")):
+            return False
+        if fname in _UNTAINT_CALLS:
+            return False
+        # host origins
+        if fname and fname.startswith(_HOST_PREFIXES):
+            return True
+        if fname in ("memoryview", "bytearray"):
+            return True
+        if fname in ("jax.device_get", "device_get"):
+            return True
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_METHODS:
+            return True
+        if term and term in self.host_returners:
+            return True
+        if term and _RESTORE_RE.search(term):
+            return True
+        # device_put PRESERVES host provenance: on the CPU backend the
+        # placed array may alias the numpy buffer zero-copy
+        if fname in ("jax.device_put", "device_put"):
+            return any(self.expr(a) for a in node.args[:1])
+        # any other call fed a host buffer conservatively returns one
+        # (slicing/padding helpers, np-aliased wrappers)
+        return (any(self.expr(a) for a in node.args)
+                or any(self.expr(k.value) for k in node.keywords))
+
+    def expr(self, node) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.BinOp):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(v is not None and self.expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        return False
+
+    def assign(self, target, value_tainted: bool):
+        for t in (ast.walk(target) if not isinstance(target, ast.Name)
+                  else (target,)):
+            if isinstance(t, ast.Name):
+                if value_tainted:
+                    self.names.add(t.id)
+                else:
+                    self.names.discard(t.id)
+
+
+class _FnWalk:
+    """One function's linear walk: host-alias sinks + use-after-donate."""
+
+    def __init__(self, sf: SourceFile, qual: str,
+                 donators: dict[str, frozenset], jitted: dict,
+                 host_returners: set):
+        self.sf = sf
+        self.qual = qual
+        self.donators = donators
+        self.taint = _HostTaint(set(), jitted, host_returners)
+        #: name -> the donating call node that consumed it
+        self.donated: dict[str, ast.Call] = {}
+        self.findings: list[Finding] = []
+        #: loop bodies are walked twice (cross-iteration reuse); one
+        #: report per (rule, site) regardless of pass
+        self._reported: set = set()
+
+    def _donated_positions(self, call: ast.Call) -> Optional[frozenset]:
+        nums = _direct_donating_call(call)
+        if nums:
+            return nums
+        fname = dotted(call.func)
+        if fname is None:
+            return None
+        return self.donators.get(fname.rsplit(".", 1)[-1])
+
+    def _flag_alias(self, call, pos, arg):
+        key = ("alias", getattr(call, "lineno", 0),
+               getattr(call, "col_offset", 0), pos)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        f = self.sf.finding(
+            "donation-host-alias", call,
+            f"argument {pos} of this dispatch is DONATED but its value "
+            f"traces back to a host-owned buffer (np array / zero-copy "
+            f"decode / checkpoint restore) in `{self.qual}` — on the CPU "
+            f"backend device_put may alias that buffer zero-copy, and "
+            f"donating it hands memory the host allocator still owns to "
+            f"XLA as scratch (the PR 7 arrow-fitstream / PR 9 post-resume "
+            f"corruption class)",
+            hint="materialize through a jitted copy first (e.g. "
+                 "jax.jit(lambda t: jax.tree_util.tree_map(jnp.copy, t))) "
+                 "or disable donation on the CPU backend",
+            context=self.qual)
+        if f:
+            self.findings.append(f)
+
+    def _scan_calls(self, expr_node, assigned_names: set):
+        """Flag donation sinks + poisoned re-reads inside an expression."""
+        for node in ast.walk(expr_node):
+            if not isinstance(node, ast.Call):
+                continue
+            nums = self._donated_positions(node)
+            if nums is None:
+                continue
+            for pos in sorted(nums):
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if self.taint.expr(arg):
+                    self._flag_alias(node, pos, arg)
+                if isinstance(arg, ast.Name):
+                    # donated from here on, unless the statement's own
+                    # targets rebind it (params, opt = step(params, opt))
+                    if arg.id not in assigned_names:
+                        self.donated[arg.id] = node
+
+    def _check_reads(self, expr_node, skip: set):
+        """A Load of a name donated earlier = use-after-donate."""
+        for node in ast.walk(expr_node):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in self.donated and node.id not in skip:
+                key = ("reuse", getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), node.id)
+                if key in self._reported:
+                    self.donated.pop(node.id, None)
+                    continue
+                self._reported.add(key)
+                f = self.sf.finding(
+                    "donation-use-after-donate", node,
+                    f"`{node.id}` was passed at a donated position of a "
+                    f"jitted dispatch earlier in `{self.qual}` and is "
+                    f"read again here — the buffer now belongs to XLA "
+                    f"and may already hold the dispatch's outputs",
+                    hint="donated buffers are consumed: rebind the name "
+                         "from the call's outputs, or drop the donation "
+                         "for buffers you must re-read",
+                    context=self.qual)
+                if f:
+                    self.findings.append(f)
+                # one report per name per donation event
+                self.donated.pop(node.id, None)
+
+    def _assigned_names(self, st) -> set:
+        out: set = set()
+        targets = []
+        if isinstance(st, ast.Assign):
+            targets = st.targets
+        elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+            targets = [st.target]
+        elif isinstance(st, ast.For):
+            targets = [st.target]
+        for t in targets:
+            for sub in (ast.walk(t) if not isinstance(t, ast.Name)
+                        else (t,)):
+                if isinstance(sub, ast.Name):
+                    out.add(sub.id)
+        return out
+
+    def stmt(self, st):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return      # nested scopes get their own walk
+        assigned = self._assigned_names(st)
+        if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            value = getattr(st, "value", None)
+            if value is not None:
+                self._check_reads(value, set())
+                self._scan_calls(value, assigned)
+                t = self.taint.expr(value)
+                targets = (st.targets if isinstance(st, ast.Assign)
+                           else [st.target])
+                for target in targets:
+                    self.taint.assign(target, t)
+            for name in assigned:
+                self.donated.pop(name, None)   # rebound: fresh buffer
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._check_reads(st.test, set())
+            self._scan_calls(st.test, set())
+            snap_d, snap_t = dict(self.donated), set(self.taint.names)
+            self.walk(st.body)
+            d_body, t_body = self.donated, self.taint.names
+            self.donated, self.taint.names = dict(snap_d), set(snap_t)
+            self.walk(st.orelse or [])
+            self.donated.update(d_body)           # union: conservative
+            self.taint.names |= t_body
+            return
+        if isinstance(st, (ast.For,)):
+            self._check_reads(st.iter, set())
+            self._scan_calls(st.iter, assigned)
+            self.taint.assign(st.target, self.taint.expr(st.iter))
+            # two passes over the body: the second catches a buffer
+            # donated on iteration N and re-read on iteration N+1
+            for _ in range(2):
+                for name in assigned:
+                    self.donated.pop(name, None)  # loop target rebinds
+                self.walk(st.body)
+            self.walk(st.orelse or [])
+            return
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self._check_reads(item.context_expr, set())
+                self._scan_calls(item.context_expr, set())
+            self.walk(st.body)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body)
+            for h in st.handlers:
+                self.walk(h.body)
+            self.walk(st.orelse or [])
+            self.walk(st.finalbody or [])
+            return
+        if isinstance(st, (ast.Return, ast.Expr)) \
+                and getattr(st, "value", None) is not None:
+            self._check_reads(st.value, set())
+            self._scan_calls(st.value, set())
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._check_reads(child, set())
+                self._scan_calls(child, set())
+
+    def walk(self, stmts):
+        for st in stmts:
+            self.stmt(st)
+
+
+def _collect_jitted_names(sf: SourceFile) -> set:
+    """Every name bound to ANY jax.jit(...) result — donating or not —
+    plus defs decorated with a jit spelling: calling through one
+    materializes host inputs into XLA-owned outputs (the sanitizer)."""
+    out: set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            dn = dotted(node.value.func)
+            if dn in _JIT_NAMES or (
+                    dn in _PARTIALS and node.value.args
+                    and dotted(node.value.args[0]) in _JIT_NAMES):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dn = dotted(dec.func) if isinstance(dec, ast.Call) \
+                    else dotted(dec)
+                if dn in _JIT_NAMES:
+                    out.add(node.name)
+                elif isinstance(dec, ast.Call) and dn in _PARTIALS \
+                        and dec.args and dotted(dec.args[0]) in _JIT_NAMES:
+                    out.add(node.name)
+    return out
+
+
+def _module_findings(sf: SourceFile) -> Iterable[Finding]:
+    donators = _collect_donators(sf)
+    jitted = _collect_jitted_names(sf)
+    host_returners = _collect_host_returners(sf)
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                w = _FnWalk(sf, qualname_of(stack + [child]), donators,
+                            jitted, host_returners)
+                w.walk(child.body)
+                yield from w.findings
+                yield from visit(child, stack + [child])
+            elif isinstance(child, ast.ClassDef):
+                yield from visit(child, stack + [child])
+            else:
+                yield from visit(child, stack)
+
+    yield from visit(sf.tree, [])
+
+
+def _donation_findings(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        yield from _module_findings(sf)
+
+
+@rule("donation-host-alias", "donation",
+      "host-owned buffers (np/arrow/restore provenance) reaching donated "
+      "argument positions of jitted dispatches")
+def check_host_alias(project: Project) -> Iterable[Finding]:
+    return [f for f in _donation_findings(project)
+            if f.rule == "donation-host-alias"]
+
+
+@rule("donation-use-after-donate", "donation",
+      "buffers re-read after being passed at a donated position")
+def check_use_after_donate(project: Project) -> Iterable[Finding]:
+    return [f for f in _donation_findings(project)
+            if f.rule == "donation-use-after-donate"]

@@ -1,0 +1,169 @@
+"""Incremental analysis: ``--changed-only``, keyed on file content hashes.
+
+The full repo pass parses every module and walks every rule — fine in
+CI, wasteful in an edit loop where one file changed. The cache
+(``<root>/.graftlint/cache.json``) stores, per analyzed file, the
+sha256 of its text plus the file-scoped findings it produced, and one
+project-level entry (digest over EVERY file hash + the observability
+doc + the tests/ index + the selected rule set) holding the
+project-scoped findings (lock-order graph, catalogue/chaos coverage,
+codegen sync — anything whose result can change when OTHER files do).
+
+On a run:
+
+* a file whose hash matches the cache contributes its cached findings
+  with zero re-analysis;
+* changed/new files are re-run through the file-scoped rules only;
+* the project-scoped rules re-run only when the project digest moved.
+
+A fully unchanged tree is therefore a pure cache hit — no rule runs at
+all (``stats["analyzed_files"] == 0 and not stats["project_rules_run"]``,
+the property the tier-1 test pins). Baseline matching is re-applied
+after assembly, so editing the baseline never requires a cache flush.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Iterable, Optional
+
+from .core import Baseline, Finding, Project, all_rules, load_project
+
+
+def default_cache_path(root: str) -> str:
+    return os.path.join(root, ".graftlint", "cache.json")
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {"rule": f.rule, "file": f.path, "line": f.line,
+            "context": f.context, "message": f.message, "hint": f.hint,
+            "code": f.code}
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(rule=d["rule"], path=d["file"], line=int(d["line"]),
+                   message=d["message"], hint=d.get("hint", ""),
+                   context=d.get("context", "<module>"),
+                   code=d.get("code", ""))
+
+
+def _env_digest(project: Project, rule_names: list) -> str:
+    """Cross-file inputs the project-scoped rules read: the docs, the
+    tests/ index, the selected rules themselves."""
+    h = hashlib.sha256()
+    h.update(",".join(sorted(rule_names)).encode())
+    from .consistency import _doc_path, _tests_dir, _tests_index
+    doc = _doc_path(project)
+    if doc and os.path.isfile(doc):
+        with open(doc, encoding="utf-8") as f:
+            h.update(_sha(f.read()).encode())
+    tests = _tests_dir(project)
+    if tests:
+        h.update(_sha(_tests_index(tests)).encode())
+    return h.hexdigest()
+
+
+def run_changed_only(paths: list, root: Optional[str] = None,
+                     baseline: Optional[str] = None,
+                     rules: Optional[Iterable[str]] = None,
+                     options: Optional[dict] = None,
+                     cache_path: Optional[str] = None):
+    """Returns ``(findings, stats)``; findings match what
+    :func:`mmlspark_tpu.analysis.run_analysis` would produce for the
+    same inputs, stats report what actually ran:
+    ``{"analyzed_files", "reused_files", "project_rules_run",
+    "cache_hit"}``."""
+    project = load_project(paths, root=root, options=options)
+    cache_path = cache_path or default_cache_path(project.root)
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        cache = {}
+    cached_files = cache.get("files", {})
+
+    selected = all_rules()
+    if rules is not None:
+        wanted = set(rules)
+        selected = [r for r in selected
+                    if r.name in wanted or r.family in wanted]
+    file_rules = [r for r in selected if r.scope == "file"]
+    project_rules = [r for r in selected if r.scope == "project"]
+
+    hashes = {sf.rel: _sha(sf.text) for sf in project.files}
+    changed = [sf for sf in project.files
+               if cached_files.get(sf.rel, {}).get("sha256")
+               != hashes[sf.rel]]
+    changed_rels = {sf.rel for sf in changed}
+    unchanged = [sf for sf in project.files
+                 if sf.rel not in changed_rels]
+
+    findings: list[Finding] = []
+    new_files_entry: dict = {}
+    for sf in unchanged:
+        cached = cached_files[sf.rel]
+        findings.extend(_finding_from_dict(d)
+                        for d in cached.get("findings", []))
+        new_files_entry[sf.rel] = cached
+    if changed:
+        sub = Project(changed, project.root, project.options)
+        per_file: dict[str, list] = {sf.rel: [] for sf in changed}
+        for r in file_rules:
+            for f in r.run(sub):
+                if f is not None:
+                    findings.append(f)
+                    per_file.setdefault(f.path, []).append(f)
+        for sf in changed:
+            new_files_entry[sf.rel] = {
+                "sha256": hashes[sf.rel],
+                "findings": [_finding_to_dict(f)
+                             for f in per_file.get(sf.rel, [])]}
+
+    # project-scoped rules: digest over everything they can read
+    digest = hashlib.sha256()
+    for rel in sorted(hashes):
+        digest.update(f"{rel}:{hashes[rel]};".encode())
+    digest.update(_env_digest(project,
+                              [r.name for r in project_rules]).encode())
+    digest = digest.hexdigest()
+    cached_project = cache.get("project", {})
+    project_rules_run = False
+    if project_rules:
+        if cached_project.get("digest") == digest:
+            findings.extend(_finding_from_dict(d)
+                            for d in cached_project.get("findings", []))
+            project_findings = cached_project.get("findings", [])
+        else:
+            project_rules_run = True
+            fresh = []
+            for r in project_rules:
+                fresh.extend(f for f in r.run(project) if f is not None)
+            findings.extend(fresh)
+            project_findings = [_finding_to_dict(f) for f in fresh]
+    else:
+        project_findings = []
+
+    try:
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        with open(cache_path, "w", encoding="utf-8") as f:
+            json.dump({"version": 1, "files": new_files_entry,
+                       "project": {"digest": digest,
+                                   "findings": project_findings}}, f)
+    except OSError:
+        pass     # a read-only checkout still gets correct results
+
+    base = Baseline.load(baseline) if baseline else Baseline([])
+    for f in findings:
+        f.baselined = base.matches(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stats = {"analyzed_files": len(changed),
+             "reused_files": len(unchanged),
+             "project_rules_run": project_rules_run,
+             "cache_hit": not changed and not project_rules_run}
+    return findings, stats

@@ -1,0 +1,405 @@
+"""Distributed-protocol rules: collectives, watcher threads, commit order.
+
+As more of the program moves inside single traced/collective programs
+(whole-program capture, communication-aware kernels — PAPERS.md arxiv
+1810.09868 / 2007.01811), the bugs that remain are exactly the ones
+pytest-on-one-host cannot see: a collective naming an axis the mesh
+doesn't have (trace-time error only on the real mesh), a collective
+dispatched under a condition that differs per rank (a deadlock that
+needs two processes to reproduce), a blocking host call on the thread
+that must stay responsive to unwind a wedged attempt, and a durability
+protocol whose ordering invariant (fsync before rename, manifest last)
+is only violated observable-y when the power goes out.
+
+* ``protocol-collective-axis`` — a collective (``psum`` / ``pmean`` /
+  ``all_gather`` / ``ppermute`` / ``all_to_all`` / ``psum_scatter``)
+  whose LITERAL axis name is absent from the enclosing ``shard_map``
+  call's declared axes (``in_specs``/``out_specs`` ``P(...)`` entries,
+  ``axis_names=``). Variable axis names are skipped — parameterized
+  helpers validate at runtime (``parallel/sequence.py`` raises on an
+  unknown axis before tracing).
+* ``protocol-divergent-collective`` — a collective (device collectives
+  plus the host-level barrier/allgather helpers) lexically under an
+  ``if``/``while`` whose condition depends on per-rank identity
+  (``process_index()``, ``rank``/``host_id``/``process_id`` names) or
+  per-host entropy (``random``, wall time): ranks that disagree about
+  the branch leave the others blocked in the collective forever.
+* ``protocol-attempt-thread-blocking`` — a blocking host call
+  (``sleep`` / thread ``join`` / HTTP / ``queue.get``) in the body of a
+  thread target whose thread is named like an attempt/watcher thread
+  (``threading.Thread(..., name="...attempt...")``): those threads must
+  stay responsive so a wedged collective can be abandoned within its
+  detection bound (``resilience/elastic.py``).
+* ``protocol-rename-before-fsync`` — an ``os.replace``/``os.rename``
+  publishing a tmp file with no ``os.fsync`` earlier in the same
+  function: after a crash the rename can land with the data still in
+  the page cache — a complete-looking file with torn contents, the
+  exact window the checkpoint commit protocol exists to close
+  (``resilience/ckpt.py``).
+* ``protocol-manifest-order`` — a manifest commit (``_commit_manifest``
+  or a ``*manifest*`` helper) ordered BEFORE a payload/shard write in
+  the same function: the manifest must be the LAST write so its
+  presence implies every listed file landed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+
+_SHARD_MAP_NAMES = {"shard_map", "jax.shard_map",
+                    "jax.experimental.shard_map.shard_map"}
+_COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                "ppermute", "pshuffle", "all_to_all", "psum_scatter"}
+#: host-level collective helpers (block until every rank arrives)
+_HOST_COLLECTIVES = {"process_barrier", "wait_at_barrier"}
+
+_RANKISH = re.compile(r"^(rank|ranks|host_id|process_id|proc_id"
+                      r"|process_index|pid_env)$")
+_DIVERGENT_CALLS = {"jax.process_index", "process_index", "time.time",
+                    "time.monotonic", "uuid.uuid4", "os.getpid"}
+
+_BLOCKING = {
+    "time.sleep": "time.sleep",
+    "urllib.request.urlopen": "HTTP round-trip",
+    "urlopen": "HTTP round-trip",
+    "requests.get": "HTTP round-trip", "requests.post": "HTTP round-trip",
+    "requests.request": "HTTP round-trip",
+    "subprocess.run": "subprocess", "subprocess.check_output": "subprocess",
+}
+_QUEUEISH = re.compile(r"(^|_)(q|queue|pending|inbox|outbox)$")
+_THREADISH = re.compile(r"(^|_)(thread|proc|process|worker)s?$")
+
+_ATTEMPT_NAME = re.compile(r"attempt|watcher")
+
+
+def _is_test_path(rel: str) -> bool:
+    parts = rel.split("/")
+    return (any(p in ("tests", "testing", "fixtures") for p in parts)
+            or parts[-1].startswith("test_"))
+
+
+def _terminal(name: Optional[str]) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+# ------------------------------------------------------- collective axis rule
+
+def _spec_axis_names(call: ast.Call) -> set:
+    """Literal axis names declared by a shard_map call: every string
+    constant inside ``in_specs``/``out_specs`` (``P('data', ...)``)
+    plus an ``axis_names=`` kwarg."""
+    out: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("in_specs", "out_specs", "axis_names", "axes"):
+            for sub in ast.walk(kw.value):
+                if isinstance(sub, ast.Constant) \
+                        and isinstance(sub.value, str):
+                    out.add(sub.value)
+    return out
+
+
+def _collective_axis(call: ast.Call) -> Optional[ast.AST]:
+    """The axis argument of a collective call (positional arg 1 or the
+    ``axis_name=`` kwarg), or None."""
+    for kw in call.keywords:
+        if kw.arg == "axis_name":
+            return kw.value
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+@rule("protocol-collective-axis", "protocol",
+      "collectives naming an axis absent from the enclosing shard_map "
+      "spec")
+def check_collective_axis(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        # local defs by name, so `shard_map(body, ...)` resolves `body`
+        defs: dict[str, list] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if _terminal(dotted(call.func)) != "shard_map" \
+                    and dotted(call.func) not in _SHARD_MAP_NAMES:
+                continue
+            axes = _spec_axis_names(call)
+            if not axes:
+                continue       # specs not statically determinable
+            bodies: list = []
+            target = call.args[0] if call.args else None
+            if isinstance(target, ast.Lambda):
+                bodies.append(target)
+            elif target is not None:
+                for sub in ast.walk(target):
+                    if isinstance(sub, ast.Name):
+                        bodies.extend(defs.get(sub.id, ()))
+            for body in bodies:
+                for sub in ast.walk(body):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    if _terminal(dotted(sub.func)) not in _COLLECTIVES:
+                        continue
+                    ax = _collective_axis(sub)
+                    if not (isinstance(ax, ast.Constant)
+                            and isinstance(ax.value, str)):
+                        continue     # variable axis: runtime-validated
+                    if ax.value in axes:
+                        continue
+                    qual = getattr(body, "name", "<lambda>")
+                    f = sf.finding(
+                        "protocol-collective-axis", sub,
+                        f"collective `{_terminal(dotted(sub.func))}` "
+                        f"names axis '{ax.value}' but the enclosing "
+                        f"shard_map declares only {sorted(axes)} — a "
+                        f"trace-time error on the real mesh (and "
+                        f"invisible on a 1-device test mesh)",
+                        hint="use an axis the mesh spec declares, or "
+                             "thread the axis name through as a "
+                             "parameter validated against "
+                             "mesh.axis_names",
+                        context=qual)
+                    if f:
+                        yield f
+
+
+# --------------------------------------------------- divergent collective
+
+def _is_divergent(test: ast.AST) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Call):
+            dn = dotted(sub.func)
+            if dn in _DIVERGENT_CALLS \
+                    or _terminal(dn) == "process_index" \
+                    or (dn or "").startswith(("random.", "np.random.")):
+                return True
+        elif isinstance(sub, ast.Name) and _RANKISH.match(sub.id):
+            return True
+        elif isinstance(sub, ast.Attribute) and _RANKISH.match(sub.attr):
+            return True
+    return False
+
+
+def _is_collective_call(call: ast.Call) -> bool:
+    term = _terminal(dotted(call.func))
+    return (term in _COLLECTIVES or term in _HOST_COLLECTIVES
+            or term.startswith("allgather"))
+
+
+@rule("protocol-divergent-collective", "protocol",
+      "collectives under a condition that can diverge per rank "
+      "(deadlock: some ranks enter, the rest never arrive)")
+def check_divergent_collective(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+
+        def walk(node, divergent_stack, stack):
+            for child in ast.iter_child_nodes(node):
+                new_stack = stack
+                div = divergent_stack
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    new_stack = stack + [child]
+                    div = 0       # conditions don't cross function scopes
+                elif isinstance(child, (ast.If, ast.While)) \
+                        and _is_divergent(child.test):
+                    div = divergent_stack + 1
+                if isinstance(child, ast.Call) and divergent_stack > 0 \
+                        and _is_collective_call(child):
+                    qual = qualname_of(stack)
+                    f = sf.finding(
+                        "protocol-divergent-collective", child,
+                        f"collective `{_terminal(dotted(child.func))}` "
+                        f"dispatched under a per-rank-divergent "
+                        f"condition in `{qual}` — ranks that take the "
+                        f"other branch never enter it, and the ranks "
+                        f"that did block until the collective timeout",
+                        hint="hoist the collective out of the branch "
+                             "(every rank must dispatch it), or derive "
+                             "the condition from replicated state",
+                        context=qual)
+                    if f:
+                        yield f
+                yield from walk(child, div, new_stack)
+
+        yield from walk(sf.tree, 0, [])
+
+
+# --------------------------------------------------- attempt-thread blocking
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    dn = dotted(call.func)
+    if dn in _BLOCKING:
+        return _BLOCKING[dn]
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        recv = _terminal(dotted(call.func.value))
+        if attr == "join" and _THREADISH.search(recv or ""):
+            return f"{recv}.join"
+        if attr in ("get", "put") and _QUEUEISH.search(recv or ""):
+            return f"blocking queue.{attr}"
+        if attr == "urlopen":
+            return "HTTP round-trip"
+    return None
+
+
+@rule("protocol-attempt-thread-blocking", "protocol",
+      "blocking host calls in attempt/watcher thread targets")
+def check_attempt_thread_blocking(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        # local + method defs by bare name
+        defs: dict[str, list] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        for call in ast.walk(sf.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            if _terminal(dotted(call.func)) != "Thread":
+                continue
+            target = None
+            tname = ""
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                elif kw.arg == "name":
+                    for sub in ast.walk(kw.value):
+                        if isinstance(sub, ast.Constant) \
+                                and isinstance(sub.value, str):
+                            tname += sub.value
+            if target is None or not _ATTEMPT_NAME.search(tname):
+                continue
+            tn = _terminal(dotted(target))
+            for body in defs.get(tn, ()):
+                for sub in ast.walk(body):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    reason = _blocking_reason(sub)
+                    if reason is None:
+                        continue
+                    f = sf.finding(
+                        "protocol-attempt-thread-blocking", sub,
+                        f"{reason} on attempt/watcher thread "
+                        f"'{tname}' (target `{body.name}`) — this "
+                        f"thread must stay responsive so a wedged "
+                        f"attempt can be unwound within its detection "
+                        f"bound",
+                        hint="move the blocking work to its own thread "
+                             "or replace it with a bounded poll",
+                        context=body.name)
+                    if f:
+                        yield f
+
+
+# ---------------------------------------------------- commit-order rules
+
+#: a call that COMMITS the manifest (``manifest_path``/``load_manifest``
+#: are reads, not commits)
+_MANIFEST_COMMIT_RE = re.compile(r"(commit|write|publish).*manifest"
+                                 r"|manifest.*(commit|write|publish)")
+
+
+def _ordered_events(fn_node) -> list:
+    """(kind, node) in statement order for the commit-protocol rules:
+    'fsync' (os.fsync), 'rename' (os.replace/os.rename of a tmp-ish
+    source), 'payload' (a shard/payload write helper), 'manifest' (a
+    manifest-commit helper)."""
+    events = []
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        dn = dotted(sub.func)
+        term = _terminal(dn)
+        if dn in ("os.fsync", "fsync"):
+            events.append(("fsync", sub))
+        elif dn in ("os.replace", "os.rename"):
+            src = dotted(sub.args[0]) if sub.args else None
+            src_txt = src or ""
+            if isinstance(sub.args[0] if sub.args else None, ast.JoinedStr):
+                src_txt = "tmp"     # f"...tmp..." templates
+            if "tmp" in src_txt.lower():
+                events.append(("rename", sub))
+        elif term in ("write_shard", "publish", "publish_sharded") \
+                or "shard" in term and term.startswith("write"):
+            events.append(("payload", sub))
+        elif _MANIFEST_COMMIT_RE.search(term.lower()):
+            events.append(("manifest", sub))
+    events.sort(key=lambda e: (getattr(e[1], "lineno", 0),
+                               getattr(e[1], "col_offset", 0)))
+    return events
+
+
+@rule("protocol-rename-before-fsync", "protocol",
+      "tmp-file publish renamed with no fsync first (torn-write window)")
+def check_rename_before_fsync(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            events = _ordered_events(node)
+            fsynced = False
+            for kind, call in events:
+                if kind == "fsync":
+                    fsynced = True
+                elif kind == "rename" and not fsynced:
+                    f = sf.finding(
+                        "protocol-rename-before-fsync", call,
+                        f"`{dotted(call.func)}` publishes a tmp file in "
+                        f"`{node.name}` with no os.fsync first — after "
+                        f"a crash the rename can be durable while the "
+                        f"data is still in the page cache, leaving a "
+                        f"complete-looking file with torn contents",
+                        hint="flush + os.fsync(f.fileno()) before the "
+                             "rename (see resilience/ckpt.py publish)",
+                        context=node.name)
+                    if f:
+                        yield f
+
+
+@rule("protocol-manifest-order", "protocol",
+      "manifest committed before payload/shard writes in the same "
+      "function (manifest must be LAST)")
+def check_manifest_order(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        if _is_test_path(sf.rel):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if _MANIFEST_COMMIT_RE.search(node.name.lower()):
+                continue    # this function's own rename IS the manifest
+            events = [e for e in _ordered_events(node)
+                      if e[0] in ("manifest", "payload", "rename")]
+            manifest_seen = None
+            for kind, call in events:
+                if kind == "manifest":
+                    manifest_seen = call
+                elif manifest_seen is not None:
+                    f = sf.finding(
+                        "protocol-manifest-order", manifest_seen,
+                        f"the manifest is committed BEFORE a later "
+                        f"payload write in `{node.name}` — a crash "
+                        f"between the two leaves a manifest vouching "
+                        f"for files that never landed (resume trusts "
+                        f"the manifest)",
+                        hint="commit the manifest LAST, after every "
+                             "payload/shard rename has landed",
+                        context=node.name)
+                    if f:
+                        yield f
+                    break

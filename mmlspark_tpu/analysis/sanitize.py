@@ -1,0 +1,149 @@
+"""Donation sanitizer — the dynamic oracle behind the donation rules.
+
+``MMLSPARK_TPU_SANITIZE=donation`` arms it (tests and chaos runs; OFF
+by default with zero overhead — the wrapper is only installed when the
+env knob is set at step-build time). Every donating dispatch the
+trainer builds goes through :func:`wrap_donated`, which does two things
+the static taint walk (:mod:`mmlspark_tpu.analysis.donation`) cannot:
+
+* **poison after dispatch** — any argument at a donated position whose
+  leaves are HOST numpy buffers (the zero-copy-alias hazard: on the CPU
+  backend ``device_put`` may alias them, and XLA now treats that memory
+  as scratch) is filled with a sentinel (NaN for floats, ``0xDD`` for
+  ints) immediately after the call returns.  The PR 7 / PR 9 bug class
+  corrupted *nondeterministically* — whenever the host allocator
+  happened to reuse the pages; poisoning makes the reuse deterministic,
+  so a donation bug fails the FIRST run, loudly, with sentinel values
+  instead of a flaky 1e35 loss three epochs later.
+* **trap re-reads** — a poisoned buffer showing up as an argument to a
+  later sanitized dispatch raises :class:`DonatedBufferReuse`
+  immediately (counted on ``mmlspark_sanitizer_poisoned_reads_total``)
+  — the dynamic twin of the ``donation-use-after-donate`` rule.
+
+The sanitizer never changes program semantics for correct code: donated
+buffers are consumed by contract, so poisoning memory the program must
+never read again is a no-op for every correct caller.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Iterable
+
+from .. import telemetry
+
+_m_poisoned = telemetry.registry.counter(
+    "mmlspark_sanitizer_poisoned_buffers",
+    "host-aliased buffers found at donated argument positions and "
+    "filled with the sentinel after dispatch (each one is a donation "
+    "hazard the static rules should also have flagged)")
+_m_poisoned_reads = telemetry.registry.counter(
+    "mmlspark_sanitizer_poisoned_reads",
+    "re-reads of poisoned (donated) buffers trapped at a later "
+    "sanitized dispatch — use-after-donate caught dynamically")
+
+#: finite int sentinel byte; floats get NaN (anything arithmetic with
+#: it stays NaN, so the corruption cannot silently average away)
+_INT_SENTINEL = 0xDD
+
+
+class DonatedBufferReuse(RuntimeError):
+    """A buffer previously passed at a donated position (and poisoned)
+    reached a later sanitized dispatch — the dynamic use-after-donate."""
+
+
+def enabled() -> bool:
+    from ..core.env import sanitize_mode
+    return sanitize_mode() == "donation"
+
+
+#: id(buffer) -> weakref; weakrefs keep id() collisions from false-
+#: positiving after the poisoned array is garbage collected
+_poisoned: dict = {}
+
+
+def _np():
+    import numpy as np
+    return np
+
+
+def _leaves(tree) -> Iterable:
+    import jax
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _host_buffers(tree) -> list:
+    """The numpy-owned leaves of ``tree`` — buffers the host allocator
+    still controls after a donating dispatch placed (or aliased) them."""
+    np = _np()
+    return [leaf for leaf in _leaves(tree)
+            if isinstance(leaf, np.ndarray) and leaf.size > 0]
+
+
+def _poison(arr) -> None:
+    np = _np()
+    try:
+        if np.issubdtype(arr.dtype, np.floating) \
+                or np.issubdtype(arr.dtype, np.complexfloating):
+            arr.fill(np.nan)
+        elif np.issubdtype(arr.dtype, np.integer):
+            arr.fill(_INT_SENTINEL)
+        else:
+            return       # bool/str leaves: nothing sensible to poison
+    except ValueError:
+        return           # read-only buffer: cannot alias-corrupt either
+    _poisoned[id(arr)] = weakref.ref(arr)
+    _m_poisoned.inc()
+
+
+def _check_not_poisoned(tree, label: str) -> None:
+    for leaf in _leaves(tree):
+        ref = _poisoned.get(id(leaf))
+        if ref is not None and ref() is leaf:
+            _m_poisoned_reads.inc()
+            telemetry.trace.instant("sanitizer/poisoned_read",
+                                    dispatch=label)
+            raise DonatedBufferReuse(
+                f"buffer id={id(leaf)} shape={getattr(leaf, 'shape', ())} "
+                f"was donated to an earlier dispatch and poisoned; it "
+                f"reached dispatch {label!r} again — donated buffers are "
+                f"consumed, rebind from the call's outputs")
+
+
+def clear() -> None:
+    """Forget poisoned-buffer identities (test isolation)."""
+    _poisoned.clear()
+
+
+def wrap_donated(fn, donate_argnums, label: str = "step"):
+    """Wrap a donating dispatch. When the sanitizer is DISARMED (the
+    default) returns ``fn`` unchanged — zero overhead, zero behavior
+    change. Armed: traps poisoned re-reads across dispatches, then
+    poisons the host-aliased donated inputs of this one."""
+    if not enabled() or not donate_argnums:
+        return fn
+    donate = tuple(sorted(set(int(i) for i in donate_argnums)))
+
+    def sanitized(*args, **kwargs):
+        _check_not_poisoned((args, kwargs), label)
+        hazards = []
+        for i in donate:
+            if i < len(args):
+                hazards.extend(_host_buffers(args[i]))
+        out = fn(*args, **kwargs)
+        for arr in hazards:
+            _poison(arr)
+        if hazards:
+            telemetry.trace.instant("sanitizer/poisoned", dispatch=label,
+                                    buffers=len(hazards))
+        return out
+
+    sanitized.__name__ = getattr(fn, "__name__", "sanitized")
+    sanitized.__wrapped__ = fn
+    if hasattr(fn, "lower"):
+        # the profiler's AOT path (ProfiledFunction._compile) lowers the
+        # step fn directly; forward it so profile=True composes (AOT
+        # dispatches skip the poison pass — the sanitizer is a test-tier
+        # oracle, not a semantics guarantee under every wrapper stack)
+        sanitized.lower = fn.lower
+    return sanitized

@@ -1,0 +1,65 @@
+"""SARIF 2.1.0 export — the interchange format CI annotation UIs speak.
+
+One run per invocation: the tool descriptor lists every rule that
+produced a finding (id + short description from the registry), results
+carry ``ruleId`` / message / one physical location each, and baselined
+findings are downgraded to ``note`` level with a ``suppressions`` entry
+(SARIF's spelling of "known, grandfathered") so a viewer shows them
+struck through instead of red.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from .core import Finding, all_rules
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: Iterable[Finding]) -> dict:
+    findings = list(findings)
+    docs = {r.name: r.doc for r in all_rules()}
+    used = sorted({f.rule for f in findings})
+    rules = [{"id": name,
+              "shortDescription": {"text": docs.get(name, name)}}
+             for name in used]
+    index = {name: i for i, name in enumerate(used)}
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "note" if f.baselined else "error",
+            "message": {"text": f.message + (f"\nhint: {f.hint}"
+                                             if f.hint else "")},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, int(f.line))},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.context}],
+            }],
+            "partialFingerprints": {"graftlint/v1": f.fingerprint()},
+        }
+        if f.baselined:
+            res["suppressions"] = [{"kind": "external",
+                                    "justification": "baselined in "
+                                    "tools/graftlint_baseline.json"}]
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }

@@ -29,6 +29,17 @@ from collections import defaultdict
 from ..core.params import Param
 from ..core.pipeline import (Estimator, Model, Transformer, registered_stages)
 
+
+def _write_text(path: str, text: str) -> None:
+    """Every generated-artifact write goes through here: one chaos site
+    (``codegen.write``) covers full-disk / read-only-checkout failures
+    for all of docs/stubs/R/smoke generation."""
+    from ..resilience import faults
+    faults.inject("codegen.write")
+    with open(path, "w") as f:
+        f.write(text)
+
+
 _NO_DEFAULT_REPR = "(required)"
 
 
@@ -98,8 +109,7 @@ def generate_docs(out_dir: str) -> list[str]:
         module = qual.split(".")[1]  # mmlspark_tpu.<pkg>...
         by_module[module].append(cls)
         path = os.path.join(out_dir, f"{cls.__name__}.md")
-        with open(path, "w") as f:
-            f.write(stage_doc_markdown(cls))
+        _write_text(path, stage_doc_markdown(cls))
         paths.append(path)
     index = [
         "# API reference", "",
@@ -116,8 +126,7 @@ def generate_docs(out_dir: str) -> list[str]:
                          f"(*{_kind(cls)}*) — {first}")
         index.append("")
     path = os.path.join(out_dir, "index.md")
-    with open(path, "w") as f:
-        f.write("\n".join(index))
+    _write_text(path, "\n".join(index))
     paths.append(path)
     return paths
 
@@ -167,8 +176,7 @@ def generate_stubs(out_dir: str) -> list[str]:
         for cls in sorted(by_srcmod[mod], key=lambda c: c.__name__):
             chunks.append(stage_stub(cls))
             chunks.append("")
-        with open(path, "w") as f:
-            f.write("\n".join(chunks))
+        _write_text(path, "\n".join(chunks))
         paths.append(path)
     return paths
 
@@ -254,8 +262,7 @@ def generate_r_wrappers(out_path: str) -> str:
             continue  # fitted models come back from mt_fit, not constructors
         chunks.append(stage_r_wrapper(qual, cls))
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
-    with open(out_path, "w") as f:
-        f.write("\n".join(chunks))
+    _write_text(out_path, "\n".join(chunks))
     return out_path
 
 
@@ -294,8 +301,7 @@ def generate_smoke_tests(out_path: str) -> str:
         ]
     parent = os.path.dirname(os.path.abspath(out_path))
     os.makedirs(parent, exist_ok=True)
-    with open(out_path, "w") as f:
-        f.write("\n".join(lines))
+    _write_text(out_path, "\n".join(lines))
     return out_path
 
 

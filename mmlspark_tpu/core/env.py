@@ -68,6 +68,15 @@ def fault_spec() -> Optional[str]:
     return os.environ.get("MMLSPARK_TPU_FAULTS") or None
 
 
+def sanitize_mode() -> Optional[str]:
+    """MMLSPARK_TPU_SANITIZE=donation: arm the donation sanitizer
+    (mmlspark_tpu.analysis.sanitize) — donating dispatches poison their
+    host-aliased donated inputs after dispatch and trap re-reads. Test/
+    chaos-tier knob; unset (the default) costs nothing."""
+    v = os.environ.get("MMLSPARK_TPU_SANITIZE", "").strip().lower()
+    return v or None
+
+
 def fault_seed() -> int:
     """MMLSPARK_TPU_FAULTS_SEED=<int>: the base seed every fault site's
     RNG derives from (seed ^ crc32(site)) — reruns replay identically."""

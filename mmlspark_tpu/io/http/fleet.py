@@ -453,6 +453,10 @@ class ProcessHTTPSource:
             if not w.alive:
                 continue
             try:
+                # debug-plane round-trip: same chaos site as the /trace
+                # endpoint's server side — an injected fault skips this
+                # worker's trace, never fails collection
+                faults.inject("http.debug")
                 with urllib.request.urlopen(
                         f"http://{w.host}:{w.control}/trace",
                         timeout=5.0) as r:

@@ -200,6 +200,15 @@ class HTTPSource:
                     _m_replies.labels(code=str(ex.code)).inc()
 
             def do_GET(self):
+                # the observability surface gets its own chaos site: an
+                # injected fault answers 503 (probes and scrapers must
+                # tolerate a flapping debug plane without killing the
+                # worker) — see docs/reliability.md `http.debug`
+                try:
+                    faults.inject("http.debug")
+                except Exception:
+                    self.send_error(503, "injected debug-plane fault")
+                    return
                 # Prometheus scrape surface: every serving process (the
                 # single-process loop AND each fleet worker) answers
                 # GET /metrics with its own registry's exposition

@@ -57,6 +57,15 @@ class WorkerServer:
                 self.wfile.write(body)
 
             def do_GET(self):
+                # same chaos site as the public port's debug plane: the
+                # supervisor and scrapers must survive a flapping
+                # control-plane GET surface (injected faults answer 503)
+                from ...resilience import faults
+                try:
+                    faults.inject("http.debug")
+                except Exception:
+                    self.send_error(503, "injected debug-plane fault")
+                    return
                 if self.path == "/health":
                     self._json(200, {"ok": True,
                                      "port": worker.source.port})

@@ -102,6 +102,8 @@ def _fit_linear(x: np.ndarray, y: np.ndarray, num_out: int, objective: str,
         up, opt2 = tx.update(g, opt, params)
         return optax.apply_updates(params, up), opt2, l
 
+    from ..analysis import sanitize
+    step = sanitize.wrap_donated(step, (0, 1), label="classical.step")
     params = (W, b)
     for _ in range(max_iter):
         params, opt, l = step(params, opt)

@@ -161,6 +161,8 @@ class RemoteRepo(Repository):
         self.timeout = timeout
 
     def _fetch(self, rel: str) -> bytes:
+        from ..resilience import faults
+        faults.inject("downloader.fetch")
         if "://" not in rel:
             # metas carry repo-relative names; tolerate absolute local paths
             # from hand-written metas by falling back to the basename

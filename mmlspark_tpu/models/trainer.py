@@ -375,6 +375,7 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
     (losses exploding to ~1e35 on a fitStream that is bit-identical to
     fit() with donation off). Host memory is not the scarce resource on
     CPU, so nothing is lost."""
+    from ..analysis import sanitize
     cpu = jax.default_backend() == "cpu"
     # `mixed` is a host-side factory flag, static at build time (the
     # profiler.wrap discovery over-approximates this FACTORY as a traced
@@ -383,12 +384,15 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
         body = step_body or _make_mixed_step_body(
             module, tx, loss_fn, is_moe, moe_aux, grad_clip)
         donate = (0, 1, 2) if cpu else (0, 1, 2, 3, 4)
-        return jax.jit(body, donate_argnums=donate)
+        return sanitize.wrap_donated(jax.jit(body, donate_argnums=donate),
+                                     donate, label="trainer.step_mixed")
     donate = () if cpu else (2, 3)
-    return jax.jit(step_body or
-                   _make_step_body(module, tx, loss_fn, is_moe, moe_aux,
-                                   grad_clip),
-                   donate_argnums=donate)
+    return sanitize.wrap_donated(
+        jax.jit(step_body or
+                _make_step_body(module, tx, loss_fn, is_moe, moe_aux,
+                                grad_clip),
+                donate_argnums=donate),
+        donate, label="trainer.step")
 
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
@@ -447,7 +451,9 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
                 body, (params, opt_state, scale_state), starts)
             return params, opt_state, scale_state, losses[-1]
 
-        return run_epoch_mixed
+        from ..analysis import sanitize
+        return sanitize.wrap_donated(run_epoch_mixed, (0, 1, 2),
+                                     label="trainer.scan_epoch_mixed")
 
     step_body = step_body or _make_step_body(module, tx, loss_fn, is_moe,
                                              moe_aux, grad_clip)
@@ -465,7 +471,9 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
             body, (params, opt_state), starts)
         return params, opt_state, losses[-1]
 
-    return run_epoch
+    from ..analysis import sanitize
+    return sanitize.wrap_donated(run_epoch, (0, 1),
+                                 label="trainer.scan_epoch")
 
 
 class TpuLearner(Estimator):

@@ -439,6 +439,10 @@ class RendezvousCoordinator:
         if unwind_at is not None:
             doc["unwind_at"] = list(unwind_at)
         os.makedirs(self.directory, exist_ok=True)
+        # same commit discipline as checkpoints (fsync BEFORE the atomic
+        # rename — lint-enforced by protocol-rename-before-fsync): a
+        # torn rendezvous doc would strand relaunched processes on a
+        # generation that never existed
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)

@@ -33,6 +33,12 @@ file that is **not in the manifest** — and resume treats "exists but
 unverified" exactly like "corrupt": skip it, warn, count it on
 ``mmlspark_ckpt_corrupt_total``, and fall back to the previous
 checkpoint.  The consensus candidate is always a manifest-verified file.
+Both ordering invariants — fsync strictly before the publishing rename,
+manifest strictly after every payload/shard write — are enforced
+statically by graftlint's ``protocol-rename-before-fsync`` /
+``protocol-manifest-order`` rules (docs/static-analysis.md), so a
+refactor that reorders them fails CI instead of waiting for a power
+cut.
 
 **Sharded checkpoints** extend the same protocol to models too big for
 one host's msgpack: the training state (flattened to ``path -> leaf``)

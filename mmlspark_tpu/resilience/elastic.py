@@ -369,10 +369,15 @@ class HostHeartbeat:
         if joining:
             doc["joining"] = True
         # unique tmp per writer thread: set_joining publishes from the
-        # caller's thread while the beacon thread keeps beating
+        # caller's thread while the beacon thread keeps beating.
+        # No fsync before the rename ON PURPOSE: a heartbeat needs READ
+        # atomicity (rename gives it), not crash durability — a host
+        # that crashes SHOULD look dead, and an fsync per beat would
+        # hammer the shared filesystem the beacon must never stall on.
         tmp = f"{self.path}.tmp.{threading.get_ident()}"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(doc, f)
+        # graftlint: disable=protocol-rename-before-fsync
         os.replace(tmp, self.path)
 
     def _run(self):

@@ -30,11 +30,12 @@ are independent and the whole run is reproducible. Injection sites call
 
 Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``fleet.respond``, ``fleet.transform``, ``serving.transform``,
-``http.request``, ``powerbi.post``, ``dataplane.put``,
+``http.request``, ``http.debug``, ``powerbi.post``, ``dataplane.put``,
 ``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
 ``supervisor.heartbeat``, ``supervisor.rejoin``, ``elastic.step``,
 ``elastic.remesh``, ``elastic.evict``, ``distributed.rendezvous``,
-``ckpt.write``, ``ckpt.rename``, ``ckpt.shard``.
+``ckpt.write``, ``ckpt.rename``, ``ckpt.shard``, ``downloader.fetch``,
+``codegen.write``.
 """
 
 from __future__ import annotations
@@ -62,12 +63,13 @@ KINDS = ("error", "delay")
 #: :func:`configure` warns when a chaos spec names a site not listed
 #: here — a typo'd site would otherwise inject nothing, silently.
 SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
-         "serving.transform", "http.request", "powerbi.post",
-         "dataplane.put", "dataplane.allgather", "trainer.step",
-         "supervisor.probe", "supervisor.heartbeat", "supervisor.rejoin",
-         "elastic.step", "elastic.remesh", "elastic.evict",
-         "distributed.rendezvous", "ckpt.write", "ckpt.rename",
-         "ckpt.shard")
+         "serving.transform", "http.request", "http.debug",
+         "powerbi.post", "dataplane.put", "dataplane.allgather",
+         "trainer.step", "supervisor.probe", "supervisor.heartbeat",
+         "supervisor.rejoin", "elastic.step", "elastic.remesh",
+         "elastic.evict", "distributed.rendezvous", "ckpt.write",
+         "ckpt.rename", "ckpt.shard", "downloader.fetch",
+         "codegen.write")
 
 
 class InjectedFault(ConnectionError):

@@ -644,3 +644,679 @@ class TestRepoGate:
                                 rules=["codegen-sync"],
                                 options={"codegen": True})
         assert [f for f in findings if not f.baselined] == []
+
+
+# -------------------------------------------------------------- donation
+
+class TestDonation:
+    def test_pr7_arrow_fitstream_regression(self, tmp_path):
+        """The PR 7 bug, reconstructed: the fitStream step donates its
+        batch positions, and the batches are device_put numpy (zero-copy
+        aliased on the CPU backend). The rule must flag BOTH donated
+        batch args."""
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            step = jax.jit(_step_body, donate_argnums=(2, 3))
+
+            def fit_stream(params, opt_state, batches):
+                for rows in batches:
+                    xb = jax.device_put(np.asarray(rows[0]))
+                    yb = jax.device_put(np.asarray(rows[1]))
+                    params, opt_state, loss = step(params, opt_state,
+                                                   xb, yb)
+                return params
+        """, rules=["donation-host-alias"])
+        assert len(fs) == 2
+        assert all(f.rule == "donation-host-alias" for f in fs)
+        assert all("PR 7" in f.message for f in fs)
+
+    def test_pr7_clean_twin_jnp_batches(self, tmp_path):
+        """The in-tree fix shape: batches materialized through jnp (an
+        XLA-owned output) are donation-safe."""
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            step = jax.jit(_step_body, donate_argnums=(2, 3))
+
+            def fit_stream(params, opt_state, batches):
+                for rows in batches:
+                    xb = jnp.asarray(np.asarray(rows[0]))
+                    yb = jnp.asarray(np.asarray(rows[1]))
+                    params, opt_state, loss = step(params, opt_state,
+                                                   xb, yb)
+                return params
+        """, rules=["donation-host-alias"], name="clean.py")
+        assert fs == []
+
+    def test_pr9_post_resume_regression(self, tmp_path):
+        """The PR 9 bug, reconstructed: a checkpoint restore returns a
+        host-numpy tree and the donating mixed step consumes it
+        directly. The restore helper's host provenance crosses the
+        function boundary (interprocedural summary)."""
+        fs = lint(tmp_path, """
+            import jax
+            import numpy as np
+
+            mixed_step = jax.jit(_mixed_body, donate_argnums=(0, 1, 2))
+
+            def _restore_checkpoint(path):
+                blob = open(path, "rb").read()
+                return {"params": np.frombuffer(blob, np.float32),
+                        "opt": np.frombuffer(blob, np.float32)}
+
+            def resume_and_step(path, scale, xb, yb):
+                restored = _restore_checkpoint(path)
+                params, opt = restored["params"], restored["opt"]
+                params, opt, scale, loss = mixed_step(params, opt,
+                                                      scale, xb, yb)
+                return params
+        """, rules=["donation-host-alias"])
+        assert len(fs) >= 2        # params + opt positions
+        assert all(f.rule == "donation-host-alias" for f in fs)
+
+    def test_pr9_clean_twin_jitted_copy_materialization(self, tmp_path):
+        """The in-tree fix verbatim: restored state materialized through
+        a jitted copy before the donating dispatch — the sanitizer the
+        rule must honor."""
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+
+            mixed_step = jax.jit(_mixed_body, donate_argnums=(0, 1, 2))
+
+            def _restore_checkpoint(path):
+                blob = open(path, "rb").read()
+                return {"params": np.frombuffer(blob, np.float32),
+                        "opt": np.frombuffer(blob, np.float32)}
+
+            def resume_and_step(path, scale, xb, yb):
+                restored = _restore_checkpoint(path)
+                params, opt = restored["params"], restored["opt"]
+                params, opt = jax.jit(
+                    lambda t: jax.tree_util.tree_map(jnp.copy, t))(
+                        (params, opt))
+                params, opt, scale, loss = mixed_step(params, opt,
+                                                      scale, xb, yb)
+                return params
+        """, rules=["donation-host-alias"], name="clean.py")
+        assert fs == []
+
+    def test_use_after_donate_positive_and_rebind_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def consume(buf, x):
+                return buf + x
+
+            def bad(buf, x):
+                out = consume(buf, x)
+                return out + buf.sum()     # buf belongs to XLA now
+
+            def good(buf, x):
+                buf = consume(buf, x)      # rebound from the outputs
+                return buf.sum()
+        """, rules=["donation-use-after-donate"])
+        assert len(fs) == 1
+        assert fs[0].context == "bad"
+
+    def test_use_after_donate_across_loop_iterations(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+
+            step = jax.jit(_body, donate_argnums=(1,))
+
+            def train(params, xb, n):
+                for _ in range(n):
+                    params = step(params, xb)   # xb donated, reused
+                return params
+        """, rules=["donation-use-after-donate"])
+        assert rules_of(fs) == ["donation-use-after-donate"]
+
+    def test_device_put_of_jnp_is_clean(self, tmp_path):
+        # device_put only preserves HOST provenance; device-owned inputs
+        # stay clean
+        fs = lint(tmp_path, """
+            import jax
+            import jax.numpy as jnp
+
+            step = jax.jit(_body, donate_argnums=(0,))
+
+            def go(sharding):
+                x = jax.device_put(jnp.ones((4,)), sharding)
+                return step(x)
+        """, rules=["donation-host-alias"], name="clean2.py")
+        assert fs == []
+
+
+class TestDonationSanitizer:
+    """The runtime complement (MMLSPARK_TPU_SANITIZE=donation)."""
+
+    @pytest.fixture(autouse=True)
+    def _armed(self, monkeypatch):
+        from mmlspark_tpu.analysis import sanitize
+        monkeypatch.setenv("MMLSPARK_TPU_SANITIZE", "donation")
+        sanitize.clear()
+        yield
+        sanitize.clear()
+
+    def test_disarmed_returns_fn_unchanged(self, monkeypatch):
+        from mmlspark_tpu.analysis import sanitize
+        monkeypatch.delenv("MMLSPARK_TPU_SANITIZE", raising=False)
+
+        def fn(a):
+            return a
+        assert sanitize.wrap_donated(fn, (0,)) is fn
+
+    def test_pr9_bug_caught_dynamically_when_static_fix_reverted(self):
+        """A test-local copy of the resume flow WITHOUT the jitted-copy
+        materialization (the reverted PR 9 fix): the donating dispatch
+        receives raw host-numpy state. The sanitizer poisons the host
+        buffers after dispatch (deterministic sentinel instead of
+        nondeterministic corruption) and traps the re-dispatch."""
+        import jax
+        import numpy as np
+        from mmlspark_tpu.analysis import sanitize
+
+        step = sanitize.wrap_donated(
+            jax.jit(lambda p, o, x: (p + x, o + 1),
+                    donate_argnums=(0, 1)),
+            (0, 1), label="test.step")
+        # "restored checkpoint": host-numpy training state (the bug)
+        params = np.ones((8,), np.float32)
+        opt = np.zeros((8,), np.float32)
+        p2, o2 = step(params, opt, np.full((8,), 2.0, np.float32))
+        assert np.allclose(np.asarray(p2), 3.0)        # outputs correct
+        # the host-aliased donated inputs are now poisoned...
+        assert np.isnan(params).all() and np.isnan(opt).all()
+        # ...and feeding one back into a sanitized dispatch traps
+        with pytest.raises(sanitize.DonatedBufferReuse):
+            step(params, opt, np.zeros((8,), np.float32))
+
+    def test_fixed_resume_flow_stays_clean(self):
+        """With the PR 9 fix in place (jitted-copy materialization) the
+        donated state is XLA-owned — the sanitizer poisons nothing."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from mmlspark_tpu.analysis import sanitize
+
+        step = sanitize.wrap_donated(
+            jax.jit(lambda p, o, x: (p + x, o + 1),
+                    donate_argnums=(0, 1)),
+            (0, 1), label="test.step_fixed")
+        restored = (np.ones((8,), np.float32), np.zeros((8,), np.float32))
+        params, opt = jax.jit(
+            lambda t: jax.tree_util.tree_map(jnp.copy, t))(restored)
+        p2, o2 = step(params, opt, np.full((8,), 2.0, np.float32))
+        assert np.allclose(np.asarray(p2), 3.0)
+        assert np.all(restored[0] == 1.0)     # originals untouched
+        p3, o3 = step(p2, o2, np.zeros((8,), np.float32))   # no trap
+        assert np.allclose(np.asarray(p3), 3.0)
+
+    def test_poisoned_reads_counter(self):
+        import jax
+        import numpy as np
+        from mmlspark_tpu import telemetry
+        from mmlspark_tpu.analysis import sanitize
+
+        telemetry.enable()
+        try:
+            telemetry.registry.reset()
+            step = sanitize.wrap_donated(
+                jax.jit(lambda p: p * 2, donate_argnums=(0,)),
+                (0,), label="test.counter")
+            buf = np.ones((4,), np.float32)
+            step(buf)
+            with pytest.raises(sanitize.DonatedBufferReuse):
+                step(buf)
+            text = telemetry.prometheus_text()
+            assert "mmlspark_sanitizer_poisoned_reads_total 1" in text
+            assert "mmlspark_sanitizer_poisoned_buffers_total 1" in text
+        finally:
+            telemetry.disable()
+
+
+# -------------------------------------------------------------- protocol
+
+class TestProtocol:
+    def test_collective_axis_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def build(mesh):
+                def body(x):
+                    return lax.psum(x, "model")   # mesh only has data
+                return shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))
+        """, rules=["protocol-collective-axis"])
+        assert rules_of(fs) == ["protocol-collective-axis"]
+        clean = lint(tmp_path, """
+            import jax
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+            from jax.experimental.shard_map import shard_map
+
+            def build(mesh, axis_name):
+                def body(x):
+                    y = lax.psum(x, "data")       # declared literal
+                    return lax.psum(y, axis_name)  # variable: runtime
+                return shard_map(body, mesh=mesh, in_specs=P("data"),
+                                 out_specs=P("data"))
+        """, rules=["protocol-collective-axis"], name="clean.py")
+        assert clean == []
+
+    def test_divergent_collective_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def sync(x, grads):
+                if jax.process_index() == 0:
+                    grads = lax.psum(grads, "data")   # rank-divergent
+                return grads
+        """, rules=["protocol-divergent-collective"])
+        assert rules_of(fs) == ["protocol-divergent-collective"]
+        clean = lint(tmp_path, """
+            import jax
+            from jax import lax
+
+            def sync(x, grads, nproc):
+                if nproc > 1:          # uniform across ranks
+                    grads = lax.psum(grads, "data")
+                if jax.process_index() == 0:
+                    write_log(grads)   # not a collective: fine
+                return grads
+        """, rules=["protocol-divergent-collective"], name="clean.py")
+        assert clean == []
+
+    def test_attempt_thread_blocking_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+            import time
+
+            def run_attempt(fn):
+                def body():
+                    time.sleep(30)        # wedges the watcher bound
+                    fn()
+                t = threading.Thread(target=body, daemon=True,
+                                     name="elastic-attempt")
+                t.start()
+        """, rules=["protocol-attempt-thread-blocking"])
+        assert rules_of(fs) == ["protocol-attempt-thread-blocking"]
+        clean = lint(tmp_path, """
+            import threading
+            import time
+
+            def run_attempt(fn):
+                def body():
+                    fn()                  # dynamic work only
+                t = threading.Thread(target=body, daemon=True,
+                                     name="elastic-attempt")
+                t.start()
+
+            def beacon_loop(stop):
+                while not stop.is_set():
+                    time.sleep(0.5)       # not an attempt thread
+
+            _t = threading.Thread(target=beacon_loop, name="heartbeat-x")
+        """, rules=["protocol-attempt-thread-blocking"], name="clean.py")
+        assert clean == []
+
+    def test_rename_before_fsync_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import json
+            import os
+
+            def publish_doc(path, doc):
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                os.replace(tmp, path)     # page cache may still hold it
+        """, rules=["protocol-rename-before-fsync"])
+        assert rules_of(fs) == ["protocol-rename-before-fsync"]
+        # the rendezvous.json ordering pinned: distributed.py's propose()
+        # shape (fsync BEFORE the rename) must stay clean
+        clean = lint(tmp_path, """
+            import json
+            import os
+
+            def propose(path, doc):
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(doc, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+        """, rules=["protocol-rename-before-fsync"], name="clean.py")
+        assert clean == []
+
+    def test_repo_rendezvous_write_is_fsync_then_rename(self):
+        """Pin the verified distributed.py ordering in-tree: the
+        rendezvous doc commit fsyncs before its atomic rename (the
+        satellite asked for the ordering to be verified and pinned)."""
+        findings = run_analysis(
+            [os.path.join(PKG, "parallel", "distributed.py")],
+            root=REPO, rules=["protocol-rename-before-fsync",
+                              "protocol-manifest-order"])
+        assert findings == []
+
+    def test_manifest_order_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            import os
+
+            def save(path, shards):
+                _commit_manifest(os.path.dirname(path), {})   # too early
+                for i, blob in enumerate(shards):
+                    write_shard(f"{path}.shard_{i}", blob)
+        """, rules=["protocol-manifest-order"])
+        assert rules_of(fs) == ["protocol-manifest-order"]
+        clean = lint(tmp_path, """
+            import os
+
+            def save(path, shards):
+                for i, blob in enumerate(shards):
+                    write_shard(f"{path}.shard_{i}", blob)
+                _commit_manifest(os.path.dirname(path), {})   # LAST
+        """, rules=["protocol-manifest-order"], name="clean.py")
+        assert clean == []
+
+
+# -------------------------------------------------------- chaos coverage
+
+class TestChaosCoverage:
+    def _project(self, tmp_path, test_text, user_text):
+        (tmp_path / "faults.py").write_text(textwrap.dedent("""
+            SITES = ("alpha.one", "beta.two")
+
+            def inject(site):
+                pass
+        """))
+        (tmp_path / "user.py").write_text(textwrap.dedent(user_text))
+        tests = tmp_path / "tests"
+        tests.mkdir()
+        (tests / "test_x.py").write_text(textwrap.dedent(test_text))
+        return run_analysis(
+            [str(tmp_path / "faults.py"), str(tmp_path / "user.py")],
+            root=str(tmp_path),
+            rules=["chaos-test-coverage"],
+            options={"tests_dir": str(tests)})
+
+    def test_unexercised_site_flagged(self, tmp_path):
+        fs = self._project(tmp_path, """
+            def test_alpha():
+                configure("alpha.one:error:1.0")
+        """, """
+            from resilience import faults
+
+            def go():
+                faults.inject("alpha.one")
+                faults.inject("beta.two")
+        """)
+        msgs = "\\n".join(f.message for f in fs)
+        assert "beta.two" in msgs and "alpha.one" not in msgs
+        assert len(fs) == 1
+
+    def test_retry_path_positive_and_clean(self, tmp_path):
+        fs = lint(tmp_path, """
+            from resilience.policy import RetryPolicy
+
+            _retry = RetryPolicy(name="orphan.io", max_attempts=3)
+
+            def fetch(url):
+                return _retry.run(lambda _a: _do(url))
+        """, rules=["chaos-retry-path"])
+        assert rules_of(fs) == ["chaos-retry-path"]
+        clean = lint(tmp_path, """
+            from resilience import faults
+            from resilience.policy import RetryPolicy
+
+            _retry = RetryPolicy(name="covered.io", max_attempts=3)
+
+            def fetch(url):
+                faults.inject("covered.io")
+                return _retry.run(lambda _a: _do(url))
+        """, rules=["chaos-retry-path"], name="clean.py")
+        assert clean == []
+
+    def test_io_site_handler_and_network(self, tmp_path):
+        fs = lint(tmp_path, """
+            import urllib.request
+            from http.server import BaseHTTPRequestHandler
+
+            class Debug(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    self.wfile.write(b"{}")
+
+            class Client:
+                def fetch(self, url):
+                    with urllib.request.urlopen(url) as r:
+                        return r.read()
+        """, rules=["chaos-io-site"])
+        assert len(fs) == 2
+        assert {f.context for f in fs} == {"Debug.do_GET", "Client"}
+        clean = lint(tmp_path, """
+            import urllib.request
+            from http.server import BaseHTTPRequestHandler
+            from resilience import faults
+
+            class Debug(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    faults.inject("http.debug")
+                    self.wfile.write(b"{}")
+
+            class Client:
+                def fetch(self, url):
+                    faults.inject("client.fetch")
+                    with urllib.request.urlopen(url) as r:
+                        return r.read()
+        """, rules=["chaos-io-site"], name="clean.py")
+        assert clean == []
+
+
+# ------------------------------------------------------ sarif + incremental
+
+class TestSarif:
+    def test_sarif_schema_shape(self, tmp_path, capsys):
+        """--sarif OUT writes a SARIF 2.1.0 log whose results point at
+        real file/line locations and whose driver.rules cover every
+        ruleId used."""
+        src = tmp_path / "mod.py"
+        src.write_text(textwrap.dedent("""
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def a(self):
+                    with self._lock:
+                        time.sleep(1)
+        """))
+        out = tmp_path / "findings.sarif"
+        rc = graftlint_main([str(src), "--rules", "lock-blocking-call",
+                             "--sarif", str(out), "--format", "json"])
+        capsys.readouterr()
+        assert rc == 1
+        doc = json.loads(out.read_text())
+        assert doc["version"] == "2.1.0"
+        assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "graftlint"
+        rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        assert rule_ids == {"lock-blocking-call"}
+        res = run["results"][0]
+        assert res["ruleId"] == "lock-blocking-call"
+        assert res["level"] == "error"
+        assert res["message"]["text"]
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("mod.py")
+        assert loc["region"]["startLine"] >= 1
+        assert "graftlint/v1" in res["partialFingerprints"]
+
+    def test_baselined_findings_are_suppressed_notes(self, tmp_path):
+        from mmlspark_tpu.analysis.sarif import to_sarif
+        from mmlspark_tpu.analysis.core import Finding
+        f = Finding(rule="lock-blocking-call", path="a.py", line=3,
+                    message="m", baselined=True)
+        doc = to_sarif([f])
+        res = doc["runs"][0]["results"][0]
+        assert res["level"] == "note"
+        assert res["suppressions"][0]["kind"] == "external"
+
+
+class TestIncremental:
+    SRC = """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def a(self):
+                with self._lock:
+                    time.sleep(1)
+    """
+
+    def _run(self, tmp_path, **kw):
+        from mmlspark_tpu.analysis.incremental import run_changed_only
+        return run_changed_only(
+            [str(tmp_path / "proj")], root=str(tmp_path / "proj"),
+            rules=["lock-blocking-call", "chaos-test-coverage"],
+            cache_path=str(tmp_path / "cache.json"), **kw)
+
+    def test_unchanged_tree_is_zero_reanalysis(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "mod.py").write_text(textwrap.dedent(self.SRC))
+        fs1, stats1 = self._run(tmp_path)
+        assert stats1["analyzed_files"] == 1
+        assert stats1["cache_hit"] is False
+        assert rules_of(fs1) == ["lock-blocking-call"]
+        # second run, nothing changed: pure cache hit — NO rule runs
+        fs2, stats2 = self._run(tmp_path)
+        assert stats2["analyzed_files"] == 0
+        assert stats2["project_rules_run"] is False
+        assert stats2["cache_hit"] is True
+        assert [f.fingerprint() for f in fs2] == \
+            [f.fingerprint() for f in fs1]
+        assert fs2[0].line == fs1[0].line
+
+    def test_changed_file_reanalyzed_unchanged_reused(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "mod.py").write_text(textwrap.dedent(self.SRC))
+        (proj / "other.py").write_text("x = 1\n")
+        self._run(tmp_path)
+        (proj / "other.py").write_text("x = 2\n")
+        fs, stats = self._run(tmp_path)
+        assert stats["analyzed_files"] == 1     # other.py only
+        assert stats["reused_files"] == 1       # mod.py from cache
+        assert rules_of(fs) == ["lock-blocking-call"]
+
+    def test_cli_changed_only_reports_stats(self, tmp_path, capsys):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "mod.py").write_text("x = 1\n")
+        args = [str(proj), "--changed-only", "--cache",
+                str(tmp_path / "c.json"), "--format", "json"]
+        assert graftlint_main(args) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["incremental"]["analyzed_files"] == 1
+        assert graftlint_main(args) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["incremental"]["cache_hit"] is True
+
+
+class TestExpandedGate:
+    def test_all_families_registered(self):
+        """The graftlint-gate contract: the expanded rule set (donation,
+        protocol, chaos-coverage) is part of every default run — tier-1's
+        repo gate enforces them the moment they register."""
+        from mmlspark_tpu.analysis import all_rules
+        families = {r.family for r in all_rules()}
+        assert {"jit-safety", "concurrency", "consistency", "donation",
+                "protocol"} <= families
+        names = {r.name for r in all_rules()}
+        assert {"donation-host-alias", "donation-use-after-donate",
+                "protocol-collective-axis",
+                "protocol-divergent-collective",
+                "protocol-attempt-thread-blocking",
+                "protocol-rename-before-fsync", "protocol-manifest-order",
+                "chaos-test-coverage", "chaos-retry-path",
+                "chaos-io-site"} <= names
+
+    def test_graftlint_gate_cli_clean(self, tmp_path, capsys):
+        """tools/bin/graftlint semantics (the CI gate invocation): the
+        whole package through every family, exit 0, zero new findings,
+        and a SARIF artifact for CI ingestion."""
+        out = tmp_path / "gate.sarif"
+        rc = graftlint_main(["--no-codegen", "--sarif", str(out),
+                             "--format", "json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["new"] == 0
+        sarif = json.loads(out.read_text())
+        assert sarif["runs"][0]["tool"]["driver"]["name"] == "graftlint"
+
+
+class TestSanitizerTrainerIntegration:
+    """The armed sanitizer over the REAL (fixed) trainer: a normal fit
+    plus a checkpoint resume must poison nothing — the in-tree jitted-
+    copy materialization keeps every donated buffer XLA-owned. If a
+    host-aliased donation path is ever reintroduced, this test fails
+    with sentinel NaNs or DonatedBufferReuse instead of a flaky loss."""
+
+    def test_fit_and_resume_clean_under_sanitizer(self, tmp_path,
+                                                  monkeypatch):
+        import numpy as np
+        from mmlspark_tpu import telemetry
+        from mmlspark_tpu.core.dataframe import DataFrame
+        from mmlspark_tpu.core.utils import object_column
+        from mmlspark_tpu.models.trainer import TpuLearner
+        from mmlspark_tpu.analysis import sanitize
+
+        monkeypatch.setenv("MMLSPARK_TPU_SANITIZE", "donation")
+        sanitize.clear()
+        telemetry.enable()
+        try:
+            telemetry.registry.reset()
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(32, 4)).astype(np.float32)
+            y = (x[:, 0] > 0).astype(np.int64)
+            df = DataFrame({"features": object_column([r for r in x]),
+                            "label": y})
+            ck = str(tmp_path / "ck")
+
+            def learner():
+                return (TpuLearner()
+                        .setModelConfig({"type": "mlp", "hidden": [4],
+                                         "num_classes": 2})
+                        .setEpochs(1).setBatchSize(8)
+                        .setLearningRate(0.05)
+                        .setDeviceDataCap(1)   # per-step feed path
+                        .setCheckpointDir(ck)
+                        .setCheckpointEverySteps(2))
+
+            model = learner().fit(df)
+            assert np.isfinite(model._final_loss)
+            # resume path: restored host state must be materialized
+            # through the jitted copy before any donating dispatch
+            model2 = learner().setEpochs(2).fit(df)
+            assert np.isfinite(model2._final_loss)
+            text = telemetry.prometheus_text()
+            assert "mmlspark_sanitizer_poisoned_buffers_total 0" in text
+            assert "mmlspark_sanitizer_poisoned_reads_total 0" in text
+        finally:
+            telemetry.disable()
+            sanitize.clear()
