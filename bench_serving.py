@@ -7,6 +7,24 @@ throughput for a ResNet-20 scorer behind `serve_pipeline`, with uint8 image
 payloads (the wire format TpuModel.transferDtype optimizes). Prints one
 JSON line per load level; the last line is the headline.
 
+``--open-loop`` runs the PRODUCTION-SHAPED benchmark instead: an
+open-loop arrival process (Poisson, or bursty on/off — requests arrive on
+the schedule whether or not earlier ones finished, unlike the closed loop
+above whose clients self-throttle) drives BOTH serving engines over the
+same model and schedule:
+
+  * ``polling``    — the seed's micro-batch loop (`serve_pipeline`:
+                     getBatch drains whatever arrived, per-row f32 host
+                     decode);
+  * ``continuous`` — the shape-bucket continuous-batching engine
+                     (`io/serving`: max-wait bucket formation, fused
+                     decode->pad->pjit->unpad step, AOT-warm buckets);
+
+and reports **goodput** (200-replies within the deadline per second) and
+p50/p99/p999 latency under saturation. The last line is one
+``mmlspark-bench/v1`` document, so the perf gate records
+`serving_open_loop_*` as first-round metrics and gates them thereafter.
+
 ``--chaos`` runs the resilience scenario instead: the PROCESS fleet
 (`serve_fleet` + FleetSupervisor) under a 10% injected `fleet.poll` error
 rate plus one mid-run worker kill. Clients post through a RetryPolicy (the
@@ -32,13 +50,14 @@ class _ImageScorer:
     thread decodes the NEXT micro-batch while the current one runs on
     device."""
 
-    def __init__(self):
+    def __init__(self, cfg=None, params=None):
         import jax
         from mmlspark_tpu.models import TpuModel, build_model
-        cfg = {"type": "resnet", "num_classes": 10}
+        cfg = cfg or {"type": "resnet", "num_classes": 10}
         module = build_model(cfg)
-        params = module.init(jax.random.PRNGKey(0),
-                             np.zeros((1, 32, 32, 3), np.float32))
+        if params is None:
+            params = module.init(jax.random.PRNGKey(0),
+                                 np.zeros((1, 32, 32, 3), np.float32))
         self.model = (TpuModel().setModelConfig(cfg).setModelParams(params)
                       .setInputCol("features").setTransferDtype("bfloat16")
                       .setInputShape((3, 32, 32)))
@@ -197,6 +216,198 @@ def chaos_main(fault_rate: float = 0.1, clients: int = 8,
         telemetry.disable()
 
 
+def arrival_times(process: str, rate: float, duration: float,
+                  seed: int = 0, burst_duty: float = 0.25,
+                  burst_period: float = 1.0) -> np.ndarray:
+    """Open-loop arrival schedule (seconds from t0).
+
+    ``poisson``: exponential inter-arrivals at ``rate``/s. ``bursty``:
+    the same MEAN rate delivered as on/off square-wave bursts —
+    ``burst_duty`` of each ``burst_period`` carries Poisson arrivals at
+    ``rate / burst_duty`` (4x the mean by default), the rest is silent;
+    the tail-latency scenario continuous batching + admission control
+    exist for. Deterministic per (process, rate, duration, seed)."""
+    rng = np.random.default_rng(seed)
+    out = []
+    if process == "poisson":
+        t = rng.exponential(1.0 / rate)
+        while t < duration:
+            out.append(t)
+            t += rng.exponential(1.0 / rate)
+    elif process == "bursty":
+        on_rate = rate / burst_duty
+        k = 0
+        while k * burst_period < duration:
+            t = k * burst_period + rng.exponential(1.0 / on_rate)
+            stop = min(k * burst_period + burst_duty * burst_period,
+                       duration)
+            while t < stop:
+                out.append(t)
+                t += rng.exponential(1.0 / on_rate)
+            k += 1
+    else:
+        raise ValueError(f"arrival process must be poisson|bursty, "
+                         f"got {process!r}")
+    return np.asarray(out)
+
+
+def run_open_loop(url: str, payload: bytes, schedule: np.ndarray,
+                  deadline: float = 1.0, pool: int = 64) -> dict:
+    """Drive one serving URL with an open-loop schedule from a bounded
+    client pool; returns goodput + latency percentiles + failure
+    taxonomy. A reply counts toward GOODPUT only when it is a 200 within
+    ``deadline`` of its scheduled arrival; 503 sheds, late replies,
+    errors, and timeouts all count offered-but-not-good. When every pool
+    client is busy the schedule slips (recorded as ``slipped`` — the
+    practical bound on offered concurrency)."""
+    import urllib.error
+    import urllib.request
+
+    idx = {"i": 0}
+    lock = threading.Lock()
+    lat: list = []        # good-reply latencies (from scheduled arrival)
+    counts = {"good": 0, "shed": 0, "late": 0, "error": 0, "slipped": 0}
+
+    def client():
+        while True:
+            with lock:
+                i = idx["i"]
+                if i >= len(schedule):
+                    return
+                idx["i"] = i + 1
+            target = t0 + schedule[i]
+            now = time.perf_counter()
+            if now < target:
+                time.sleep(target - now)
+            elif now - target > 0.001:
+                with lock:
+                    counts["slipped"] += 1
+            try:
+                req = urllib.request.Request(url, data=payload)
+                with urllib.request.urlopen(req, timeout=deadline) as r:
+                    ok = r.status == 200
+                    r.read()
+            except urllib.error.HTTPError as e:
+                with lock:
+                    counts["shed" if e.code == 503 else "error"] += 1
+                continue
+            except Exception:
+                with lock:
+                    counts["error"] += 1
+                continue
+            dt = time.perf_counter() - target
+            with lock:
+                if ok and dt <= deadline:
+                    counts["good"] += 1
+                    lat.append(dt)
+                else:
+                    counts["late" if ok else "error"] += 1
+
+    threads = [threading.Thread(target=client) for _ in range(pool)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    lat_ms = np.sort(np.asarray(lat)) * 1e3 if lat else np.array([0.0])
+    return {
+        "offered": len(schedule),
+        "offered_rps": round(len(schedule) / wall, 1),
+        "goodput_rps": round(counts["good"] / wall, 1),
+        "good": counts["good"], "shed": counts["shed"],
+        "late": counts["late"], "errors": counts["error"],
+        "slipped": counts["slipped"],
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
+        "p999_ms": round(float(np.percentile(lat_ms, 99.9)), 1),
+        "wall_s": round(wall, 2),
+    }
+
+
+def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
+                   deadline: float = 1.0, pool: int = 64,
+                   smoke: bool = False, max_batch: int = 256,
+                   max_wait: float = 0.005, max_queue_depth: int = 1024,
+                   engines=("polling", "continuous")):
+    """The production-shaped comparison: same model, same payloads, same
+    open-loop schedule against the polling loop and the continuous-
+    batching engine; prints one JSON line per engine and the
+    mmlspark-bench/v1 document last."""
+    import jax
+    from mmlspark_tpu.io.http import serve_pipeline
+    from mmlspark_tpu.io.serving import (BucketPolicy, FusedServingStep,
+                                         serve_continuous)
+    from mmlspark_tpu.models import build_model
+
+    cfg = ({"type": "convnet", "channels": (4, 4), "dense": 16,
+            "num_classes": 10} if smoke
+           else {"type": "resnet", "num_classes": 10})
+    module = build_model(cfg)
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 32, 32, 3), np.float32))
+    rng = np.random.default_rng(0)
+    payload = base64.b64encode(
+        rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
+    schedule = arrival_times(arrival, rate, duration)
+    results: dict = {}
+
+    if "polling" in engines:
+        scorer = _ImageScorer(cfg, params)   # warmup() precompiles
+        source, loop = serve_pipeline(scorer, max_batch=max_batch,
+                                      prepare=scorer.prepare,
+                                      max_queue_depth=max_queue_depth)
+        try:
+            results["polling"] = run_open_loop(source.url, payload,
+                                               schedule, deadline, pool)
+        finally:
+            loop.stop()
+            source.close()
+        print(json.dumps({"engine": "polling", "arrival": arrival,
+                          "rate": rate, **results["polling"]}))
+
+    if "continuous" in engines:
+        step = FusedServingStep(cfg, params,
+                                policy=BucketPolicy(max_batch=max_batch),
+                                row_shape=(32, 32, 3),
+                                in_dtype=np.uint8, output="argmax")
+        source, loop = serve_continuous(step, max_wait=max_wait,
+                                        max_queue_depth=max_queue_depth)
+        try:
+            results["continuous"] = run_open_loop(source.url, payload,
+                                                  schedule, deadline,
+                                                  pool)
+        finally:
+            loop.stop()
+            source.close()
+        print(json.dumps({"engine": "continuous", "arrival": arrival,
+                          "rate": rate, **results["continuous"]}))
+
+    metrics = []
+    cont = results.get("continuous")
+    poll = results.get("polling")
+    if cont:
+        extra = {}
+        if poll and poll["goodput_rps"]:
+            extra["vs_polling"] = round(
+                cont["goodput_rps"] / poll["goodput_rps"], 2)
+        metrics.append({"metric": "serving_open_loop_goodput_rps",
+                        "value": cont["goodput_rps"], "unit": "req/s",
+                        "arrival": arrival, "rate": rate, **extra})
+        for q in ("p50", "p99", "p999"):
+            metrics.append({"metric": f"serving_open_loop_{q}_ms",
+                            "value": cont[f"{q}_ms"], "unit": "ms",
+                            "arrival": arrival, "rate": rate})
+    if poll:
+        metrics.append({"metric": "serving_open_loop_polling_goodput_rps",
+                        "value": poll["goodput_rps"], "unit": "req/s",
+                        "arrival": arrival, "rate": rate})
+    doc = {"schema": "mmlspark-bench/v1", "bench": "serving_open_loop",
+           "backend": jax.default_backend(), "metrics": metrics}
+    print(json.dumps(doc))
+    return doc
+
+
 def main():
     import requests
     from mmlspark_tpu.io.http import serve_pipeline
@@ -276,8 +487,39 @@ if __name__ == "__main__":
                          "merges every hop into serving_trace.jsonl "
                          "(one trace_id per request; combine with "
                          "--chaos for the fault-injected run)")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="open-loop arrival benchmark: polling loop vs "
+                         "continuous-batching engine over the same "
+                         "Poisson/bursty schedule; reports goodput + "
+                         "p50/p99/p999 and emits an mmlspark-bench/v1 "
+                         "doc for the perf gate")
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="open-loop mean arrival rate (req/s)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="open-loop schedule length (s)")
+    ap.add_argument("--arrival", default="poisson",
+                    choices=("poisson", "bursty"))
+    ap.add_argument("--deadline-ms", type=float, default=1000.0,
+                    help="goodput SLO: a reply counts only if it is a "
+                         "200 within this many ms of its scheduled "
+                         "arrival")
+    ap.add_argument("--pool", type=int, default=64,
+                    help="open-loop client pool size (the offered-"
+                         "concurrency bound)")
+    ap.add_argument("--max-wait", type=float, default=0.005,
+                    help="continuous batcher max-wait deadline (s)")
+    ap.add_argument("--max-batch", type=int, default=256)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny convnet + short schedule (CPU CI "
+                         "validation of the open-loop harness)")
     args = ap.parse_args()
-    if args.chaos or args.trace:
+    if args.open_loop:
+        open_loop_main(rate=args.rate, duration=args.duration,
+                       arrival=args.arrival,
+                       deadline=args.deadline_ms / 1e3, pool=args.pool,
+                       smoke=args.smoke, max_batch=args.max_batch,
+                       max_wait=args.max_wait)
+    elif args.chaos or args.trace:
         chaos_main(fault_rate=args.fault_rate if args.chaos else 0.0,
                    clients=args.clients, per_client=args.per_client,
                    trace=args.trace)

@@ -1,7 +1,7 @@
 """IO layer (reference: src/io). `readImages`/`readBinaryFiles` mirror the
 reference's session implicits (io/src/main/scala/Readers.scala:14-45)."""
 
-from . import arrow, binary, csv, http, image, loader, powerbi
+from . import arrow, binary, csv, http, image, loader, powerbi, serving
 from .arrow import (arrow_feature_batches, arrow_frames,
                     batch_to_matrix, frame_from_arrow_stream)
 from .binary import read_binary_files, recurse_path
@@ -13,6 +13,7 @@ readImages = read_images
 readBinaryFiles = read_binary_files
 
 __all__ = ["binary", "csv", "http", "image", "loader", "powerbi",
+           "serving",
            "read_binary_files", "read_images", "write_images",
            "decode_image", "recurse_path", "read_csv", "read_csv_matrix",
            "image_batches", "device_image_batches", "list_images",

@@ -70,10 +70,16 @@ class _Worker:
     SPAWN_TIMEOUT = 30.0
 
     def __init__(self, host: str, port: int, control_port: int,
-                 spawn: bool = True, max_queue_depth: int = 0):
+                 spawn: bool = True, max_queue_depth: int = 0,
+                 extra_argv: tuple = ()):
         self.host = host
         self.alive = True
         self.proc = None
+        # preserved across supervisor restarts: a respawned worker must
+        # come back with the same serving flags (e.g. --bundle DIR, so
+        # the fresh incarnation loads its AOT executables and answers
+        # its first request warm)
+        self.extra_argv = tuple(extra_argv)
         self.pending_ack: list[str] = []   # ids appended, not yet acked
         self.last_trace: dict = {}   # id -> traceparent from the last poll
         if spawn:
@@ -83,7 +89,8 @@ class _Worker:
                 [sys.executable, "-m", "mmlspark_tpu.io.http.worker",
                  "--host", host, "--port", str(port),
                  "--control-port", str(control_port),
-                 "--max-queue-depth", str(max_queue_depth)],
+                 "--max-queue-depth", str(max_queue_depth),
+                 *self.extra_argv],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 text=True)
             # bounded startup: a child that dies (or hangs) before printing

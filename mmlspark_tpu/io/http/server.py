@@ -298,16 +298,22 @@ class HTTPSource:
             out["elastic"] = fleet
         return out
 
-    def getBatch(self, max_rows: int = 1024,
-                 timeout: float = 0.05) -> DataFrame:
-        """Drain up to max_rows pending requests into an (id, value) frame."""
-        rows = []
+    def drain(self, max_rows: int = 1024, timeout: float = 0.05,
+              wait_first: bool = True) -> list:
+        """Drain up to ``max_rows`` LIVE pending exchanges (dead ones —
+        clients whose wait timed out — are discarded). Returns the raw
+        :class:`_Exchange` handles: the continuous batcher needs arrival
+        timestamps (``t0_ns``) for its max-wait deadline and responds by
+        id later. ``wait_first=False`` makes an empty queue return
+        immediately (top-up polls while a batch is forming)."""
+        rows: list[_Exchange] = []
         deadline = time.monotonic() + timeout
         try:
             while len(rows) < max_rows:
                 # deadline-bounded: discarding dead exchanges must not restart
                 # the clock, or repeated client timeouts stall this unboundedly
-                wait = max(0.0, deadline - time.monotonic()) if not rows else 0
+                wait = (max(0.0, deadline - time.monotonic())
+                        if wait_first and not rows else 0)
                 ex = self._pending.get(timeout=wait)
                 # a client whose wait timed out was dropped from _inflight;
                 # its exchange is dead — don't hand it to the pipeline
@@ -323,6 +329,12 @@ class HTTPSource:
             pass
         with self._lock:
             _m_queue_depth.set(self._n_pending)
+        return rows
+
+    def getBatch(self, max_rows: int = 1024,
+                 timeout: float = 0.05) -> DataFrame:
+        """Drain up to max_rows pending requests into an (id, value) frame."""
+        rows = self.drain(max_rows, timeout)
         if not rows:
             return DataFrame({"id": np.array([], dtype=object),
                               "value": np.array([], dtype=object)})

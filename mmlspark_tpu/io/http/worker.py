@@ -36,12 +36,28 @@ class WorkerServer:
     The poll handoff is AT-LEAST-ONCE: drained exchanges stay in an
     ``unacked`` buffer until the driver's next poll acknowledges their ids,
     so a poll response lost in transit re-delivers the same rows instead of
-    stranding their clients (a drain-and-forget handoff would drop them)."""
+    stranding their clients (a drain-and-forget handoff would drop them).
+
+    ``bundle`` turns the worker SELF-SERVING: instead of parking rows
+    for a driver's ``/poll`` loop, the worker loads the model+executable
+    bundle (io/serving/bundle.py) at startup and runs its own
+    continuous-batching loop — every shape bucket's compiled executable
+    deserializes from the bundle, so a supervisor-restarted worker
+    answers its first request WARM (zero live-traffic compiles; the
+    recompile counters on ``GET /metrics`` prove it)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 control_port: int = 0, max_queue_depth: int = 0):
+                 control_port: int = 0, max_queue_depth: int = 0,
+                 bundle: str = None, max_wait: float = 0.01):
         self.source = HTTPSource(host=host, port=port, name="worker",
                                  max_queue_depth=max_queue_depth)
+        self.serving = None
+        self.step = None
+        if bundle:
+            from ..serving import ContinuousServingLoop, load_bundle
+            self.step = load_bundle(bundle)
+            self.serving = ContinuousServingLoop(
+                self.source, self.step, max_wait=max_wait).start()
         self._unacked: dict[str, str] = {}   # id -> value, insertion order
         self._lock = threading.Lock()
         worker = self
@@ -77,6 +93,14 @@ class WorkerServer:
                     with worker._lock:
                         h["unacked"] = len(worker._unacked)
                     h["port"] = worker.source.port
+                    if worker.step is not None:
+                        # the warm-start surface: which buckets answer
+                        # without a compile, and how many compiles this
+                        # incarnation has paid
+                        h["serving"] = {
+                            "warm_buckets": worker.step.warm_buckets(),
+                            "buckets": worker.step.policy.buckets,
+                            "compiles": worker.step.compiles()}
                     self._json(200, h)
                 elif self.path == "/metrics":
                     # same exposition as the public port's GET /metrics, so
@@ -168,6 +192,8 @@ class WorkerServer:
         self._thread.start()
 
     def close(self):
+        if self.serving is not None:
+            self.serving.stop()
         self.source.close()
         self.control.shutdown()
         self.control.server_close()
@@ -182,9 +208,18 @@ def main(argv=None) -> int:
     ap.add_argument("--max-queue-depth", type=int, default=0,
                     help="load-shed (503 + Retry-After) past this many "
                          "queued requests; 0 = unbounded")
+    ap.add_argument("--bundle", default=None,
+                    help="serving-bundle directory: load the model + "
+                         "per-bucket AOT executables and serve locally "
+                         "with the continuous-batching engine (warm "
+                         "restart — no live-traffic compiles)")
+    ap.add_argument("--max-wait", type=float, default=0.01,
+                    help="continuous batcher's max-wait deadline seconds "
+                         "(bundle mode)")
     args = ap.parse_args(argv)
     w = WorkerServer(args.host, args.port, args.control_port,
-                     max_queue_depth=args.max_queue_depth)
+                     max_queue_depth=args.max_queue_depth,
+                     bundle=args.bundle, max_wait=args.max_wait)
     print(json.dumps({"port": w.source.port, "control": w.control_port}),
           flush=True)
     try:

@@ -1,0 +1,25 @@
+"""Continuous-batching serving engine with shape-bucket AOT warm starts.
+
+The production serving path (ROADMAP item 1): dynamic batching into a
+static set of power-of-two shape buckets under a max-wait deadline
+(:mod:`.batcher`), a fused decode->pad->pjit->unpad step dispatching each
+bucket as ONE compiled program (:mod:`.step`), every bucket compiled
+ahead of live traffic through ``ProfiledFunction``'s lower/compile cache,
+and the compiled executables serialized into a versioned, manifest-
+committed model+executable bundle (:mod:`.bundle`) so a supervisor-
+restarted worker answers its first request warm. Admission control rides
+the existing SLO ``should_shed()`` + queue-bound machinery — overload is
+rejected 503 at the door, not discovered by a queue timeout.
+
+See docs/performance.md (engine + bundle format) and
+docs/reliability.md (admission control, chaos sites).
+"""
+
+from .batcher import BucketPolicy, ContinuousBatcher, pow2_bucket
+from .bundle import BUNDLE_HEAD, load_bundle, save_bundle
+from .engine import ContinuousServingLoop, serve_continuous
+from .step import FusedServingStep
+
+__all__ = ["BucketPolicy", "ContinuousBatcher", "ContinuousServingLoop",
+           "FusedServingStep", "BUNDLE_HEAD", "load_bundle",
+           "save_bundle", "serve_continuous", "pow2_bucket"]

@@ -1368,18 +1368,60 @@ def _predict_chunked(bins: np.ndarray, score_chunk, table_nodes: int
     return np.concatenate(outs, axis=0)
 
 
-def quantize_ensemble(ens: TreeEnsemble, num_iteration: Optional[int] = None):
-    """Level-wise ensemble -> structure-of-arrays quantized test tables:
-    ``(feature u8 (T,K,N), threshold u8 (T,K,N), leaf bf16 (T,K,L))``.
+def quantize_leaves_int8(leaf: np.ndarray):
+    """f32 leaf table (T, K, L) -> per-(tree, class) symmetric int8:
+    ``(q int8 (T,K,L), scale f32 (T,K,1))`` with ``q * scale ~= leaf``.
 
-    Exactness argument (the tables are lossless except the bf16 leaf
-    round): feature ids live in [0, d) with d <= 256 enforced here; bin
+    One scale per tree per class (not global): boosting shrinks leaf
+    magnitudes iteration over iteration, so a single ensemble-wide scale
+    would burn the int8 range on the first trees and quantize the last
+    ones to zero. Per-tree the round-off is <= scale/2 = max|leaf|/254
+    of THAT tree — the summed raw-score error stays in the same band as
+    the bf16 round (parity tests pin <= 1e-3, argmax exact)."""
+    leaf = np.asarray(leaf, np.float32)
+    amax = np.abs(leaf).max(axis=2, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.rint(leaf / scale).astype(np.int8)
+    return q, scale
+
+
+def dequant_leaf(leaf):
+    """Widen a stored leaf table to the f32 the predict kernels consume:
+    bf16 tables widen exactly; ``(int8, scale)`` pairs dequantize."""
+    if isinstance(leaf, tuple):
+        q, scale = leaf
+        return jnp.asarray(q, jnp.float32) * jnp.asarray(scale)
+    return jnp.asarray(leaf).astype(jnp.float32)
+
+
+def leaf_table_bytes(leaf) -> int:
+    """Stored bytes of a quantized leaf table (the traffic-gauge term):
+    2/leaf for bf16, 1/leaf + the f32 scales for int8."""
+    if isinstance(leaf, tuple):
+        q, scale = leaf
+        return q.nbytes + scale.nbytes
+    return leaf.size * 2
+
+
+def quantize_ensemble(ens: TreeEnsemble, num_iteration: Optional[int] = None,
+                      leaf_dtype: str = "bf16"):
+    """Level-wise ensemble -> structure-of-arrays quantized test tables:
+    ``(feature u8 (T,K,N), threshold u8 (T,K,N), leaf)`` where leaf is a
+    bf16 (T,K,L) table (``leaf_dtype='bf16'``) or a per-tree-scaled
+    ``(int8 (T,K,L), f32 scale (T,K,1))`` pair (``'int8'`` — half the
+    leaf bytes again; see :func:`quantize_leaves_int8`).
+
+    Exactness argument (the tables are lossless except the leaf round):
+    feature ids live in [0, d) with d <= 256 enforced here; bin
     ids live in [0, max_bin) with max_bin <= 256 (fit_gbdt's uint8 wire
     contract), so the route test ``bin > thr`` is unchanged by clamping
     thresholds to 255 — the route-all-left sentinel (thr = n_bins) and a
     bin-255 threshold both already route nothing right against uint8
-    bins. The bf16 leaf round is the one lossy step (<= 2^-9 relative
-    per leaf; the parity bound tests pin <= 1e-3 on summed raw scores)."""
+    bins. The leaf round is the one lossy step (bf16: <= 2^-9 relative
+    per leaf; int8: <= max|leaf|/254 per tree — the parity bound tests
+    pin <= 1e-3 on summed raw scores for both)."""
+    if leaf_dtype not in ("bf16", "int8"):
+        raise ValueError(f"leaf_dtype must be bf16|int8, got {leaf_dtype!r}")
     T = ens.feature.shape[0]
     T = min(T, num_iteration) if num_iteration else T
     d = ens.bin_edges.shape[0]
@@ -1388,25 +1430,32 @@ def quantize_ensemble(ens: TreeEnsemble, num_iteration: Optional[int] = None):
                          f"(uint8 feature ids), got {d}")
     feat = np.asarray(ens.feature[:T]).astype(np.uint8)
     thr = np.minimum(np.asarray(ens.threshold[:T]), 255).astype(np.uint8)
-    leaf = jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16)
+    if leaf_dtype == "int8":
+        leaf = quantize_leaves_int8(np.asarray(ens.leaf[:T]))
+    else:
+        leaf = jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16)
     return feat, thr, leaf
 
 
 def _resolve_predict_impl(requested: str, eligible: bool, why: str) -> str:
-    """auto|dense|pallas -> the impl that will run. 'auto' rides the
-    quantized pallas kernel only on TPU (interpret mode off-TPU is a
-    correctness fallback, not a fast path) and only when the ensemble
-    fits the kernel's unroll caps; an EXPLICIT 'pallas' on an ineligible
-    ensemble is an error, not a silent reroute."""
-    if requested not in ("auto", "dense", "pallas"):
-        raise ValueError(f"predict_impl must be auto|dense|pallas, got "
-                         f"{requested!r}")
+    """auto|dense|pallas|pallas_int8 -> the impl that will run. 'auto'
+    rides the quantized pallas kernel only on TPU (interpret mode
+    off-TPU is a correctness fallback, not a fast path) and only when
+    the ensemble fits the kernel's unroll caps; an EXPLICIT
+    'pallas'/'pallas_int8' on an ineligible ensemble is an error, not a
+    silent reroute. 'pallas_int8' is the same kernel path with
+    per-tree-scaled int8 leaf tables (explicit opt-in: one more lossy
+    round than bf16, half the leaf bytes again)."""
+    if requested not in ("auto", "dense", "pallas", "pallas_int8"):
+        raise ValueError(f"predict_impl must be auto|dense|pallas|"
+                         f"pallas_int8, got {requested!r}")
     if requested == "dense":
         return "dense"
-    if requested == "pallas":
+    if requested in ("pallas", "pallas_int8"):
         if not eligible:
-            raise ValueError(f"predict_impl='pallas' unavailable: {why}")
-        return "pallas"
+            raise ValueError(f"predict_impl={requested!r} unavailable: "
+                             f"{why}")
+        return requested
     return ("pallas" if eligible and jax.default_backend() == "tpu"
             else "dense")
 
@@ -1432,24 +1481,28 @@ def _set_predict_traffic_gauge(n: int, d: int, K: int, table_bytes: int,
 
 
 def _predict_quant_levelwise(ens: TreeEnsemble, bins: np.ndarray, T: int,
-                             depth: int) -> np.ndarray:
-    """The quantized pallas scoring path: SoA uint8/bf16 tables + the
-    tile-resident kernel, chunked so per-chunk device staging stays
-    under the predict byte cap (the same streaming guard as the dense
-    path — here the per-row staging is the bin row + f32 output, no
-    test table)."""
+                             depth: int,
+                             leaf_dtype: str = "bf16") -> np.ndarray:
+    """The quantized pallas scoring path: SoA uint8 + bf16/int8 tables
+    walked by the tile-resident kernel, chunked so per-chunk device
+    staging stays under the predict byte cap (the same streaming guard
+    as the dense path — here the per-row staging is the bin row + f32
+    output, no test table). ``leaf_dtype='int8'`` stores per-tree-scaled
+    int8 leaves (the gauge reflects the smaller table); the kernel
+    always walks the f32 widening, so the traversal is identical."""
     from ...ops.pallas_kernels import gbdt_predict_quant_levelwise
-    feat, thr, leaf = quantize_ensemble(ens, T)
+    feat, thr, leaf = quantize_ensemble(ens, T, leaf_dtype=leaf_dtype)
     K = feat.shape[1]
     n, d = bins.shape
     base = jnp.asarray(ens.base)[None, :].astype(jnp.float32)
-    table_bytes = feat.nbytes + thr.nbytes + leaf.size * 2
+    table_bytes = feat.nbytes + thr.nbytes + leaf_table_bytes(leaf)
     _set_predict_traffic_gauge(n, d, K, table_bytes, 0)
+    leaf_f32 = dequant_leaf(leaf)
 
     @jax.jit
     def run(part):
-        contrib = gbdt_predict_quant_levelwise(part.T, feat, thr, leaf,
-                                               depth=depth)
+        contrib = gbdt_predict_quant_levelwise(part.T, feat, thr,
+                                               leaf_f32, depth=depth)
         return contrib + base
 
     prof = telemetry.profiler.wrap(run, "gbdt.predict_quant")
@@ -1467,8 +1520,10 @@ def predict_raw(ens, x: np.ndarray,
     at bounded HBM. ``predict_impl`` picks the scoring backend: 'dense'
     (the f32/int32 XLA test-table path), 'pallas' (quantized SoA tables
     — uint8 feature/threshold, bf16 leaf — walked by the tile-resident
-    kernel in ops/pallas_kernels.py), or 'auto' (pallas on TPU when the
-    ensemble fits the kernel caps, dense otherwise)."""
+    kernel in ops/pallas_kernels.py), 'pallas_int8' (same kernel with
+    per-tree-scaled int8 leaf tables — half the leaf bytes again), or
+    'auto' (pallas on TPU when the ensemble fits the kernel caps, dense
+    otherwise)."""
     from .leafwise import LeafwiseEnsemble, predict_raw_lw
     if isinstance(ens, LeafwiseEnsemble):
         bins = bin_data_auto(
@@ -1482,8 +1537,11 @@ def predict_raw(ens, x: np.ndarray,
     depth = int(np.log2(ens.leaf.shape[2]))
     T = min(T, num_iteration) if num_iteration else T
     eligible, why = _quant_eligible_levelwise(ens, depth)
-    if _resolve_predict_impl(predict_impl, eligible, why) == "pallas":
-        return _predict_quant_levelwise(ens, np.asarray(bins), T, depth)
+    resolved = _resolve_predict_impl(predict_impl, eligible, why)
+    if resolved in ("pallas", "pallas_int8"):
+        return _predict_quant_levelwise(
+            ens, np.asarray(bins), T, depth,
+            leaf_dtype="int8" if resolved == "pallas_int8" else "bf16")
 
     @jax.jit
     def run(bins, feature, threshold, leaf):

@@ -427,22 +427,31 @@ def predict_tree_lw(bins, S, F, T, W, IC, leaf, has_cats: bool = True):
 
 
 def quantize_ensemble_lw(ens: LeafwiseEnsemble,
-                         num_iteration: Optional[int] = None):
+                         num_iteration: Optional[int] = None,
+                         leaf_dtype: str = "bf16"):
     """Leaf-wise ensemble -> SoA quantized tables: ``(split_leaf i32,
-    feature u8, threshold u8, leaf bf16)``. Numeric splits only (the
+    feature u8, threshold u8, leaf)`` — leaf bf16, or a per-tree-scaled
+    ``(int8, f32 scale)`` pair under ``leaf_dtype='int8'`` (see
+    engine.quantize_leaves_int8). Numeric splits only (the
     caller gates categorical ensembles onto the dense path — bitset
     tests don't reduce to the uint8 compare). Same exactness argument
-    as engine.quantize_ensemble: only the bf16 leaf round is lossy."""
+    as engine.quantize_ensemble: only the leaf round is lossy."""
+    from .engine import quantize_leaves_int8
+    if leaf_dtype not in ("bf16", "int8"):
+        raise ValueError(f"leaf_dtype must be bf16|int8, got {leaf_dtype!r}")
     T = ens.feature.shape[0]
     T = min(T, num_iteration) if num_iteration else T
     d = ens.bin_edges.shape[0]
     if d > 256:
         raise ValueError(f"quantized predict tables need <= 256 features "
                          f"(uint8 feature ids), got {d}")
+    leaf = (quantize_leaves_int8(np.asarray(ens.leaf[:T]))
+            if leaf_dtype == "int8"
+            else jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16))
     return (np.asarray(ens.split_leaf[:T]).astype(np.int32),
             np.asarray(ens.feature[:T]).astype(np.uint8),
             np.minimum(np.asarray(ens.threshold[:T]), 255).astype(np.uint8),
-            jnp.asarray(ens.leaf[:T]).astype(jnp.bfloat16))
+            leaf)
 
 
 def _quant_eligible_lw(ens: LeafwiseEnsemble, has_cats: bool):
@@ -462,20 +471,22 @@ def _quant_eligible_lw(ens: LeafwiseEnsemble, has_cats: bool):
 
 
 def _predict_quant_lw(ens: LeafwiseEnsemble, bins: np.ndarray,
-                      T: int) -> np.ndarray:
-    from .engine import (_predict_chunked, _set_predict_traffic_gauge)
+                      T: int, leaf_dtype: str = "bf16") -> np.ndarray:
+    from .engine import (_predict_chunked, _set_predict_traffic_gauge,
+                         dequant_leaf, leaf_table_bytes)
     from ...ops.pallas_kernels import gbdt_predict_quant_leafwise
     from ... import telemetry
-    S, F, Th, leaf = quantize_ensemble_lw(ens, T)
+    S, F, Th, leaf = quantize_ensemble_lw(ens, T, leaf_dtype=leaf_dtype)
     K = F.shape[1]
     n, d = bins.shape
     base = jnp.asarray(ens.base)[None, :].astype(jnp.float32)
-    table_bytes = S.nbytes + F.nbytes + Th.nbytes + leaf.size * 2
+    table_bytes = S.nbytes + F.nbytes + Th.nbytes + leaf_table_bytes(leaf)
     _set_predict_traffic_gauge(n, d, K, table_bytes, 0)
+    leaf_f32 = dequant_leaf(leaf)
 
     @jax.jit
     def run(part):
-        contrib = gbdt_predict_quant_leafwise(part.T, S, F, Th, leaf)
+        contrib = gbdt_predict_quant_leafwise(part.T, S, F, Th, leaf_f32)
         return contrib + base
 
     prof = telemetry.profiler.wrap(run, "gbdt.predict_quant")
@@ -498,8 +509,11 @@ def predict_raw_lw(ens: LeafwiseEnsemble, bins,
 
     has_cats = bool(np.asarray(ens.cat_features).any())
     eligible, why = _quant_eligible_lw(ens, has_cats)
-    if _resolve_predict_impl(predict_impl, eligible, why) == "pallas":
-        return _predict_quant_lw(ens, np.asarray(bins), T)
+    resolved = _resolve_predict_impl(predict_impl, eligible, why)
+    if resolved in ("pallas", "pallas_int8"):
+        return _predict_quant_lw(
+            ens, np.asarray(bins), T,
+            leaf_dtype="int8" if resolved == "pallas_int8" else "bf16")
 
     @jax.jit
     def run(bins, S, F, Th, W, IC, leaf):

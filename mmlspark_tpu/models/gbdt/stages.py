@@ -587,7 +587,10 @@ _PREDICT_IMPL_DOC = (
     "ensemble scoring backend: dense = the f32/int32 XLA test-table "
     "path; pallas = quantized structure-of-arrays tables (uint8 "
     "feature/threshold, bf16 leaf) walked by the tile-resident Pallas "
-    "kernel (ops/pallas_kernels.py; interpret-mode off-TPU); auto "
+    "kernel (ops/pallas_kernels.py; interpret-mode off-TPU); "
+    "pallas_int8 = the same kernel with per-tree-scaled int8 leaf "
+    "tables (half the leaf bytes again; one extra lossy round — "
+    "explicit opt-in); auto "
     "(default) = pallas on TPU when the ensemble fits the kernel's "
     "unroll caps, dense otherwise")
 
@@ -598,7 +601,7 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
     predictionCol = StringParam("predicted label column", default="prediction")
     objective = StringParam("binary|multiclass", default="binary")
     predictImpl = StringParam(_PREDICT_IMPL_DOC, default="auto",
-                              choices=("auto", "dense", "pallas"))
+                              choices=("auto", "dense", "pallas", "pallas_int8"))
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)
@@ -672,7 +675,7 @@ class LightGBMRegressionModel(Model, HasFeaturesCol):
     predictionCol = StringParam("prediction column", default="prediction")
     objective = StringParam("regression|quantile|mae", default="regression")
     predictImpl = StringParam(_PREDICT_IMPL_DOC, default="auto",
-                              choices=("auto", "dense", "pallas"))
+                              choices=("auto", "dense", "pallas", "pallas_int8"))
     boosterState = ComplexParam("fitted tree arrays", default=None)
     featureSelection = ComplexParam(
         "column indices the fit kept (sparse wide inputs)", default=None)

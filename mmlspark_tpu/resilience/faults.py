@@ -30,6 +30,7 @@ are independent and the whole run is reproducible. Injection sites call
 
 Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``fleet.respond``, ``fleet.transform``, ``serving.transform``,
+``serving.batch``, ``serving.bundle_load``,
 ``http.request``, ``http.debug``, ``powerbi.post``, ``dataplane.put``,
 ``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
 ``supervisor.heartbeat``, ``supervisor.rejoin``, ``elastic.step``,
@@ -63,7 +64,8 @@ KINDS = ("error", "delay")
 #: :func:`configure` warns when a chaos spec names a site not listed
 #: here — a typo'd site would otherwise inject nothing, silently.
 SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
-         "serving.transform", "http.request", "http.debug",
+         "serving.transform", "serving.batch", "serving.bundle_load",
+         "http.request", "http.debug",
          "powerbi.post", "dataplane.put", "dataplane.allgather",
          "trainer.step", "supervisor.probe", "supervisor.heartbeat",
          "supervisor.rejoin", "elastic.step", "elastic.remesh",

@@ -76,7 +76,8 @@ def _default_respawn(wi: int, old):
         old.kill()   # reap the zombie; no-op for already-waited procs
     except Exception:
         pass
-    return _Worker(old.host, old.port, old.control, spawn=True)
+    return _Worker(old.host, old.port, old.control, spawn=True,
+                   extra_argv=getattr(old, "extra_argv", ()))
 
 
 class _Recovery:

@@ -203,11 +203,21 @@ class ProfiledFunction:
     abstract arg signature, so first-compile cost analysis, compile wall
     time, and recompile causes are all observed; each call is then timed
     to completion (``block_until_ready`` — profiling is an opt-in sync
-    point, exactly like span ``sync=``)."""
+    point, exactly like span ``sync=``).
 
-    def __init__(self, fn, tag: str):
+    ``aot=True`` pins the AOT lower/compile cache on even while profiling
+    is off (no per-call timing or ``block_until_ready`` then — async
+    dispatch is untouched): the serving engine's warm-start story rides
+    this cache — every shape bucket is compiled ahead of time
+    (:meth:`aot_compile`), serialized executables from a bundle are
+    seeded back in (:meth:`preload`), and compile counts/causes keep
+    flowing to the recompile counters so "zero compiles on live traffic"
+    is an assertable metric."""
+
+    def __init__(self, fn, tag: str, aot: bool = False):
         self._fn = fn
         self.tag = tag
+        self.aot = bool(aot)
         self._cache: dict = {}     # sig -> (compiled, cost)
         self._last_sig: Optional[tuple] = None
         self.compiles = 0
@@ -238,9 +248,48 @@ class ProfiledFunction:
         _m_bytes.labels(fn=self.tag).set(cost["bytes"])
         return compiled, cost
 
+    def is_cached(self, *args) -> bool:
+        """Would a call with these args hit the AOT executable cache?
+        (The serving engine's cache hit/miss accounting — a miss on live
+        traffic is a cold compile somebody's request pays for.)"""
+        return _abstract_sig(args) in self._cache
+
+    def aot_compile(self, *args):
+        """Compile (and cache) the executable for ``args``' abstract
+        signature WITHOUT running it — args may be concrete arrays or
+        ``jax.ShapeDtypeStruct``s. The warm-up entry point: serving
+        buckets compile here at startup / bundle-build time, so no live
+        request ever pays the compile. Returns the compiled executable
+        (what :mod:`io/serving/bundle` serializes)."""
+        sig = _abstract_sig(args)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._cache[sig] = self._compile(args, sig)
+            self._last_sig = sig
+        return entry[0]
+
+    def preload(self, args, compiled) -> tuple:
+        """Seed the AOT cache with a deserialized executable for
+        ``args``' signature (no compile, no counter bump — the whole
+        point of a warm start). Returns the cache signature."""
+        sig = _abstract_sig(args)
+        self._cache[sig] = (compiled, _extract_cost(compiled))
+        self._last_sig = sig
+        return sig
+
     def __call__(self, *args):
         if not _pstate.enabled:
-            return self._fn(*args)
+            if not self.aot:
+                return self._fn(*args)
+            # AOT-pinned mode: executable-cache dispatch without the
+            # profiler's sync point — async dispatch stays intact
+            sig = _abstract_sig(args)
+            entry = self._cache.get(sig)
+            if entry is None:
+                entry = self._cache[sig] = self._compile(args, sig)
+                self._last_sig = sig
+            self.calls += 1
+            return entry[0](*args)
         import jax
         sig = _abstract_sig(args)
         entry = self._cache.get(sig)
@@ -262,12 +311,14 @@ class ProfiledFunction:
         return out
 
 
-def wrap(fn, tag: str) -> ProfiledFunction:
+def wrap(fn, tag: str, aot: bool = False) -> ProfiledFunction:
     """Wrap a jitted function for profiling (idempotent per tag: wrapping
-    replaces the report slot, not accumulates)."""
+    replaces the report slot, not accumulates). ``aot=True`` keeps the
+    executable cache live even while profiling is off (serving warm
+    starts)."""
     if isinstance(fn, ProfiledFunction):
         return fn
-    return ProfiledFunction(fn, tag)
+    return ProfiledFunction(fn, tag, aot=aot)
 
 
 def report() -> dict:

@@ -1009,3 +1009,112 @@ class TestQuantizedPredict:
         # the quantized path drops the per-row test-table staging and
         # shrinks the amortized tables
         assert quant_bpr < dense_bpr
+
+
+class TestInt8LeafTables:
+    """predict_impl='pallas_int8': the quantized kernel path with
+    per-tree-scaled int8 leaf tables (the bf16 leaves were the last
+    non-8-bit term of the SoA tables). One more lossy round than bf16 —
+    the parity bar is <= 1e-3 on the user-facing PROBABILITIES (sigmoid
+    /softmax damp the raw-score round-off) with argmax exact on
+    separated classes; raw scores carry a documented ~2e-3 band."""
+
+    def _fit_binary(self, n=8000, iters=15):
+        rng = np.random.default_rng(42)
+        x = rng.normal(size=(n, 12)).astype(np.float32)
+        logit = x[:, 0] * 2 + x[:, 1] - x[:, 2] * 0.5
+        y = (logit + rng.normal(0, 0.5, n) > 0).astype(np.float32)
+        ens = engine.fit_gbdt(x, y, GBDTParams(
+            num_iterations=iters, max_depth=4, objective="binary"))
+        return ens, x
+
+    def test_quantize_tables_int8_with_per_tree_scale(self):
+        ens, x = self._fit_binary(n=2000, iters=5)
+        feat, thr, leaf = engine.quantize_ensemble(ens, leaf_dtype="int8")
+        q, scale = leaf
+        assert q.dtype == np.int8 and scale.dtype == np.float32
+        assert q.shape == (5, 1, 2 ** 4) and scale.shape == (5, 1, 1)
+        # symmetric per-tree quantization: |dequant - f32| <= scale/2,
+        # and the full int8 range is used for each tree's largest leaf
+        ref = np.asarray(ens.leaf[:5], np.float32)
+        dq = np.asarray(engine.dequant_leaf(leaf))
+        assert np.abs(dq - ref).max() <= (scale / 2).max() + 1e-9
+        assert np.abs(q).max(axis=2).min() == 127
+        # table accounting: int8 leaves + scales undercut the 2-byte
+        # bf16 table
+        assert engine.leaf_table_bytes(leaf) < ref.size * 2
+
+    def test_levelwise_probability_parity_and_raw_band(self):
+        ens, x = self._fit_binary()
+        prob_d = engine.predict(ens, x, predict_impl="dense")
+        prob_i = engine.predict(ens, x, predict_impl="pallas_int8")
+        assert np.abs(prob_i - prob_d).max() <= 1e-3
+        raw_d = engine.predict_raw(ens, x, predict_impl="dense")
+        raw_i = engine.predict_raw(ens, x, predict_impl="pallas_int8")
+        assert np.abs(raw_i - raw_d).max() / np.abs(raw_d).max() <= 4e-3
+
+    def test_leafwise_probability_parity(self):
+        rng = np.random.default_rng(42)
+        x = rng.normal(size=(8000, 12)).astype(np.float32)
+        logit = x[:, 0] * 1.5 + x[:, 1] - x[:, 2] * 0.5
+        y = (logit + rng.normal(0, 0.5, len(x)) > 0).astype(np.float32)
+        ens = engine.fit_gbdt(x, y, GBDTParams(
+            num_iterations=15, num_leaves=15, objective="binary"))
+        prob_d = engine.predict(ens, x, predict_impl="dense")
+        prob_i = engine.predict(ens, x, predict_impl="pallas_int8")
+        assert np.abs(prob_i - prob_d).max() <= 1e-3
+
+    def test_multiclass_parity_and_exact_argmax(self):
+        rng = np.random.default_rng(42)
+        x = rng.normal(size=(8000, 12)).astype(np.float32)
+        centers = np.array([[4, 0], [0, 4], [-4, -4]], np.float32)
+        ym = rng.integers(0, 3, size=len(x))
+        x[:, :2] += centers[ym]
+        ens = engine.fit_gbdt(x, ym.astype(np.float32), GBDTParams(
+            num_iterations=10, max_depth=4, objective="multiclass",
+            num_class=3))
+        prob_d = engine.predict(ens, x, predict_impl="dense")
+        prob_i = engine.predict(ens, x, predict_impl="pallas_int8")
+        assert np.abs(prob_i - prob_d).max() <= 1e-3
+        assert (prob_i.argmax(1) == prob_d.argmax(1)).all()
+
+    def test_bytes_per_row_gauge_drops_below_bf16(self):
+        from mmlspark_tpu import telemetry
+        ens, x = self._fit_binary(n=1000, iters=10)
+        telemetry.enable()
+        telemetry.registry.reset()
+        try:
+            engine.predict_raw(ens, x, predict_impl="pallas")
+            bf16_bpr = telemetry.snapshot()[
+                "mmlspark_gbdt_predict_bytes_per_row"]["series"][0]["value"]
+            engine.predict_raw(ens, x, predict_impl="pallas_int8")
+            int8_bpr = telemetry.snapshot()[
+                "mmlspark_gbdt_predict_bytes_per_row"]["series"][0]["value"]
+        finally:
+            telemetry.registry.reset()
+            telemetry.disable()
+        assert int8_bpr < bf16_bpr
+
+    def test_stage_routing_and_eligibility(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2000, 12)).astype(np.float32)
+        y = (x[:, 0] * 2 + x[:, 1] > 0).astype(np.int64)
+        df = _df_from_matrix(x, y)
+        model = (LightGBMClassifier().setNumIterations(10)
+                 .setNumLeaves(15).fit(df))
+        dense = np.stack(list(
+            model.setPredictImpl("dense").transform(df)
+            .col("probability")))
+        int8 = np.stack(list(
+            model.setPredictImpl("pallas_int8").transform(df)
+            .col("probability")))
+        assert np.abs(dense - int8).max() <= 2e-3
+        assert (dense.argmax(1) == int8.argmax(1)).all()
+        # explicit pallas_int8 on an ineligible ensemble errors like
+        # explicit pallas does (no silent reroute)
+        deep = engine.fit_gbdt(
+            x, (x[:, 0] > 0).astype(np.float32),
+            GBDTParams(num_iterations=2, max_depth=9,
+                       objective="binary"))
+        with pytest.raises(ValueError, match="unroll cap"):
+            engine.predict_raw(deep, x, predict_impl="pallas_int8")
