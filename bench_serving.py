@@ -251,7 +251,7 @@ def arrival_times(process: str, rate: float, duration: float,
     return np.asarray(out)
 
 
-def run_open_loop(url: str, payload: bytes, schedule: np.ndarray,
+def run_open_loop(url, payload: bytes, schedule: np.ndarray,
                   deadline: float = 1.0, pool: int = 64) -> dict:
     """Drive one serving URL with an open-loop schedule from a bounded
     client pool; returns goodput + latency percentiles + failure
@@ -259,7 +259,9 @@ def run_open_loop(url: str, payload: bytes, schedule: np.ndarray,
     ``deadline`` of its scheduled arrival; 503 sheds, late replies,
     errors, and timeouts all count offered-but-not-good. When every pool
     client is busy the schedule slips (recorded as ``slipped`` — the
-    practical bound on offered concurrency)."""
+    practical bound on offered concurrency). ``url`` may be a callable
+    ``() -> url`` so elastic-fleet scenarios pick a live replica per
+    request."""
     import urllib.error
     import urllib.request
 
@@ -283,7 +285,8 @@ def run_open_loop(url: str, payload: bytes, schedule: np.ndarray,
                 with lock:
                     counts["slipped"] += 1
             try:
-                req = urllib.request.Request(url, data=payload)
+                u = url() if callable(url) else url
+                req = urllib.request.Request(u, data=payload)
                 with urllib.request.urlopen(req, timeout=deadline) as r:
                     ok = r.status == 200
                     r.read()
@@ -408,6 +411,180 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
     return doc
 
 
+def chaos_serve_main(rate: float = 300.0, duration: float = 8.0,
+                     deadline: float = 0.5, pool: int = 48,
+                     smoke: bool = False, seed: int = 0):
+    """The elastic-serving chaos scenario: one bursty open-loop load
+    against the SLO-driven autoscaled fleet, with a throttled-straggler
+    window and a mid-run worker kill -9 layered on top. The fleet must
+    GROW under the spike (new workers warm from the AOT bundle — zero
+    compiles), reconcile the killed worker back into the same lineage,
+    and SHRINK by graceful drain once the load ends. Emits
+    ``serving_chaos_{recovery_seconds,goodput_rps}`` in one
+    mmlspark-bench/v1 doc for the perf gate."""
+    import tempfile
+    import urllib.request
+    import jax
+    from mmlspark_tpu import telemetry
+    from mmlspark_tpu.io.http.fleet import ProcessHTTPSource, _Worker
+    from mmlspark_tpu.io.http.worker import WorkerServer
+    from mmlspark_tpu.io.serving import (BucketPolicy, FusedServingStep,
+                                         save_bundle)
+    from mmlspark_tpu.models import build_model
+    from mmlspark_tpu.resilience import faults
+    from mmlspark_tpu.resilience.autoscale import ServingAutoscaler
+    from mmlspark_tpu.resilience.reconciler import FleetReconciler
+    from mmlspark_tpu.telemetry.slo import SLOEngine
+    from mmlspark_tpu.telemetry.timeseries import TimeSeriesSampler
+
+    telemetry.enable()
+    cfg = ({"type": "convnet", "channels": (4, 4), "dense": 16,
+            "num_classes": 10} if smoke
+           else {"type": "resnet", "num_classes": 10})
+    module = build_model(cfg)
+    params = module.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 32, 32, 3), np.float32))
+    step = FusedServingStep(cfg, params,
+                            policy=BucketPolicy(max_batch=64,
+                                                min_bucket=8),
+                            row_shape=(32, 32, 3), in_dtype=np.uint8,
+                            output="argmax")
+    bundle_dir = tempfile.mkdtemp(prefix="serving_chaos_bundle_")
+    save_bundle(bundle_dir, step)
+
+    def compiles():
+        snap = telemetry.snapshot()
+        return sum(s["value"] for s in snap.get(
+            "mmlspark_profiler_compiles", {}).get("series", []))
+
+    compiles0 = compiles()
+    # in-process bundle workers: the warm-start + drain semantics of the
+    # subprocess fleet without paying a JAX import per spawned replica
+    servers: list = []
+
+    def spawn(wi, old):
+        if old is not None:
+            for ws in servers:
+                if ws.control_port == old.control:
+                    try:
+                        ws.close()
+                    except Exception:
+                        pass
+        ws = WorkerServer("127.0.0.1",
+                          port=old.port if old is not None else 0,
+                          control_port=old.control if old is not None
+                          else 0, bundle=bundle_dir)
+        servers.append(ws)
+        return _Worker("127.0.0.1", ws.source.port, ws.control_port,
+                       spawn=False)
+
+    source = ProcessHTTPSource(workers=[spawn(0, None)])
+    sampler = TimeSeriesSampler(interval=0.2).start()
+    slo = SLOEngine([{"name": "serve-latency", "kind": "latency",
+                      "hist": "mmlspark_http_request_seconds",
+                      "threshold_s": deadline / 5.0, "target": 0.99,
+                      "windows": (0.8, 1.6)}], sampler=sampler)
+    rec = FleetReconciler(source, 1, spawn=spawn, min_workers=1,
+                          max_workers=3, interval=0.05,
+                          probe_interval=0.05,
+                          drain_timeout=15.0).start()
+    rec.supervisor.probe_timeout = 0.5
+    rec.supervisor.restart_backoff = 0.05
+    asc = ServingAutoscaler(slo, rec, grow_window=0.4,
+                            shrink_window=2.0, cooldown=1.0,
+                            idle_rows_per_worker=0.5,
+                            interval=0.1).start()
+
+    rng = np.random.default_rng(seed)
+    payload = base64.b64encode(
+        rng.integers(0, 256, 32 * 32 * 3, dtype=np.uint8).tobytes())
+    schedule = arrival_times("bursty", rate, duration, seed=seed)
+    pick = {"i": 0}
+
+    def url():
+        urls = source.urls
+        if not urls:
+            return f"http://127.0.0.1:{source.workers[0].port}/"
+        pick["i"] += 1
+        return urls[pick["i"] % len(urls)]
+
+    recovery = {"s": None}
+
+    def scenario():
+        # straggler window: the serving path slows (alive, just slow)
+        time.sleep(duration * 0.3)
+        faults.configure("serving.batch:delay:0.5:0.05", seed=seed)
+        time.sleep(duration * 0.2)
+        faults.clear()
+        # kill -9 worker 0 mid-load; recovery = kill -> same URL serves
+        port0 = source.workers[0].port
+        servers[0].close()
+        t_kill = time.perf_counter()
+        dead_url = f"http://127.0.0.1:{port0}/"
+        deadline_t = time.monotonic() + 30
+        while time.monotonic() < deadline_t:
+            try:
+                req = urllib.request.Request(dead_url, data=payload)
+                with urllib.request.urlopen(req, timeout=1.0) as r:
+                    if r.status == 200:
+                        recovery["s"] = time.perf_counter() - t_kill
+                        return
+            except Exception:
+                time.sleep(0.05)
+
+    chaos = threading.Thread(target=scenario)
+    chaos.start()
+    result = run_open_loop(url, payload, schedule, deadline, pool)
+    chaos.join(timeout=60)
+
+    # idle: the fleet shrinks back to the floor by graceful drain
+    deadline_t = time.monotonic() + 20
+    while not (rec.observed() == 1 and rec.converged()) \
+            and time.monotonic() < deadline_t:
+        time.sleep(0.1)
+    snap = telemetry.snapshot()
+
+    def total(name):
+        return sum(s["value"] for s in snap.get(name, {}).get(
+            "series", []))
+
+    verdicts = {tuple(sorted(s["labels"].items()))[0][1]: s["value"]
+                for s in snap.get("mmlspark_autoscale_verdicts",
+                                  {}).get("series", [])}
+    headline = {
+        "metric": "serving_chaos", "arrival": "bursty", "rate": rate,
+        **result,
+        "recovery_s": (None if recovery["s"] is None
+                       else round(recovery["s"], 2)),
+        "grow_verdicts": int(verdicts.get("grow", 0)),
+        "shrink_verdicts": int(verdicts.get("shrink", 0)),
+        "workers_retired": int(total("mmlspark_fleet_workers_retired")),
+        "final_workers": rec.observed(),
+        "compiles_during_traffic": int(compiles() - compiles0),
+    }
+    print(json.dumps(headline))
+    asc.stop()
+    rec.stop()
+    sampler.stop()
+    for ws in servers:
+        try:
+            ws.close()
+        except Exception:
+            pass
+    source.close()
+    faults.clear()
+    telemetry.disable()
+    metrics = [{"metric": "serving_chaos_goodput_rps",
+                "value": result["goodput_rps"], "unit": "req/s",
+                "arrival": "bursty", "rate": rate},
+               {"metric": "serving_chaos_recovery_seconds",
+                "value": headline["recovery_s"], "unit": "s"}]
+    doc = {"schema": "mmlspark-bench/v1", "bench": "serving_chaos",
+           "backend": jax.default_backend(), "metrics": metrics}
+    print(json.dumps(doc))
+    return doc
+
+
 def main():
     import requests
     from mmlspark_tpu.io.http import serve_pipeline
@@ -487,6 +664,13 @@ if __name__ == "__main__":
                          "merges every hop into serving_trace.jsonl "
                          "(one trace_id per request; combine with "
                          "--chaos for the fault-injected run)")
+    ap.add_argument("--chaos-serve", action="store_true",
+                    help="elastic-fleet chaos scenario: bursty spike + "
+                         "throttled straggler + worker kill -9 against "
+                         "the SLO-driven autoscaled fleet; reports "
+                         "goodput, recovery seconds, grow/shrink "
+                         "verdicts and emits an mmlspark-bench/v1 doc "
+                         "(serving_chaos_*) for the perf gate")
     ap.add_argument("--open-loop", action="store_true",
                     help="open-loop arrival benchmark: polling loop vs "
                          "continuous-batching engine over the same "
@@ -513,7 +697,11 @@ if __name__ == "__main__":
                     help="tiny convnet + short schedule (CPU CI "
                          "validation of the open-loop harness)")
     args = ap.parse_args()
-    if args.open_loop:
+    if args.chaos_serve:
+        chaos_serve_main(rate=args.rate, duration=args.duration,
+                         deadline=args.deadline_ms / 1e3,
+                         pool=args.pool, smoke=args.smoke)
+    elif args.open_loop:
         open_loop_main(rate=args.rate, duration=args.duration,
                        arrival=args.arrival,
                        deadline=args.deadline_ms / 1e3, pool=args.pool,

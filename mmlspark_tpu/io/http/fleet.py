@@ -62,6 +62,13 @@ _m_rows_dropped = telemetry.registry.counter(
 _m_replies_parked = telemetry.registry.counter(
     "mmlspark_fleet_replies_parked",
     "computed replies parked because their worker was marked dead")
+_m_workers_added = telemetry.registry.counter(
+    "mmlspark_fleet_workers_added",
+    "workers added to the fleet after launch (autoscaler grow / "
+    "reconciler converge)")
+_m_workers_retired = telemetry.registry.counter(
+    "mmlspark_fleet_workers_retired",
+    "workers retired after a graceful drain (zero parked rows/replies)")
 
 
 class _Worker:
@@ -74,6 +81,12 @@ class _Worker:
                  extra_argv: tuple = ()):
         self.host = host
         self.alive = True
+        # scale-down lifecycle: draining = shedding new requests while
+        # in-flight work finishes; retired = drained and gone (the slot
+        # stays in the workers list so qid offsets never shift; a later
+        # grow respawns into it — the same lineage)
+        self.draining = False
+        self.retired = False
         self.proc = None
         # preserved across supervisor restarts: a respawned worker must
         # come back with the same serving flags (e.g. --bundle DIR, so
@@ -147,6 +160,22 @@ class _Worker:
     def respond(self, replies: list) -> None:
         faults.inject("fleet.respond")
         self._call("/respond", {"replies": replies})
+
+    def drain(self, draining: bool = True) -> dict:
+        """Flip the worker's drain mode over the control channel; returns
+        its {draining, inflight, unacked} snapshot."""
+        faults.inject("fleet.drain")
+        return self._call("/drain", {"draining": draining})
+
+    def healthz(self, timeout: float = 2.0) -> dict:
+        """One control-plane ``GET /healthz`` round-trip (the fleet
+        aggregation + drain-completion probe). Same chaos site as the
+        rest of the observability GET surface."""
+        faults.inject("http.debug")
+        with urllib.request.urlopen(
+                f"http://{self.host}:{self.control}/healthz",
+                timeout=timeout) as r:
+            return json.loads(r.read() or b"{}")
 
     def probably_dead(self) -> bool:
         """Distinguish crashed from merely slow: process exit is
@@ -392,6 +421,136 @@ class ProcessHTTPSource:
         _m_workers_alive.set(self.aliveCount())
         _m_uncommitted.set(n_log)
 
+    # ---- elastic membership (the reconciler/autoscaler surface) ----
+    def addWorker(self, worker) -> int:
+        """Admit a NEW worker into rotation (autoscaler grow). Returns
+        its index; the next ``getOffset`` round starts polling it."""
+        with self._lock:
+            self.workers.append(worker)
+            wi = len(self.workers) - 1
+        _m_workers_added.inc()
+        _m_workers_alive.set(self.aliveCount())
+        log.info("worker %d added to the fleet on port %d", wi,
+                 worker.port)
+        return wi
+
+    def beginDrain(self, wi: int) -> None:
+        """Start a graceful drain of worker ``wi``: it sheds NEW client
+        requests (503 + Retry-After) while the driver keeps polling and
+        replying until everything admitted has been answered."""
+        w = self.workers[wi]
+        if w.draining or not w.alive:
+            return
+        w.draining = True
+        telemetry.trace.instant("fleet/drain", worker=wi, phase="begin")
+        try:
+            snap = w.drain(True)
+            log.info("worker %d draining: %d inflight, %d unacked", wi,
+                     snap.get("inflight", -1), snap.get("unacked", -1))
+        except Exception as e:
+            # reset the flag so the reconciler's next tick retries the
+            # drain POST (a worker flagged draining but never told would
+            # keep admitting while the fleet waits on it forever)
+            log.warning("worker %d drain request failed (retried next "
+                        "tick): %s", wi, e)
+            w.draining = False
+
+    def drainComplete(self, wi: int) -> bool:
+        """True once worker ``wi`` has nothing left in flight anywhere:
+        its own queue/exchanges/unacked backlog are empty AND the driver
+        holds no uncommitted rows or buffered replies for it."""
+        w = self.workers[wi]
+        prefix = f"{wi}:"
+        with self._lock:
+            driver_busy = (any(qid.startswith(prefix)
+                               for _off, qid, _v in self._log)
+                           or bool(self._reply_buf.get(wi))
+                           or bool(self._parked_rows.get(wi))
+                           or bool(self._parked_replies.get(wi)))
+        if driver_busy:
+            return False
+        h = w.healthz()
+        return (bool(h.get("draining"))
+                and int(h.get("inflight", 1)) == 0
+                and int(h.get("unacked", 1)) == 0)
+
+    def retireWorker(self, wi: int) -> None:
+        """Remove a drained worker from the fleet. The slot stays in
+        ``workers`` (offsets/qids never shift) flagged ``retired``; a
+        later grow respawns into it — the same lineage. Nothing is
+        parked: retire only fires after :meth:`drainComplete`."""
+        w = self.workers[wi]
+        with self._lock:
+            w.alive = False
+            w.draining = False
+            w.retired = True
+        try:
+            w.kill()
+        except Exception:
+            pass
+        w.alive = False      # kill() clears it anyway; be explicit
+        _m_workers_retired.inc()
+        telemetry.trace.instant("fleet/drain", worker=wi, phase="retired")
+        telemetry.flight.note("fleet/retire", worker=wi)
+        log.info("worker %d retired after graceful drain", wi)
+        _m_workers_alive.set(self.aliveCount())
+
+    def fleet_healthz(self, timeout: float = 2.0) -> dict:
+        """One fleet-level health doc: every live worker's control-plane
+        ``/healthz`` (queue depth, inflight, breakers, warm buckets)
+        aggregated with the driver's own view (uncommitted rows, parked
+        state) — a single probe shows fleet health. Registered sections
+        (autoscaler, reconciler) are appended by the caller."""
+        with self._lock:
+            n_log = len(self._log)
+            parked = sum(len(v) for v in self._parked_rows.values())
+            workers = list(enumerate(self.workers))
+        per_worker = {}
+        depth = inflight = 0
+        ok = True
+        for wi, w in workers:
+            if w.retired:
+                per_worker[str(wi)] = {"state": "retired"}
+                continue
+            state = ("draining" if w.draining
+                     else "alive" if w.alive else "dead")
+            if not w.alive:
+                per_worker[str(wi)] = {"state": state}
+                ok = False
+                continue
+            try:
+                h = w.healthz(timeout=timeout)
+            except Exception as e:
+                per_worker[str(wi)] = {"state": state,
+                                       "probe_error": str(e)}
+                ok = False
+                continue
+            entry = {"state": state, "port": w.port,
+                     "ok": bool(h.get("ok", False)),
+                     "queue_depth": h.get("queue_depth"),
+                     "inflight": h.get("inflight"),
+                     "unacked": h.get("unacked"),
+                     "breakers": h.get("breakers", {})}
+            if "serving" in h:       # bundle-warm self-serving worker
+                entry["warm_buckets"] = h["serving"].get("warm_buckets")
+                entry["compiles"] = h["serving"].get("compiles")
+            if "slo" in h:
+                entry["slo"] = h["slo"]
+                entry["ok"] = entry["ok"] and h["slo"].get("ok", True)
+            per_worker[str(wi)] = entry
+            ok = ok and entry["ok"]
+            depth += int(h.get("queue_depth") or 0)
+            inflight += int(h.get("inflight") or 0)
+        return {"ok": ok,
+                "workers_alive": self.aliveCount(),
+                "workers_draining": sum(1 for _i, w in workers
+                                        if w.draining),
+                "queue_depth": depth,
+                "inflight": inflight,
+                "uncommitted_rows": n_log,
+                "parked_rows": parked,
+                "workers": per_worker}
+
     # ---- reply path (HTTPSink surface) ----
     def respond(self, ex_id: str, code: int, body) -> None:
         wi, raw = str(ex_id).split(":", 1)
@@ -584,6 +743,128 @@ class ReplayServingLoop:
             self.supervisor.stop()
         self._thread.join(timeout=5)
         self.source.close()
+
+
+def fleet_doc(source: ProcessHTTPSource, autoscaler=None,
+              reconciler=None) -> dict:
+    """The single-probe fleet health doc: per-worker ``/healthz``
+    aggregation plus the ``autoscale`` and ``reconciler`` control-plane
+    sections. Wire it to a driver-side
+    :class:`~.server.HTTPSource`'s ``fleet_state`` so ``GET /healthz``
+    on the driver shows the whole fleet."""
+    doc = source.fleet_healthz()
+    if autoscaler is not None:
+        doc["autoscale"] = autoscaler.state()
+    if reconciler is not None:
+        doc["reconciler"] = reconciler.state()
+        doc["ok"] = doc["ok"] and reconciler.state()["last_error"] is None
+    return doc
+
+
+class AutoscaledFleet:
+    """Handle over an SLO-driven elastic serving fleet: the worker
+    source, the optional driver batch loop, the reconciler, the
+    autoscaler, and the driver health server. ``stop()`` tears all of
+    it down in dependency order."""
+
+    def __init__(self, source, loop, reconciler, autoscaler, health):
+        self.source = source
+        self.loop = loop
+        self.reconciler = reconciler
+        self.autoscaler = autoscaler
+        self.health = health
+
+    @property
+    def urls(self) -> list[str]:
+        return self.source.urls
+
+    def healthz(self) -> dict:
+        return fleet_doc(self.source, self.autoscaler, self.reconciler)
+
+    def stop(self):
+        self.autoscaler.stop()
+        self.reconciler.stop()
+        if self.loop is not None:
+            self.loop.stop()        # also closes the source
+        else:
+            self.source.close()
+        if self.health is not None:
+            self.health.close()
+
+
+def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
+                     replicas: int = 1, min_workers: int = 1,
+                     max_workers: int = 8, host: str = "127.0.0.1",
+                     max_queue_depth: int = 0,
+                     health_port: int = None,
+                     grow_window: float = 1.0,
+                     shrink_window: float = 10.0, cooldown: float = 5.0,
+                     idle_rows_per_worker: float = 1.0,
+                     probe_interval: float = 0.25,
+                     reconcile_interval: float = 0.25,
+                     autoscale_interval: float = 0.5,
+                     objectives=None, load_fn=None) -> AutoscaledFleet:
+    """Spin up the SLO-driven elastic serving fleet.
+
+    ``slo`` is an :class:`~...telemetry.slo.SLOEngine` (or a config
+    accepted by ``SLOEngine.from_config``); its latency/goodput burn
+    verdicts drive grow, sustained idle drives shrink. Exactly one of:
+
+    * ``bundle_dir`` — workers self-serve the AOT bundle
+      (``--bundle``): every spawned replica answers its first request
+      warm, no driver batch loop;
+    * ``transformer`` — the classic driver micro-batch loop
+      (:class:`ReplayServingLoop`) over the worker fleet.
+
+    The engine must evaluate over series visible in THIS process's
+    registry (in-process worker fleets share it; subprocess fleets
+    scale on driver-side series such as a goodput objective over the
+    offset log, or a custom ``load_fn``).
+
+    ``health_port`` (0 = kernel-assigned) additionally starts a
+    driver-side health server whose ``GET /healthz`` embeds the
+    fleet-level doc (per-worker health + autoscale + reconciler)."""
+    from ...resilience.autoscale import ServingAutoscaler
+    from ...resilience.reconciler import FleetReconciler
+    from ...telemetry.slo import SLOEngine
+    if (transformer is None) == (bundle_dir is None):
+        raise ValueError("pass exactly one of transformer / bundle_dir")
+    if not isinstance(slo, SLOEngine):
+        slo = SLOEngine.from_config(slo)
+    extra_argv = ("--bundle", bundle_dir) if bundle_dir else ()
+    replicas = max(min_workers, min(max_workers, replicas))
+    workers = []
+    try:
+        for _ in range(replicas):
+            workers.append(_Worker(host, 0, 0, spawn=True,
+                                   max_queue_depth=max_queue_depth,
+                                   extra_argv=extra_argv))
+    except Exception:
+        for w in workers:
+            w.kill()
+        raise
+    source = ProcessHTTPSource(workers=workers)
+    reconciler = FleetReconciler(
+        source, replicas, min_workers=min_workers,
+        max_workers=max_workers, interval=reconcile_interval,
+        probe_interval=probe_interval, extra_argv=extra_argv).start()
+    autoscaler = ServingAutoscaler(
+        slo, reconciler, grow_window=grow_window,
+        shrink_window=shrink_window, cooldown=cooldown,
+        idle_rows_per_worker=idle_rows_per_worker,
+        objectives=objectives, load_fn=load_fn,
+        interval=autoscale_interval).start()
+    loop = None
+    if transformer is not None:
+        loop = ReplayServingLoop(source, transformer).start()
+    health = None
+    if health_port is not None:
+        from .server import HTTPSource
+        health = HTTPSource(host=host, port=health_port,
+                            name="fleet-driver", slo=slo)
+        health.fleet_state = lambda: fleet_doc(source, autoscaler,
+                                               reconciler)
+    return AutoscaledFleet(source, loop, reconciler, autoscaler, health)
 
 
 def serve_fleet(transformer, n_workers: int = 2, host: str = "127.0.0.1",

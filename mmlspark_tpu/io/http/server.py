@@ -119,6 +119,19 @@ class HTTPSource:
         # optional telemetry.slo.SLOEngine: its breach state rides
         # /healthz and (for shed_on_breach objectives) gates admission
         self.slo = slo
+        # graceful drain (scale-down): a draining server sheds every NEW
+        # request (503 + Retry-After — clients go elsewhere) while the
+        # already-admitted exchanges finish normally; the fleet retires
+        # the worker once inflight hits zero. Parks nothing, loses
+        # nothing.
+        self._draining = False
+        # optional fleet-doc provider: the DRIVER's health surface sets
+        # this to embed the aggregated per-worker fleet healthz (plus
+        # autoscaler/reconciler sections) — see io/http/fleet.fleet_doc.
+        # Deliberately instance-scoped, never global: worker processes
+        # (and in-process worker sources) must not recurse through the
+        # aggregation probe.
+        self.fleet_state = None
         self._t0 = time.monotonic()
         # live requests awaiting batch pickup. NOT _pending.qsize(): a
         # timed-out client's exchange lingers in the queue until a later
@@ -141,8 +154,8 @@ class HTTPSource:
                 if telemetry.enabled():
                     ctx = (telemetry.context.from_headers(self.headers)
                            or telemetry.context.new_trace())
-                shed = False
-                if source.max_queue_depth:
+                shed = source._draining
+                if not shed and source.max_queue_depth:
                     with source._lock:
                         shed = source._n_pending >= source.max_queue_depth
                 if not shed and source.slo is not None:
@@ -151,14 +164,24 @@ class HTTPSource:
                     # fast 503 beats queueing work the budget can't afford
                     shed = source.slo.should_shed()
                 if shed:
+                    # Retry-After is derived from the SLO burn severity
+                    # (fast-window ratio) when an engine is attached:
+                    # clients back off proportionally to the overload
+                    # instead of stampeding back after a fixed second
+                    retry_after = (source.slo.retry_after()
+                                   if source.slo is not None else 1)
                     _m_shed.inc()
                     _m_replies.labels(code="503").inc()
                     with telemetry.context.use(ctx):
                         telemetry.trace.instant(
-                            "http/shed", depth=source.max_queue_depth)
-                    payload = b'{"error": "overloaded, retry later"}'
+                            "http/shed", depth=source.max_queue_depth,
+                            retry_after=retry_after,
+                            draining=source._draining)
+                    payload = (b'{"error": "draining, retry another '
+                               b'replica"}' if source._draining else
+                               b'{"error": "overloaded, retry later"}')
                     self.send_response(503)
-                    self.send_header("Retry-After", "1")
+                    self.send_header("Retry-After", str(retry_after))
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length",
                                      str(len(payload)))
@@ -272,15 +295,33 @@ class HTTPSource:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}/"
 
+    def set_draining(self, draining: bool) -> None:
+        """Flip graceful-drain mode: new requests shed 503 (Retry-After
+        points clients at the surviving replicas) while admitted
+        exchanges run to completion."""
+        self._draining = bool(draining)
+        if draining:
+            log.info("serving source on port %d draining: new requests "
+                     "shed, %d in flight", self.port, self.inflight())
+
+    def inflight(self) -> int:
+        """Admitted exchanges not yet replied (queued + in a batch) —
+        the count graceful drain waits out."""
+        with self._lock:
+            return len(self._inflight)
+
     def health(self) -> dict:
         """The ``GET /healthz`` payload: queue depth, shedding bound,
         uptime, and every circuit breaker's per-target state in this
         process."""
         with self._lock:
             depth = self._n_pending
+            inflight = len(self._inflight)
         out = {"ok": True,
                "uptime_s": round(time.monotonic() - self._t0, 3),
                "queue_depth": depth,
+               "inflight": inflight,
+               "draining": self._draining,
                "max_queue_depth": self.max_queue_depth,
                "breakers": CircuitBreaker.snapshot_all()}
         if self.slo is not None:
@@ -296,6 +337,17 @@ class HTTPSource:
         fleet = fleet_health()
         if fleet is not None:
             out["elastic"] = fleet
+        if self.fleet_state is not None:
+            # the serving-fleet driver surface: every worker's healthz
+            # (warm buckets, breakers, queue depth) aggregated into one
+            # doc, with the autoscaler + reconciler sections — a single
+            # probe shows fleet health
+            try:
+                f = self.fleet_state()
+            except Exception as e:
+                f = {"ok": False, "error": str(e)}
+            out["fleet"] = f
+            out["ok"] = out["ok"] and bool(f.get("ok", True))
         return out
 
     def drain(self, max_rows: int = 1024, timeout: float = 0.05,

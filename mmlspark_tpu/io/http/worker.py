@@ -179,6 +179,21 @@ class WorkerServer:
                         worker.source.respond(str(ex_id), int(code),
                                               str(body))
                     self._json(200, {})
+                elif self.path == "/drain":
+                    # graceful scale-down, step 1: stop admitting. New
+                    # client POSTs shed 503 + Retry-After; everything
+                    # already admitted keeps flowing (the driver keeps
+                    # polling / the local loop keeps serving) until
+                    # /healthz shows inflight == 0 and the reconciler
+                    # retires the process. The fleet parks nothing.
+                    worker.source.set_draining(
+                        bool(req.get("draining", True)))
+                    with worker._lock:
+                        backlog = len(worker._unacked)
+                    self._json(200, {
+                        "draining": worker.source._draining,
+                        "inflight": worker.source.inflight(),
+                        "unacked": backlog})
                 else:
                     self.send_error(404)
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -50,6 +51,17 @@ _m_rendezvous = telemetry.registry.counter(
     "mmlspark_rendezvous_total",
     "re-rendezvous joins completed (coordinator-service restart + "
     "barrier re-entry into a new generation)")
+_m_lease_term = telemetry.registry.gauge(
+    "mmlspark_lease_term",
+    "the leader-lease term this process last observed (bumped by every "
+    "takeover; 0 = no lease yet)")
+_m_lease_renewals = telemetry.registry.counter(
+    "mmlspark_lease_renewals",
+    "leader-lease renewals written by this process as the holder")
+_m_lease_takeovers = telemetry.registry.counter(
+    "mmlspark_lease_takeovers",
+    "leader-lease acquisitions (fresh grants and expired-lease "
+    "takeovers by the lowest-rank fresh host)")
 
 # launcher-agnostic env contract (set by the Spark-executor / TPU-VM launcher)
 ENV_COORDINATOR = "MMLTPU_COORDINATOR"       # "host:port" of process 0
@@ -233,6 +245,196 @@ def rendezvous_coordinator() -> Optional["RendezvousCoordinator"]:
     return _rdzv_coordinator
 
 
+LEASE_DOC = "lease.json"
+ENV_LEASE_TIMEOUT = "MMLTPU_LEASE_TIMEOUT"
+DEFAULT_LEASE_TIMEOUT = 5.0
+
+
+class LeaderLease:
+    """A renewable leader lease over one shared-storage file.
+
+    PR 10's rendezvous made the *generation* race-free but left the
+    *proposer election* racy: "lowest-rank survivor proposes" is a rule
+    each host evaluates from its own heartbeat view, and two hosts with
+    briefly divergent views could both propose — bounded only by
+    last-write-wins on the doc rename. The lease serializes proposals
+    the way production control planes do:
+
+    * ``lease.json`` carries ``{holder, term, seq, time}``. The holder
+      renews it (``seq`` + 1, same ``term``) while it leads; every
+      renewal is an atomic rename, so readers never see a torn doc.
+    * Freshness is judged like PR 10's heartbeats: a reader tracks when
+      the ``(term, seq)`` pair last *advanced on its own monotonic
+      clock* — a skewed writer wall clock can neither fake freshness
+      nor fake expiry. A lease that has not advanced for
+      ``timeout`` seconds (``MMLTPU_LEASE_TIMEOUT``, default 5) is
+      **expired**.
+    * An expired (or absent) lease is taken over with ``term + 1`` by
+      the lowest-rank fresh host (:meth:`RendezvousCoordinator.propose`
+      enforces *who*); the takeover re-reads the file after its rename,
+      so two racing takeovers resolve deterministically — exactly one
+      proceeds, the loser raises and re-enters election as a follower.
+    * A **stale leader can never publish**: its term is behind the
+      file's, so :meth:`renew` refuses, ``propose`` re-validates the
+      lease after the doc rename (a void proposal raises instead of
+      standing), and followers refuse docs stamped with an old
+      ``lease_term`` — the late-proposal race PR 10 bounded with
+      retries is now refused by generation.
+    """
+
+    def __init__(self, directory: str, host_id: str,
+                 timeout: Optional[float] = None):
+        self.directory = directory
+        self.host_id = host_id
+        if timeout is None:
+            timeout = float(os.environ.get(ENV_LEASE_TIMEOUT,
+                                           DEFAULT_LEASE_TIMEOUT))
+        self.timeout = float(timeout)
+        #: the term THIS process last acquired (0 = never held). A
+        #: relaunched process starts at 0 and must re-acquire — its old
+        #: incarnation's file term is someone it can no longer speak for.
+        self.term = 0
+        self._seen: tuple[int, int] = (0, 0)   # last observed (term, seq)
+        self._seen_at = time.monotonic()       # reader clock at last advance
+        self._last_renewal = 0.0
+        self._cache: tuple[float, Optional[dict]] = (0.0, None)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self.directory, LEASE_DOC)
+
+    def read(self) -> Optional[dict]:
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+            if not isinstance(doc.get("term"), int):
+                return None
+            return doc
+        except (OSError, ValueError):
+            return None
+
+    def observe(self, max_age: float = 0.0) -> Optional[dict]:
+        """Read the lease and advance the reader-side freshness clock
+        whenever ``(term, seq)`` moved. Chaos site ``distributed.lease``
+        covers every lease-file round-trip. ``max_age`` > 0 reuses the
+        last read within that window (per-committed-step election must
+        not turn into a per-step shared-FS read)."""
+        if max_age > 0:
+            at, doc = self._cache
+            if time.monotonic() - at < max_age:
+                return doc
+        from ..resilience import faults
+        faults.inject("distributed.lease")
+        doc = self.read()
+        self._cache = (time.monotonic(), doc)
+        if doc is not None:
+            key = (int(doc.get("term", 0)), int(doc.get("seq", 0)))
+            if key != self._seen:
+                self._seen = key
+                self._seen_at = time.monotonic()
+            _m_lease_term.set(key[0])
+        return doc
+
+    def expired(self, max_age: float = 0.0) -> bool:
+        """True when the lease is absent, or its ``(term, seq)`` has not
+        advanced for ``timeout`` seconds of THIS reader's monotonic
+        clock. A reader that just started watching a stale file still
+        waits out one full window — lease semantics require observing
+        the silence, not just old metadata."""
+        if self.observe(max_age=max_age) is None:
+            return True
+        return time.monotonic() - self._seen_at >= self.timeout
+
+    def held(self) -> bool:
+        """True while the file names this process as holder at the term
+        it acquired (a relaunched process, term 0, never holds)."""
+        doc = self.read()
+        return (self.term > 0 and doc is not None
+                and doc.get("holder") == self.host_id
+                and int(doc.get("term", 0)) == self.term)
+
+    def _write(self, term: int, seq: int):
+        os.makedirs(self.directory, exist_ok=True)
+        doc = {"holder": self.host_id, "term": term, "seq": seq,
+               "time": time.time()}
+        # unique tmp per process: racing takeovers must not clobber each
+        # other's tmp files. No fsync before the rename ON PURPOSE (the
+        # heartbeat posture): a lease needs READ atomicity, not crash
+        # durability — a leader that crashes SHOULD lose its lease, and
+        # an fsync per renewal would hammer the shared filesystem.
+        tmp = f"{self.path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        # graftlint: disable=protocol-rename-before-fsync
+        os.replace(tmp, self.path)
+        self._seen = (term, seq)
+        self._seen_at = time.monotonic()
+        self._cache = (self._seen_at, doc)
+
+    def renew(self):
+        """Holder-side keep-alive: bump ``seq`` at the held term. Raises
+        :class:`RendezvousError` when the lease moved on (takeover) —
+        the caller has been deposed and must re-enter election."""
+        from ..resilience import faults
+        faults.inject("distributed.lease")
+        doc = self.read()
+        if (doc is None or doc.get("holder") != self.host_id
+                or int(doc.get("term", 0)) != self.term or self.term == 0):
+            raise RendezvousError(
+                f"{self.host_id} lost the leader lease (now held by "
+                f"{(doc or {}).get('holder')!r} at term "
+                f"{(doc or {}).get('term')})")
+        self._write(self.term, int(doc.get("seq", 0)) + 1)
+        self._last_renewal = time.monotonic()
+        _m_lease_renewals.inc()
+
+    def maybe_renew(self):
+        """Opportunistic holder keep-alive, throttled to a third of the
+        timeout (callers can invoke it per committed step for free)."""
+        if self.term == 0:
+            return
+        if time.monotonic() - self._last_renewal < self.timeout / 3.0:
+            return
+        try:
+            self.renew()
+        except RendezvousError:
+            self.term = 0      # deposed: stop renewing a lost lease
+
+    def acquire(self) -> dict:
+        """Take (over) the lease at ``term + 1``. Refused while another
+        holder is fresh; a write race is resolved by the post-rename
+        re-read — exactly one contender's doc stands."""
+        from ..resilience import faults
+        faults.inject("distributed.lease")
+        doc = self.observe()
+        if (doc is not None and doc.get("holder") != self.host_id
+                and not self.expired()):
+            raise RendezvousError(
+                f"leader lease is held fresh by {doc['holder']!r} (term "
+                f"{doc['term']}); {self.host_id} must not take over")
+        new_term = (int(doc.get("term", 0)) if doc else 0) + 1
+        self._write(new_term, 1)
+        cur = self.read()
+        if (cur is None or cur.get("holder") != self.host_id
+                or int(cur.get("term", 0)) != new_term):
+            raise RendezvousError(
+                f"lease takeover raced: {self.host_id} wrote term "
+                f"{new_term} but the file now holds "
+                f"{(cur or {}).get('holder')!r} at term "
+                f"{(cur or {}).get('term')}")
+        self.term = new_term
+        self._last_renewal = time.monotonic()
+        _m_lease_takeovers.inc()
+        _m_lease_term.set(new_term)
+        telemetry.trace.instant("lease/takeover", holder=self.host_id,
+                                term=new_term)
+        telemetry.flight.note("lease/takeover", holder=self.host_id,
+                              term=new_term)
+        log.warning("leader lease acquired by %s at term %d",
+                    self.host_id, new_term)
+        return cur
+
+
 def _advertised_address() -> str:
     """The address peers can reach THIS host on (the new coordinator
     service binds here after a leader takeover)."""
@@ -385,11 +587,15 @@ class RendezvousCoordinator:
     generations)."""
 
     def __init__(self, directory: str, host_id: str,
-                 init_timeout: Optional[int] = None):
+                 init_timeout: Optional[int] = None,
+                 lease_timeout: Optional[float] = None):
         self.directory = directory
         self.host_id = host_id
         self.generation = 0
         self.ranks: dict[str, int] = {}
+        #: proposals are serialized by a leader lease — see LeaderLease
+        self.lease = LeaderLease(directory, host_id,
+                                 timeout=lease_timeout)
         #: the PROCESS-LEVEL heartbeat beacon (started by
         #: elastic_initialize, reused by the fit coordinator): the host
         #: must never go silent between joining a generation and the fit
@@ -414,20 +620,53 @@ class RendezvousCoordinator:
         except (OSError, ValueError):
             return None
 
+    def elect_leader(self, members, max_age: float = 0.05) -> str:
+        """Lease-aware leader election over ``members``: the fresh lease
+        holder when it is a member, else the lowest-rank member (who
+        will take over the expired/absent lease at propose time)."""
+        members = sorted(members)
+        doc = self.lease.observe(max_age=max_age)
+        if doc is not None and not self.lease.expired(max_age=max_age):
+            holder = doc.get("holder")
+            if holder in members:
+                return holder
+        return members[0] if members else self.host_id
+
     def propose(self, hosts, unwind_at: Optional[tuple] = None) -> dict:
         """Leader-side: mint the next generation over ``hosts`` (ranks
         assigned in sorted host order, so the lowest surviving host is
         rank 0 and carries the restarted coordinator service) and commit
         the doc atomically. ``unwind_at`` tells still-stepping members
         the (epoch, step) after which they must unwind and join —
-        the deterministic grow/evict boundary."""
+        the deterministic grow/evict boundary.
+
+        Proposals are serialized by the leader lease: the fresh holder
+        renews and proposes; an absent/expired lease is taken over by
+        the lowest-rank host of the proposal set; anyone else is
+        refused. After the doc rename the lease is re-validated — a
+        leader deposed mid-proposal raises instead of publishing, and a
+        fresh leader whose doc was overwritten by a stale straggler
+        rewrites it (the straggler cannot renew, so this converges)."""
         from ..resilience import faults
         faults.inject("distributed.rendezvous")
         hosts = sorted(set(hosts))
-        if self.host_id != hosts[0]:
-            raise RendezvousError(
-                f"{self.host_id} proposed a generation but {hosts[0]} is "
-                f"the surviving leader")
+        if self.lease.held():
+            self.lease.renew()
+        else:
+            lease_doc = self.lease.observe()
+            if (lease_doc is not None
+                    and lease_doc.get("holder") != self.host_id
+                    and not self.lease.expired()):
+                raise RendezvousError(
+                    f"{self.host_id} proposed a generation but "
+                    f"{lease_doc['holder']!r} holds a fresh leader lease "
+                    f"(term {lease_doc['term']})")
+            if self.host_id != hosts[0]:
+                raise RendezvousError(
+                    f"{self.host_id} proposed a generation but {hosts[0]} "
+                    f"is the surviving leader (lowest-rank fresh host "
+                    f"takes the expired lease)")
+            self.lease.acquire()
         cur = self.read()
         gen = max(self.generation,
                   cur["generation"] if cur else 0) + 1
@@ -435,22 +674,44 @@ class RendezvousCoordinator:
                "address": f"{_advertised_address()}:{_free_port()}",
                "ranks": {h: i for i, h in enumerate(hosts)},
                "num_processes": len(hosts),
+               "lease_term": self.lease.term,
                "time": time.time()}
         if unwind_at is not None:
             doc["unwind_at"] = list(unwind_at)
         os.makedirs(self.directory, exist_ok=True)
-        # same commit discipline as checkpoints (fsync BEFORE the atomic
-        # rename — lint-enforced by protocol-rename-before-fsync): a
-        # torn rendezvous doc would strand relaunched processes on a
-        # generation that never existed
-        tmp = f"{self.path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        for _attempt in range(8):
+            # same commit discipline as checkpoints (fsync BEFORE the
+            # atomic rename — lint-enforced by
+            # protocol-rename-before-fsync): a torn rendezvous doc would
+            # strand relaunched processes on a generation that never
+            # existed
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            if not self.lease.held():
+                raise RendezvousError(
+                    f"{self.host_id} lost the leader lease during the "
+                    f"proposal; generation {gen} is void (refused by "
+                    f"generation at every follower)")
+            stood = self.read()
+            if (stood is not None
+                    and stood.get("generation") == gen
+                    and stood.get("address") == doc["address"]
+                    and stood.get("lease_term") == self.lease.term):
+                break
+            log.warning("rendezvous doc overwritten by a stale proposal; "
+                        "leaseholder %s rewrites generation %d",
+                        self.host_id, gen)
+        else:
+            raise RendezvousError(
+                f"rendezvous doc for generation {gen} would not stand "
+                f"after 8 rewrites")
         log.warning("rendezvous generation %d proposed: %d host(s) %s at "
-                    "%s", gen, len(hosts), hosts, doc["address"])
+                    "%s (lease term %d)", gen, len(hosts), hosts,
+                    doc["address"], self.lease.term)
         return doc
 
     def await_membership(self, min_generation: int,
@@ -467,6 +728,15 @@ class RendezvousCoordinator:
         deadline = time.monotonic() + timeout
         while True:
             doc = self.read()
+            if doc is not None and "lease_term" in doc:
+                # a stale leader's LATE proposal: stamped with a lease
+                # term the fleet has moved past — refused by generation
+                # (the fresh leaseholder rewrites the doc; keep polling)
+                lease_doc = self.lease.read()
+                if (lease_doc is not None
+                        and int(doc["lease_term"])
+                        < int(lease_doc.get("term", 0))):
+                    doc = None
             if (doc and doc["generation"] >= min_generation
                     and self.host_id in doc.get("ranks", {})):
                 return doc

@@ -27,7 +27,15 @@ the trainer:
                         window, restart-vs-shrink decisions) and
                         :class:`ElasticFitCoordinator` (re-mesh over
                         surviving hosts + consensus-checkpoint resume —
-                        a fit survives a preempted host).
+                        a fit survives a preempted host);
+  * :mod:`autoscale`  — :class:`ServingAutoscaler`: the SLO engine's
+                        burn verdicts drive serving-fleet GROW, sustained
+                        idle drives SHRINK, with hysteresis windows +
+                        cooldown and min/max floors;
+  * :mod:`reconciler` — :class:`FleetReconciler`: the k8s-operator-shaped
+                        loop converging desired vs observed workers
+                        (heal into the same lineage, spawn warm from
+                        bundles, graceful drain on scale-down).
 
 Everything reports through :mod:`mmlspark_tpu.telemetry` (retry counters,
 breaker-state gauges, injected-fault counters, restart counters); see
@@ -37,14 +45,17 @@ docs/reliability.md.
 from __future__ import annotations
 
 from . import ckpt, faults
+from .autoscale import ServingAutoscaler
 from .ckpt import AsyncCheckpointWriter
 from .elastic import (ElasticFitCoordinator, ElasticFleetLost,
                       HostHeartbeat, HostLossError, HostRejoinError,
                       TrainSupervisor)
 from .policy import BreakerOpen, CircuitBreaker, RetryPolicy
+from .reconciler import FleetReconciler
 from .supervisor import FleetSupervisor
 
 __all__ = ["faults", "ckpt", "BreakerOpen", "CircuitBreaker",
            "RetryPolicy", "FleetSupervisor", "TrainSupervisor",
            "ElasticFitCoordinator", "ElasticFleetLost", "HostHeartbeat",
-           "HostLossError", "HostRejoinError", "AsyncCheckpointWriter"]
+           "HostLossError", "HostRejoinError", "AsyncCheckpointWriter",
+           "ServingAutoscaler", "FleetReconciler"]

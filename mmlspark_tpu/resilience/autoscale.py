@@ -1,0 +1,248 @@
+"""SLO-burn-driven autoscaling verdicts for the serving fleet.
+
+Elasticity used to stop at training (`resilience/elastic.py`): the
+serving fleet could *heal* (supervisor restarts) but not *scale*, so a
+burst it could absorb by growing was shed as 503s instead. This module
+closes ROADMAP item 2 the way production TPU serving does it (PAPERS.md
+arxiv 2605.25645): the :class:`~..telemetry.slo.SLOEngine`'s burn
+verdicts on latency/goodput objectives ARE the scaling signal.
+
+:class:`ServingAutoscaler` evaluates the engine each tick and turns
+sustained pressure into verdicts written to the
+:class:`~.reconciler.FleetReconciler`'s desired replica count:
+
+* **GROW** — a watched objective (by default every ``latency`` /
+  ``goodput`` objective) in **breach** continuously for ``grow_window``
+  seconds adds one replica. The reconciler spawns it through the
+  supervisor respawn machinery; with ``--bundle`` workers it comes up
+  warm (zero live-traffic compiles).
+* **SHRINK** — every watched objective **ok** AND driver-observed load
+  under ``idle_rows_per_worker`` rows/s/worker continuously for
+  ``shrink_window`` seconds removes one replica, by graceful drain
+  (the worker stops admitting, finishes its in-flight exchanges, then
+  exits; nothing is parked).
+* **Hysteresis** — the windows are separate (grow fast, shrink slow)
+  and every verdict opens a ``cooldown`` during which NO verdict fires:
+  a burn that recovers inside the cooldown produces nothing, and a
+  square-wave load can force at most one transition per cooldown
+  window. ``min_workers``/``max_workers`` floor and cap the fleet.
+
+Verdicts pass chaos site ``autoscale.verdict`` — an injected fault
+skips (and counts) that tick's verdict without killing the loop; the
+pressure trackers keep accumulating, so the verdict fires next tick.
+
+``tick(now=...)`` is deterministic (tests drive it with the same
+synthetic clock they tick the sampler with); :meth:`start` runs it on a
+daemon thread. :meth:`state` is the ``autoscale`` section of the
+fleet-level ``/healthz`` doc.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from .. import telemetry
+from ..core.utils import get_logger
+from . import faults
+
+log = get_logger("resilience.autoscale")
+
+_m_verdicts = telemetry.registry.counter(
+    "mmlspark_autoscale_verdicts",
+    "grow/shrink verdicts applied to the desired replica count",
+    labels=("verdict",))
+_m_verdicts_skipped = telemetry.registry.counter(
+    "mmlspark_autoscale_verdicts_skipped",
+    "verdicts skipped by an injected fault at autoscale.verdict "
+    "(re-issued on a later tick while the pressure persists)")
+_m_load = telemetry.registry.gauge(
+    "mmlspark_autoscale_load_rows_per_worker",
+    "driver-observed arrival rate per capacity worker (the SHRINK "
+    "side's idle signal)")
+_m_state = telemetry.registry.gauge(
+    "mmlspark_autoscale_state",
+    "autoscaler pressure: 0 steady, 1 grow pressure accumulating, "
+    "-1 shrink pressure accumulating, 2 in post-verdict cooldown")
+
+
+class ServingAutoscaler:
+    """Burn verdicts -> desired replicas, with hysteresis.
+
+    ``slo`` is a started-or-not :class:`SLOEngine` (the autoscaler calls
+    ``evaluate(now)`` itself each tick — don't also ``start()`` the
+    engine); ``reconciler`` receives ``set_desired`` writes.
+    ``objectives`` restricts the watched set by name (default: every
+    ``latency`` / ``goodput`` objective). ``load_fn() -> rows/s`` totals
+    fleet arrivals for the idle signal; the default derives it from the
+    source's offset-log advancement between ticks."""
+
+    def __init__(self, slo, reconciler, *,
+                 grow_window: float = 1.0, shrink_window: float = 10.0,
+                 cooldown: float = 5.0,
+                 idle_rows_per_worker: float = 1.0,
+                 objectives: Optional[Iterable[str]] = None,
+                 load_fn: Optional[Callable[[], float]] = None,
+                 interval: float = 0.5):
+        if grow_window <= 0 or shrink_window <= 0 or cooldown < 0:
+            raise ValueError("windows must be > 0 and cooldown >= 0")
+        self.slo = slo
+        self.reconciler = reconciler
+        self.grow_window = float(grow_window)
+        self.shrink_window = float(shrink_window)
+        self.cooldown = float(cooldown)
+        self.idle_rows_per_worker = float(idle_rows_per_worker)
+        names = {o.name for o in slo.objectives}
+        if objectives is not None:
+            objectives = list(objectives)
+            unknown = [n for n in objectives if n not in names]
+            if unknown:
+                raise ValueError(f"autoscaler watches unknown "
+                                 f"objective(s) {unknown} (engine has "
+                                 f"{sorted(names)})")
+            self.objectives = objectives
+        else:
+            self.objectives = [o.name for o in slo.objectives
+                               if o.kind in ("latency", "goodput")]
+        if not self.objectives:
+            raise ValueError("no latency/goodput objectives to scale on "
+                             "(pass objectives=[...] explicitly)")
+        self.load_fn = load_fn
+        self.interval = float(interval)
+        self._breach_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._cooldown_until = 0.0
+        self._last_verdict: Optional[str] = None
+        self._last_offset: Optional[tuple[float, int]] = None
+        self._load = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-autoscaler")
+
+    # ------------------------------------------------------------- signals
+    def _observe_load(self, now: float) -> Optional[float]:
+        """Rows/s per capacity worker since the last tick (None until
+        two observations exist)."""
+        if self.load_fn is not None:
+            total = float(self.load_fn())
+        else:
+            src = self.reconciler.source
+            offset = int(src._offset)
+            prev = self._last_offset
+            self._last_offset = (now, offset)
+            if prev is None or now <= prev[0]:
+                return None
+            total = max(0, offset - prev[1]) / (now - prev[0])
+        per = total / max(1, self.reconciler.observed())
+        _m_load.set(per)
+        return per
+
+    # ------------------------------------------------------------ verdicts
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One evaluation pass; returns the applied verdict (``"grow"``
+        / ``"shrink"`` / None). ``now`` drives BOTH the SLO evaluation
+        and the hysteresis clocks, so tests replay scenarios exactly."""
+        t = time.time() if now is None else float(now)
+        self._now = t
+        state = self.slo.evaluate(now=t)
+        watched = {n: state[n] for n in self.objectives if n in state}
+        breach = any(r["state"] == "breach" for r in watched.values())
+        calm = all(r["state"] == "ok" for r in watched.values())
+        self._load = load = self._observe_load(t)
+        desired = self.reconciler.desired
+        # pressure accumulation (tracked even through cooldown: a burn
+        # that OUTLIVES the cooldown fires the moment it ends, but one
+        # that recovers inside it leaves no trace)
+        if breach and desired < self.reconciler.max_workers:
+            if self._breach_since is None:
+                self._breach_since = t
+        else:
+            self._breach_since = None
+        idle = (calm and load is not None
+                and load < self.idle_rows_per_worker)
+        if idle and desired > self.reconciler.min_workers:
+            if self._idle_since is None:
+                self._idle_since = t
+        else:
+            self._idle_since = None
+        verdict = None
+        if t >= self._cooldown_until:
+            if (self._breach_since is not None
+                    and t - self._breach_since >= self.grow_window):
+                verdict = "grow"
+            elif (self._idle_since is not None
+                    and t - self._idle_since >= self.shrink_window):
+                verdict = "shrink"
+        _m_state.set(2 if t < self._cooldown_until
+                     else 1 if self._breach_since is not None
+                     else -1 if self._idle_since is not None else 0)
+        if verdict is None:
+            return None
+        try:
+            faults.inject("autoscale.verdict")
+        except Exception:
+            # the verdict is skipped, not lost: pressure keeps
+            # accumulating and the next clean tick re-issues it
+            _m_verdicts_skipped.inc()
+            return None
+        new = desired + (1 if verdict == "grow" else -1)
+        applied = self.reconciler.set_desired(new)
+        self._cooldown_until = t + self.cooldown
+        self._breach_since = None
+        self._idle_since = None
+        self._last_verdict = verdict
+        _m_verdicts.labels(verdict=verdict).inc()
+        burns = {n: r["burn_fast"] for n, r in watched.items()}
+        if verdict == "grow":
+            telemetry.trace.instant("autoscale/grow", desired=applied,
+                                    load_per_worker=load)
+        else:
+            telemetry.trace.instant("autoscale/shrink", desired=applied,
+                                    load_per_worker=load)
+        telemetry.flight.note(f"autoscale/{verdict}", desired=applied,
+                              burns={k: (v if isinstance(v, (int, float))
+                                         and math.isfinite(v) else "inf")
+                                     for k, v in burns.items()})
+        log.warning("autoscale %s verdict: desired -> %d (burns %s, "
+                    "load/worker %s)", verdict, applied, burns,
+                    None if load is None else round(load, 2))
+        return verdict
+
+    def state(self) -> dict:
+        """The ``autoscale`` section of the fleet-level healthz doc.
+        Durations are measured against the LAST tick's clock, so
+        synthetic-clock tests read consistent numbers."""
+        now = getattr(self, "_now", time.time())
+        return {"desired": self.reconciler.desired,
+                "objectives": list(self.objectives),
+                "grow_window_s": self.grow_window,
+                "shrink_window_s": self.shrink_window,
+                "cooldown_s": self.cooldown,
+                "cooldown_remaining_s": round(
+                    max(0.0, self._cooldown_until - now), 3),
+                "breach_for_s": (None if self._breach_since is None
+                                 else round(now - self._breach_since, 3)),
+                "idle_for_s": (None if self._idle_since is None
+                               else round(now - self._idle_since, 3)),
+                "load_rows_per_worker": self._load,
+                "last_verdict": self._last_verdict}
+
+    # ----------------------------------------------------------- lifecycle
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # a verdict bug must not kill the loop
+                log.warning("autoscaler tick failed: %s", e)
+            self._stop.wait(self.interval)
+
+    def start(self) -> "ServingAutoscaler":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)

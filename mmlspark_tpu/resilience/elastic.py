@@ -1024,8 +1024,11 @@ class ElasticFitCoordinator:
         return doc
 
     def _is_leader(self) -> bool:
-        return bool(self._mesh_hosts) \
-            and self._rdzv.host_id == min(self._mesh_hosts)
+        """Lease-aware: the fresh leaseholder leads; an expired/absent
+        lease falls back to the lowest-rank mesh host (who takes the
+        lease over at propose time)."""
+        return bool(self._mesh_hosts) and self._rdzv.host_id \
+            == self._rdzv.elect_leader(self._mesh_hosts)
 
     def check_rendezvous(self, epoch: int, step: int):
         """Multi-process fleets only (single-process fits no-op): the
@@ -1043,6 +1046,9 @@ class ElasticFitCoordinator:
         doc = self._read_rdzv_doc()
         if (doc is None or doc["generation"] <= rdzv.generation) \
                 and self._is_leader():
+            # hold leadership while the fit runs: a renewed lease keeps
+            # followers from taking over between membership changes
+            rdzv.lease.maybe_renew()
             grow = self.pending_grow()
             evict = self.pending_evict()
             if grow or evict:
@@ -1588,7 +1594,10 @@ class ElasticFitCoordinator:
                 hb.set_joining(True)
                 return rdzv.await_membership(rdzv.generation + 1)
             if len(members) >= self.min_hosts:
-                if host_id == members[0]:
+                # lease-aware election: the fresh leaseholder proposes;
+                # an expired lease is taken over by the lowest-rank
+                # fresh member (members only contains fresh hosts)
+                if host_id == rdzv.elect_leader(members, max_age=0.0):
                     return rdzv.propose(members)
                 # follower: wait as long as the leader might (it may be
                 # holding for quorum before proposing)

@@ -29,13 +29,15 @@ are independent and the whole run is reproducible. Injection sites call
 :func:`inject` — one function call + module-bool check when disabled.
 
 Registered sites (see docs/reliability.md): ``fleet.poll``,
-``fleet.respond``, ``fleet.transform``, ``serving.transform``,
+``fleet.respond``, ``fleet.transform``, ``fleet.spawn``,
+``fleet.drain``, ``serving.transform``,
 ``serving.batch``, ``serving.bundle_load``,
 ``http.request``, ``http.debug``, ``powerbi.post``, ``dataplane.put``,
 ``dataplane.allgather``, ``trainer.step``, ``supervisor.probe``,
 ``supervisor.heartbeat``, ``supervisor.rejoin``, ``elastic.step``,
-``elastic.remesh``, ``elastic.evict``, ``distributed.rendezvous``,
-``ckpt.write``, ``ckpt.rename``, ``ckpt.shard``, ``downloader.fetch``,
+``elastic.remesh``, ``elastic.evict``, ``autoscale.verdict``,
+``distributed.rendezvous``, ``distributed.lease``, ``ckpt.write``,
+``ckpt.rename``, ``ckpt.shard``, ``downloader.fetch``,
 ``codegen.write``.
 """
 
@@ -64,12 +66,14 @@ KINDS = ("error", "delay")
 #: :func:`configure` warns when a chaos spec names a site not listed
 #: here — a typo'd site would otherwise inject nothing, silently.
 SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
+         "fleet.spawn", "fleet.drain",
          "serving.transform", "serving.batch", "serving.bundle_load",
          "http.request", "http.debug",
          "powerbi.post", "dataplane.put", "dataplane.allgather",
          "trainer.step", "supervisor.probe", "supervisor.heartbeat",
          "supervisor.rejoin", "elastic.step", "elastic.remesh",
-         "elastic.evict", "distributed.rendezvous", "ckpt.write",
+         "elastic.evict", "autoscale.verdict",
+         "distributed.rendezvous", "distributed.lease", "ckpt.write",
          "ckpt.rename", "ckpt.shard", "downloader.fetch",
          "codegen.write")
 

@@ -1,0 +1,277 @@
+"""A k8s-operator-shaped reconciler loop for the serving fleet.
+
+The orchestration adapter ROADMAP item 2 calls for, simulated in-tree
+the way multi-host fleets already are: one loop owns **desired vs
+observed** worker state and converges the difference every tick, the
+way a Deployment controller converges replicas (PAPERS.md arxiv
+1810.08744 motivates the one-control-plane-over-many-engines shape).
+
+* **Desired** is a replica count — written by the operator or by the
+  :class:`~.autoscale.ServingAutoscaler`'s grow/shrink verdicts
+  (``set_desired``); clamped to ``[min_workers, max_workers]``.
+* **Observed** is the fleet's live capacity: workers that are alive and
+  not draining.
+* **Converge** each tick:
+
+  1. *heal* — the embedded :class:`~.supervisor.FleetSupervisor` tick
+     (health probes, exponential-backoff respawn): a kill -9'd worker is
+     relaunched **into the same slot** — same ports, same
+     ``extra_argv`` (``--bundle`` included, so the fresh incarnation
+     answers warm) — the serving fleet's "same rendezvous lineage";
+  2. *drain progress* — draining workers are retired the moment
+     :meth:`~...io.http.fleet.ProcessHTTPSource.drainComplete` holds
+     (nothing in flight anywhere: zero loss by construction), or
+     force-retired past ``drain_timeout`` / on mid-drain death (their
+     clients died with them);
+  3. *scale up* — capacity below desired spawns workers through the
+     same respawn machinery (chaos site ``fleet.spawn``), preferring
+     retired slots (a shrink followed by a grow resurrects the same
+     lineage) before appending fresh ones;
+  4. *scale down* — capacity above desired begins a graceful drain of
+     the highest-index workers (chaos site ``fleet.drain`` inside the
+     control round-trip): they shed new requests, finish what they
+     admitted, then exit. The fleet parks nothing.
+
+:meth:`state` is the ``reconciler`` section of the driver's fleet-level
+``/healthz`` doc (:func:`fleet_doc`)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from .. import telemetry
+from ..core.utils import get_logger
+from . import faults
+from .supervisor import FleetSupervisor
+
+log = get_logger("resilience.reconciler")
+
+_m_desired = telemetry.registry.gauge(
+    "mmlspark_autoscale_desired_workers",
+    "serving replicas the control plane wants (autoscaler verdicts / "
+    "operator set_desired, clamped to the min/max floors)")
+_m_observed = telemetry.registry.gauge(
+    "mmlspark_autoscale_observed_workers",
+    "serving replicas actually providing capacity (alive, not draining)")
+_m_spawns = telemetry.registry.counter(
+    "mmlspark_autoscale_spawns",
+    "workers spawned by the reconciler converging desired > observed")
+_m_spawn_failures = telemetry.registry.counter(
+    "mmlspark_autoscale_spawn_failures",
+    "reconciler spawn attempts that failed (retried next tick)")
+_m_drains = telemetry.registry.counter(
+    "mmlspark_autoscale_drains",
+    "graceful drains begun by the reconciler converging desired < "
+    "observed")
+
+
+def default_spawn_factory(host: str = "127.0.0.1",
+                          max_queue_depth: int = 0,
+                          extra_argv: tuple = ()) -> Callable:
+    """The subprocess spawn/respawn callable: ``(wi, old) -> _Worker``.
+    With ``old`` it rebinds the old incarnation's ports and serving
+    flags (the supervisor-respawn contract — same lineage); without, it
+    spawns a fresh worker on kernel-assigned ports. ``extra_argv``
+    (e.g. ``("--bundle", dir)``) makes every spawned worker come up
+    warm from the AOT bundle."""
+    def spawn(wi: int, old):
+        from ..io.http.fleet import _Worker
+        if old is not None:
+            try:
+                old.kill()   # reap; no-op for never-spawned handles
+            except Exception:
+                pass
+            return _Worker(old.host, old.port, old.control, spawn=True,
+                           extra_argv=getattr(old, "extra_argv", ())
+                           or tuple(extra_argv))
+        return _Worker(host, 0, 0, spawn=True,
+                       max_queue_depth=max_queue_depth,
+                       extra_argv=tuple(extra_argv))
+    return spawn
+
+
+class FleetReconciler:
+    """Desired-vs-observed convergence over a ``ProcessHTTPSource``.
+
+    ``spawn(wi, old_or_None) -> worker`` is the single worker factory —
+    shared with the embedded supervisor's respawn, so healing and
+    scaling produce identical incarnations (in-process chaos tests
+    substitute WorkerServer factories). ``supervise=False`` skips the
+    embedded supervisor (a caller that already runs one)."""
+
+    def __init__(self, source, replicas: int,
+                 spawn: Optional[Callable] = None,
+                 min_workers: int = 1, max_workers: int = 8,
+                 interval: float = 0.25, drain_timeout: float = 10.0,
+                 supervise: bool = True,
+                 probe_interval: float = 0.25,
+                 extra_argv: tuple = ()):
+        if not 1 <= min_workers <= max_workers:
+            raise ValueError(f"need 1 <= min_workers <= max_workers, got "
+                             f"({min_workers}, {max_workers})")
+        self.source = source
+        self.min_workers = int(min_workers)
+        self.max_workers = int(max_workers)
+        self.interval = float(interval)
+        self.drain_timeout = float(drain_timeout)
+        self.spawn = spawn or default_spawn_factory(extra_argv=extra_argv)
+        self.supervisor = (FleetSupervisor(source,
+                                           probe_interval=probe_interval,
+                                           respawn=self.spawn)
+                           if supervise else None)
+        self._desired = self._clamp(replicas)
+        self._drain_started: dict[int, float] = {}
+        self._last_error: Optional[str] = None
+        self._converged_at: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="fleet-reconciler")
+        _m_desired.set(self._desired)
+
+    def _clamp(self, n: int) -> int:
+        return max(self.min_workers, min(self.max_workers, int(n)))
+
+    # ---- the control-plane write surface ----
+    @property
+    def desired(self) -> int:
+        return self._desired
+
+    def set_desired(self, replicas: int) -> int:
+        """Write the desired replica count (the autoscaler's verdict
+        sink); the loop converges toward it. Returns the clamped value."""
+        n = self._clamp(replicas)
+        if n != self._desired:
+            log.info("desired replicas %d -> %d", self._desired, n)
+        self._desired = n
+        _m_desired.set(n)
+        return n
+
+    # ---- observation ----
+    def capacity_slots(self) -> list:
+        """Indices of workers currently providing capacity."""
+        return [wi for wi, w in enumerate(self.source.workers)
+                if w.alive and not w.draining and not w.retired]
+
+    def observed(self) -> int:
+        return len(self.capacity_slots())
+
+    def converged(self) -> bool:
+        return (self.observed() == self._desired
+                and not self._drain_started)
+
+    # ---- convergence ----
+    def _spawn_into(self, wi: Optional[int], now: float) -> bool:
+        """One spawn attempt (``wi`` = retired/dead slot to resurrect,
+        None = append a fresh worker). Failures are counted and retried
+        next tick — a flapping spawn path must not kill the loop."""
+        try:
+            faults.inject("fleet.spawn")
+            if wi is not None:
+                nw = self.spawn(wi, self.source.workers[wi])
+                self.source.restoreWorker(wi, worker=nw,
+                                          resurrected=False)
+            else:
+                nw = self.spawn(len(self.source.workers), None)
+                wi = self.source.addWorker(nw)
+            _m_spawns.inc()
+            telemetry.trace.instant("fleet/spawn", worker=wi,
+                                    port=nw.port)
+            telemetry.flight.note("fleet/spawn", worker=wi, port=nw.port)
+            self._last_error = None
+            return True
+        except Exception as e:
+            _m_spawn_failures.inc()
+            self._last_error = f"spawn: {e}"
+            log.warning("reconciler spawn failed (retried next tick): %s",
+                        e)
+            return False
+
+    def tick(self, now: Optional[float] = None):
+        """One reconcile pass (public: deterministic tests drive it
+        directly instead of sleeping against the thread)."""
+        now = time.monotonic() if now is None else now
+        if self.supervisor is not None:
+            self.supervisor.tick()
+        # 1. progress draining workers toward retirement
+        for wi, w in enumerate(list(self.source.workers)):
+            if not w.draining:
+                self._drain_started.pop(wi, None)
+                continue
+            started = self._drain_started.setdefault(wi, now)
+            done = False
+            if not w.alive:
+                done = True        # died mid-drain: its clients are gone
+            else:
+                try:
+                    done = self.source.drainComplete(wi)
+                except Exception as e:
+                    self._last_error = f"drain probe: {e}"
+            if done or now - started >= self.drain_timeout:
+                if not done:
+                    log.warning("worker %d force-retired after %.1fs "
+                                "drain timeout", wi, self.drain_timeout)
+                self.source.retireWorker(wi)
+                self._drain_started.pop(wi, None)
+        # 2. converge capacity toward desired
+        capacity = self.capacity_slots()
+        desired = self._desired
+        # dead non-retired slots are the supervisor's backoff-governed
+        # healing in progress: count them as pending capacity, or a
+        # grow verdict during a heal would overshoot and then drain
+        healing = sum(1 for w in self.source.workers
+                      if not w.alive and not w.retired and not w.draining)
+        if len(capacity) + healing < desired:
+            # prefer resurrecting retired slots (same lineage) over
+            # appending new ones
+            free = [wi for wi, w in enumerate(self.source.workers)
+                    if w.retired]
+            for _ in range(desired - len(capacity) - healing):
+                slot = free.pop(0) if free else None
+                if not self._spawn_into(slot, now):
+                    break           # retry the rest next tick
+        elif len(capacity) > desired:
+            for wi in sorted(capacity, reverse=True)[
+                    :len(capacity) - desired]:
+                self.source.beginDrain(wi)
+                if self.source.workers[wi].draining:
+                    self._drain_started[wi] = now
+                    _m_drains.inc()
+        observed = self.observed()
+        _m_observed.set(observed)
+        if observed == desired and not self._drain_started:
+            if self._converged_at is None:
+                self._converged_at = now
+        else:
+            self._converged_at = None
+
+    def state(self) -> dict:
+        """The ``reconciler`` section of the fleet-level healthz doc."""
+        return {"desired": self._desired,
+                "observed": self.observed(),
+                "min_workers": self.min_workers,
+                "max_workers": self.max_workers,
+                "draining": sorted(self._drain_started),
+                "retired": [wi for wi, w in
+                            enumerate(self.source.workers) if w.retired],
+                "converged": self.converged(),
+                "last_error": self._last_error}
+
+    # ---- lifecycle ----
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:   # a converge bug must not kill the loop
+                log.warning("reconciler tick failed: %s", e)
+            self._stop.wait(self.interval)
+
+    def start(self) -> "FleetReconciler":
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)

@@ -187,6 +187,12 @@ class FleetSupervisor:
         directly instead of sleeping against the thread)."""
         now = time.monotonic()
         for wi, w in enumerate(list(self.source.workers)):
+            # draining / retired workers belong to the reconciler's
+            # scale-down lifecycle: healing one would respawn capacity
+            # the autoscaler just decided to shed
+            if getattr(w, "retired", False) or getattr(w, "draining",
+                                                       False):
+                continue
             if getattr(w, "alive", False):
                 if self._process_exited(w) or (
                         not self._healthy(w) and w.probably_dead()):

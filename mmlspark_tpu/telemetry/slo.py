@@ -360,6 +360,24 @@ class SLOEngine:
             return any(self._states.get(o.name) == "breach"
                        for o in self.objectives if o.shed_on_breach)
 
+    def retry_after(self, base: float = 1.0, cap: float = 30.0) -> int:
+        """Severity-proportional client backoff for shed 503s: the
+        Retry-After seconds scale with the worst FAST-window burn rate
+        among breached ``shed_on_breach`` objectives (burn 3.0 = clients
+        told to stay away 3x longer), clamped to ``cap``. With nothing
+        burning it degrades to ``base`` — the static value queue-bound
+        shedding always used."""
+        with self._lock:
+            burns = [self._last.get(o.name, {}).get("burn_fast", 0.0)
+                     for o in self.objectives
+                     if o.shed_on_breach
+                     and self._states.get(o.name) == "breach"]
+        worst = max((b for b in burns if isinstance(b, (int, float))),
+                    default=0.0)
+        if not math.isfinite(worst):
+            return int(cap)
+        return int(min(cap, max(base, math.ceil(base * worst))))
+
     def healthz(self) -> dict:
         """Compact dict embedded in every ``GET /healthz`` payload."""
         with self._lock:
