@@ -616,6 +616,94 @@ def serving_scenario():
         source.close()
 
 
+def pipeline_fused_scenario():
+    """Cross-stage XLA fusion (core/capture.py): a 3-stage impute →
+    assemble → predict PipelineModel scored as the staged per-stage
+    chain vs ONE fused program. Reports wall time for both, plus the
+    dispatch-count and boundary-transfer-bytes deltas the fusion
+    refactor exists to shrink (N dispatches → number-of-segments;
+    intra-segment transfer bytes → 0). Parity is asserted before any
+    number is published."""
+    import jax
+    from mmlspark_tpu import DataFrame, Pipeline
+    from mmlspark_tpu.core import capture as capturelib
+    from mmlspark_tpu.models.classical import LogisticRegression
+    from mmlspark_tpu.stages.basic import FastVectorAssembler
+    from mmlspark_tpu.stages.data_stages import CleanMissingData
+
+    if jax.default_backend() == "cpu":
+        n, d, repeats = 50_000, 16, 5
+    else:
+        n, d, repeats = 1_000_000, 64, 5
+    rng = np.random.default_rng(0)
+    cols = {f"f{i}": rng.normal(size=n) for i in range(d)}
+    for i in range(0, d, 3):
+        cols[f"f{i}"][::11] = np.nan
+    y = (cols["f1"] > 0).astype(np.int64)
+    df = DataFrame({**cols, "label": y})
+    feats = [f"f{i}" for i in range(d)]
+    pm = Pipeline().setStages((
+        CleanMissingData().setInputCols(feats),
+        FastVectorAssembler().setInputCols(feats).setOutputCol("features"),
+        LogisticRegression().setMaxIter(20),
+    )).fit(df)
+
+    def _t(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    def timed(fn):
+        fn()                            # warm (compiles)
+        return min(_t(fn) for _ in range(repeats))
+
+    pm.setFusePipeline(False)
+    staged_probs = np.stack(list(pm.transform(df).col("probability")))
+    staged_s = timed(lambda: pm.transform(df))
+    pm.setFusePipeline(True)
+    from mmlspark_tpu import telemetry
+    was_enabled = telemetry.enabled()
+    telemetry.enable()      # the transfer-bytes counters are the point
+    try:
+        tb = capturelib._m_transfer
+        in0 = tb.labels(direction="in").value
+        out0 = tb.labels(direction="out").value
+        fused_probs = np.stack(list(pm.transform(df).col("probability")))
+        in1 = tb.labels(direction="in").value
+        out1 = tb.labels(direction="out").value
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    fused_s = timed(lambda: pm.transform(df))
+    # never publish numbers for a fused path that lost parity
+    err = float(np.abs(fused_probs - staged_probs).max())
+    assert err <= 1e-4, f"fused pipeline parity broke: {err}"
+    (entry,) = pm._seg_cache.values()
+    pf = entry["pf"]
+    assert pf.compiles == 1, pf.compiles   # ONE program for all 3 stages
+    cfg = (f"{n} rows x {d} cols, impute->assemble->LR, "
+           f"{len(pm.getStages())} stages -> 1 segment")
+    out = [_with_baseline({
+               "metric": "pipeline_fused_seconds",
+               "value": round(fused_s, 4), "unit": "s",
+               "vs_baseline": None,
+               "speedup_vs_staged": round(staged_s / fused_s, 2),
+               "segment_compiles": pf.compiles,
+               "fused_dispatches_per_transform": 1,
+               "staged_dispatches_per_transform": len(pm.getStages()),
+               "boundary_bytes_in": int(in1 - in0),
+               "boundary_bytes_out": int(out1 - out0),
+               "max_abs_err_vs_staged": err,
+               "config": cfg}),
+           _with_baseline({
+               "metric": "pipeline_staged_seconds",
+               "value": round(staged_s, 4), "unit": "s",
+               "vs_baseline": None, "config": cfg})]
+    for r in out:
+        print(json.dumps(r))
+    return out
+
+
 def loader_scenario():
     """Data-ingest throughput: disk -> threaded JPEG decode/resize ->
     staging -> device (the bench_loader.py pipeline at suite scale).
@@ -675,6 +763,7 @@ def suite(profile: bool = False):
                   lambda: [main(profile=profile, mixed=True)]),
                  ("gbdt", gbdt_scenario),
                  ("gbdt_predict_quant", gbdt_predict_quant_scenario),
+                 ("pipeline_fused", pipeline_fused_scenario),
                  ("serving", serving_scenario),
                  ("loader", loader_scenario))
     scen_out: dict = {}

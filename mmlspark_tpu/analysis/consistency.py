@@ -18,6 +18,16 @@
   from the live Param registry (Python signatures are the single source
   of truth). Import-based; disable with ``options={"codegen": False}``
   (fixture projects) or ``--no-codegen``.
+* ``pipeline-capture-coverage`` — every concrete ``Transformer`` whose
+  ``transform`` (transitively) dispatches a jitted/pjit computation must
+  either expose a ``capture()`` (the cross-stage fusion entry point,
+  core/capture.py) or carry the explicit ``_uncapturable = True``
+  marker, so the fused pipeline path can distinguish "host-only by
+  design" from "capture forgotten". Dispatch is an interprocedural
+  fixed point over jit-bound names (``x = jax.jit(...)``, jit-decorated
+  defs, ``profiler.wrap``), excluding delegation through the stage
+  algebra's own ``transform``/``fit`` edges (composition stages like
+  Timer defer the obligation to their inner stages).
 
 Chaos-coverage rules (a fault-injection framework only pays for itself
 when every recovery path it guards is actually rehearsed):
@@ -465,6 +475,219 @@ def check_chaos_test_coverage(project: Project) -> Iterable[Finding]:
                  "(faults.configure(f'{site}:error:1.0')) and asserts "
                  "the recovery behavior",
             context="SITES")
+        if f:
+            yield f
+
+
+# ------------------------------------------------- pipeline capture coverage
+
+#: constructing/holding one of these means device computation is being
+#: compiled — a transform reaching one dispatches a jitted program
+_CC_JIT_WRAPPERS = {
+    "jax.jit", "jit", "jax.pjit", "pjit", "jax.shard_map", "shard_map",
+    "jax.experimental.shard_map.shard_map", "profiler.wrap",
+    "telemetry.profiler.wrap", "ProfiledFunction"}
+#: stage-algebra method names excluded from call-graph propagation: a
+#: stage delegating to an INNER stage's transform (Timer, adapters,
+#: PipelineModel) is a composition point — the inner stage carries its
+#: own capture obligation
+_CC_NO_PROPAGATE = {"transform", "fit", "__call__", "capture"}
+_CC_STAGE_BASES = {"Transformer", "Model", "UnaryTransformer"}
+#: the core contract classes whose default capture()/_uncapturable must
+#: NOT satisfy the rule for subclasses
+_CC_CORE_BASES = _CC_STAGE_BASES | {"PipelineStage"}
+
+
+class _CCFunc:
+    __slots__ = ("sf", "node", "name", "direct", "calls", "nested")
+
+    def __init__(self, sf, node, name, direct, calls, nested):
+        self.sf = sf
+        self.node = node
+        self.name = name
+        self.direct = direct
+        self.calls = calls
+        #: defined inside another function — invoked only locally, so it
+        #: never participates in cross-function by-name propagation
+        #: (generic names like `fn` / a jitted nested `run` would
+        #: otherwise taint every caller of ANY `fn`/`run`)
+        self.nested = nested
+
+
+def _cc_scan_file(sf: SourceFile):
+    """(functions, classes, jit-bound names) of one module.
+
+    jit-bound names: assignment targets whose value is a jit/pjit/
+    shard_map/profiler.wrap construction (incl. ``self._x = jax.jit(f)``)
+    plus defs decorated with one — calling such a name dispatches."""
+    jit_names: set[str] = set()
+    funcs: list[_CCFunc] = []
+    classes: dict[str, dict] = {}
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            dn = dotted(node.value.func)
+            if dn in _CC_JIT_WRAPPERS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jit_names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        jit_names.add(t.attr)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                dd = dotted(dec if not isinstance(dec, ast.Call)
+                            else dec.func)
+                if dd in _CC_JIT_WRAPPERS \
+                        or (isinstance(dec, ast.Call) and dec.args
+                            and dotted(dec.args[0]) in _CC_JIT_WRAPPERS):
+                    jit_names.add(node.name)
+
+    def scan_fn(node):
+        direct = False
+        calls: set[str] = set()
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dn = dotted(sub.func)
+            term = dn.rsplit(".", 1)[-1] if dn else ""
+            if dn in _CC_JIT_WRAPPERS:
+                direct = True       # constructs/holds a jitted callable
+            elif term and term in jit_names:
+                direct = True       # invokes a jit-bound name
+            elif term and term not in _CC_NO_PROPAGATE:
+                calls.add(term)
+        return direct, calls
+
+    def walk(node, cls, in_func=False):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                info = classes.setdefault(child.name, {
+                    "sf": sf, "node": child, "bases": [], "methods": {},
+                    "abstract": False, "uncapturable": False})
+                for b in child.bases:
+                    bn = dotted(b)
+                    if bn:
+                        info["bases"].append(bn.rsplit(".", 1)[-1])
+                for stmt in child.body:
+                    if isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name) \
+                                    and t.id == "_abstract" \
+                                    and isinstance(stmt.value, ast.Constant) \
+                                    and stmt.value.value:
+                                info["abstract"] = True
+                            if isinstance(t, ast.Name) \
+                                    and t.id == "_uncapturable" \
+                                    and isinstance(stmt.value, ast.Constant) \
+                                    and stmt.value.value:
+                                info["uncapturable"] = True
+                    elif isinstance(stmt, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        info["methods"][stmt.name] = stmt
+                walk(child, child, in_func)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                direct, calls = scan_fn(child)
+                funcs.append(_CCFunc(sf, child, child.name, direct, calls,
+                                     nested=in_func))
+                walk(child, cls, True)
+            else:
+                walk(child, cls, in_func)
+
+    walk(sf.tree, None)
+    return funcs, classes
+
+
+@rule("pipeline-capture-coverage", "consistency",
+      "every Transformer whose transform dispatches a jitted computation "
+      "must expose a capture() or carry an explicit _uncapturable marker",
+      scope="project")
+def check_pipeline_capture_coverage(project: Project) -> Iterable[Finding]:
+    all_funcs: list[_CCFunc] = []
+    all_classes: dict[str, dict] = {}
+    for sf in project.files:
+        if _is_test_rel(sf.rel) or "/analysis/" in "/" + sf.rel:
+            continue
+        funcs, classes = _cc_scan_file(sf)
+        all_funcs.extend(funcs)
+        for name, info in classes.items():
+            all_classes.setdefault(name, info)
+    if not all_classes:
+        return
+    # fixed point: a function dispatches if it calls (by terminal name)
+    # any project function that dispatches — an over-approximation that
+    # crosses modules (transform -> engine.predict_raw -> jitted run)
+    by_name: dict[str, list[_CCFunc]] = {}
+    for f in all_funcs:
+        if not f.nested:
+            by_name.setdefault(f.name, []).append(f)
+    dispatching = {id(f) for f in all_funcs if f.direct}
+    changed = True
+    while changed:
+        changed = False
+        for f in all_funcs:
+            if id(f) in dispatching:
+                continue
+            for callee in f.calls:
+                if any(id(g) in dispatching
+                       for g in by_name.get(callee, ())):
+                    dispatching.add(id(f))
+                    changed = True
+                    break
+
+    def is_stage_class(name: str, seen: set) -> bool:
+        if name in seen:
+            return False
+        seen.add(name)
+        info = all_classes.get(name)
+        if info is None:
+            return False
+        for b in info["bases"]:
+            if b in _CC_STAGE_BASES or is_stage_class(b, seen):
+                return True
+        return False
+
+    def chain(name: str):
+        """The class + its project-defined ancestors, nearest first,
+        stopping at (and excluding) the core contract bases."""
+        out, queue, seen = [], [name], set()
+        while queue:
+            n = queue.pop(0)
+            if n in seen or n in _CC_CORE_BASES:
+                continue
+            seen.add(n)
+            info = all_classes.get(n)
+            if info is None:
+                continue
+            out.append(info)
+            queue.extend(info["bases"])
+        return out
+
+    for name, info in sorted(all_classes.items()):
+        if info["abstract"] or not is_stage_class(name, set()):
+            continue
+        lineage = chain(name)
+        transform_def = next((c["methods"]["transform"] for c in lineage
+                              if "transform" in c["methods"]), None)
+        if transform_def is None:
+            continue
+        tf = next((f for f in all_funcs if f.node is transform_def), None)
+        if tf is None or id(tf) not in dispatching:
+            continue
+        covered = any("capture" in c["methods"] or c["uncapturable"]
+                      for c in lineage)
+        if covered:
+            continue
+        f = info["sf"].finding(
+            "pipeline-capture-coverage", info["node"],
+            f"Transformer `{name}` dispatches a jitted computation in its "
+            f"transform but neither exposes a capture() nor carries the "
+            f"explicit `_uncapturable = True` marker — the fused pipeline "
+            f"path (core/capture.py) cannot tell \"host-only by design\" "
+            f"from \"capture forgotten\"",
+            hint="implement capture(columns) returning a StageCapture "
+                 "(preferred for device stages), or declare "
+                 "`_uncapturable = True` with a one-line justification",
+            context=name)
         if f:
             yield f
 

@@ -63,7 +63,11 @@ _BODY_WRAPPERS = _JIT_WRAPPERS | {
     "jax.shard_map", "shard_map", "jax.experimental.shard_map.shard_map",
     "jax.lax.scan", "lax.scan", "jax.checkpoint", "jax.remat",
     "profiler.wrap", "telemetry.profiler.wrap", "ProfiledFunction",
-    "jax.vmap", "vmap", "jax.grad", "jax.value_and_grad"}
+    "jax.vmap", "vmap", "jax.grad", "jax.value_and_grad",
+    # the pipeline-capture entry point (core/capture.py): a function
+    # handed to StageCapture(fn, ...) is traced inside the fused
+    # segment's single jitted program
+    "StageCapture", "capture.StageCapture", "core.capture.StageCapture"}
 
 _PARAMS_NAMES = {"params"}
 _OPT_NAMES = {"opt", "opt_state", "optstate", "optimizer_state"}

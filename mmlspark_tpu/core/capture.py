@@ -1,0 +1,403 @@
+"""Whole-pipeline capture: cross-stage XLA fusion for PipelineModel.
+
+A ``Pipeline`` of N stages used to run N separate jitted programs with a
+host-numpy columnar round-trip between every pair (``PipelineModel.
+transform`` chained per-stage ``transform`` calls), so a featurize→predict
+chain paid N dispatches plus device→host→device transfers XLA could fuse
+away. The Julia-to-TPU paper (PAPERS.md, arxiv 1810.09868) makes the case
+for compiling the *whole program*, not fragments — this module is that
+refactor's core:
+
+* every ``Transformer``/``Model`` may expose a :class:`StageCapture` — a
+  traced, shape-polymorphic description of its device computation
+  (``capture(columns)``); host-only stages (``UDFTransformer``,
+  ``Repartition``, ``Cacher``, ...) declare themselves uncapturable with
+  the ``_uncapturable = True`` class marker (the explicit form graftlint's
+  ``pipeline-capture-coverage`` rule checks for);
+* :func:`run_fused_pipeline` composes consecutive capturable stages into
+  **maximal fused segments**, each compiled as ONE program through
+  :class:`~..telemetry.profiler.ProfiledFunction` (AOT lower/compile
+  cache, FLOPs/bytes cost analysis, recompile-cause counters) — arrays
+  stay on device across stage boundaries inside a segment, and the
+  intermediate columns a later stage drops never return to host at all;
+* the fused segment callable is also the serving composite: ``io/serving``
+  builds a :class:`FusedServingStep` body from it
+  (``FusedServingStep.from_pipeline``) and serializes the per-bucket
+  executables into the manifest-committed bundle, so a worker loads a
+  featurize→predict *pipeline* warm.
+
+Capture contract (``StageCapture``): ``fn(params, inputs) -> outputs``
+is a pure traceable function over device arrays — ``params`` an arbitrary
+pytree of constants (weights, tables; ``{}`` when none), ``inputs`` a
+tuple of column arrays aligned with ``capture.inputs``, returning a
+tuple aligned with ``capture.outputs``. ``drops`` removes columns
+(Select/Drop/Rename semantics); unmentioned columns pass through on
+host, untouched. Compute runs in the device dtypes (f32/i32) — stages
+whose host path computes in float64 document the fused path as f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import numpy as np
+
+from .. import telemetry
+from .utils import get_logger
+
+log = get_logger("pipeline")
+
+_m_segments = telemetry.registry.gauge(
+    "mmlspark_pipeline_segments",
+    "fused segments in the last fused PipelineModel.transform plan")
+_m_fused_dispatches = telemetry.registry.counter(
+    "mmlspark_pipeline_fused_dispatches_total",
+    "fused-segment device dispatches (one per segment execution — the "
+    "staged path would have paid one per stage)")
+_m_staged_stages = telemetry.registry.counter(
+    "mmlspark_pipeline_staged_stage_transforms_total",
+    "stages executed via their own transform inside a fused "
+    "PipelineModel.transform (uncapturable, ineligible inputs, or a "
+    "segment of one)")
+_m_fallbacks = telemetry.registry.counter(
+    "mmlspark_pipeline_fusion_fallbacks_total",
+    "planned fused segments that fell back to staged execution at "
+    "encode time (a column the cheap planner predicate accepted turned "
+    "out not to be device-encodable, e.g. ragged rows)")
+_m_transfer = telemetry.registry.counter(
+    "mmlspark_pipeline_transfer_bytes_total",
+    "host<->device bytes moved at fused-segment boundaries; within a "
+    "segment stage-to-stage traffic is zero by construction",
+    labels=("direction",))
+
+
+class StageCapture:
+    """A stage's device computation as a traced callable.
+
+    ``fn(params, inputs)``: pure traceable function; ``inputs`` aligned
+    with :attr:`inputs`, returns value(s) aligned with :attr:`outputs`.
+    ``drops`` names columns the stage removes. ``host_cast`` maps output
+    columns to a numpy dtype applied at readback (e.g. prediction
+    columns stay float64 like the staged path). ``finalize`` is an
+    optional host-side ``df -> df`` hook applied after the segment's
+    frame is rebuilt (column-metadata tagging — SparkSchema score
+    kinds)."""
+
+    __slots__ = ("fn", "inputs", "outputs", "params", "drops",
+                 "host_cast", "finalize", "tag")
+
+    def __init__(self, fn: Callable, inputs: Sequence[str] = (),
+                 outputs: Sequence[str] = (), *, params: Any = None,
+                 drops: Sequence[str] = (),
+                 host_cast: Optional[dict] = None,
+                 finalize: Optional[Callable] = None, tag: str = ""):
+        self.fn = fn
+        self.inputs = tuple(inputs)
+        self.outputs = tuple(outputs)
+        self.params = {} if params is None else params
+        self.drops = tuple(drops)
+        self.host_cast = dict(host_cast or {})
+        self.finalize = finalize
+        self.tag = tag
+
+
+# ------------------------------------------------------------- host encoding
+
+def encodable(col: np.ndarray) -> bool:
+    """Cheap planning predicate: can this column feed the device?
+    (Numeric arrays; object columns of numeric vectors/scalars. The
+    authoritative check is :func:`encode_column` — ragged rows pass
+    here and fall back there.)"""
+    if col.dtype.kind in "biuf":
+        return True
+    if col.dtype.kind != "O":
+        return False
+    if len(col) == 0:
+        return True
+    v = col[0]
+    if isinstance(v, np.ndarray):
+        return v.dtype.kind in "biuf"
+    if isinstance(v, (list, tuple)):
+        return len(v) == 0 or isinstance(v[0], (int, float, np.number))
+    return isinstance(v, (int, float, np.number)) \
+        and not isinstance(v, bool)
+
+
+def encode_column(col: np.ndarray) -> Optional[np.ndarray]:
+    """Column -> device-feedable host array (None when it has no device
+    encoding). Numeric columns ship as-is; object columns of fixed-shape
+    numeric vectors become the (n, d) float32 matrix (the TpuModel wire
+    convention, ``core.utils.to_float32_matrix``)."""
+    if col.dtype.kind in "biuf":
+        return col
+    if col.dtype.kind != "O":
+        return None
+    from .utils import to_float32_matrix
+    try:
+        return to_float32_matrix(col)
+    except (ValueError, TypeError):
+        return None
+
+
+def decode_column(arr: np.ndarray) -> np.ndarray:
+    """Device output -> DataFrame column (2D+ becomes an object column
+    of per-row vectors, the frame's canonical vector form)."""
+    if arr.ndim <= 1:
+        return arr
+    from .utils import object_column
+    return object_column(arr)
+
+
+# ------------------------------------------------------------- fused runner
+
+class _Segment:
+    """One maximal run of capturable stages + its name-flow plan."""
+
+    __slots__ = ("pairs", "in_names", "out_names", "names", "host_cast")
+
+    def __init__(self, pairs, df_columns):
+        self.pairs = list(pairs)          # [(stage, capture), ...]
+        produced: set = set()
+        in_names: list = []
+        names = list(df_columns)          # running column order
+        host_cast: dict = {}
+        for _, cap in self.pairs:
+            for i in cap.inputs:
+                if i not in produced and i not in in_names:
+                    in_names.append(i)
+            for d in cap.drops:
+                if d in names:
+                    names.remove(d)
+                produced.discard(d)
+            for o in cap.outputs:
+                if o not in names:
+                    names.append(o)
+                produced.add(o)
+            host_cast.update(cap.host_cast)
+        self.in_names = in_names
+        self.names = names
+        self.out_names = [n for n in names if n in produced]
+        self.host_cast = host_cast
+
+
+def _param_key(tree) -> tuple:
+    """Cache-validity key for a segment's capture params: array leaves
+    by identity (the framework-wide convention — updating weights means
+    a NEW tree, TpuModel._device_params), scalar leaves by value (a
+    fresh ``[0.5]`` fills list every transform must still hit)."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (hash(treedef),
+            tuple(x if isinstance(x, (int, float, str, bool, bytes,
+                                      type(None)))
+                  else id(x) for x in leaves))
+
+
+def _segment_program(owner, seg: _Segment, seg_index: int):
+    """The ONE jitted program for a segment, via ProfiledFunction's AOT
+    lower/compile cache (compile counts + recompile causes observable),
+    cached on the owning PipelineModel. Capture params are device-put
+    once per (segment, params-identity) — re-shipping model weights per
+    transform would dominate small-batch latency (the TpuModel
+    ``_device_params`` convention: updating weights means new trees)."""
+    import jax
+    caps = [c for _, c in seg.pairs]
+    # simple (jsonable) params pin the traced structure: a config change
+    # that alters the capture's computation without renaming columns
+    # (e.g. DataConversion.convertTo) must not reuse a stale program
+    key = (tuple(s.uid for s, _ in seg.pairs),
+           tuple(repr(sorted(s._jsonParams().items()))
+                 for s, _ in seg.pairs),
+           tuple(seg.in_names), tuple(seg.out_names))
+    cache = getattr(owner, "_seg_cache", None)
+    if cache is None:
+        cache = owner._seg_cache = {}
+    entry = cache.get(key)
+    params = tuple(c.params for c in caps)
+    if entry is None or entry["param_ids"] != _param_key(params):
+        in_names, out_names = list(seg.in_names), list(seg.out_names)
+        fns = [(c.fn, c.inputs, c.drops, c.outputs) for c in caps]
+
+        def seg_fn(param_tuple, arrays):
+            cols = dict(zip(in_names, arrays))
+            for (fn, inputs, drops, outputs), p in zip(fns, param_tuple):
+                vals = fn(p, tuple(cols[i] for i in inputs))
+                if not isinstance(vals, (tuple, list)):
+                    vals = (vals,)
+                for d in drops:
+                    cols.pop(d, None)
+                cols.update(zip(outputs, vals))
+            return tuple(cols[n] for n in out_names)
+
+        tag = f"pipeline.seg{seg_index}.{getattr(owner, 'uid', 'anon')}"
+        entry = {
+            "pf": telemetry.profiler.wrap(jax.jit(seg_fn), tag, aot=True),
+            "params_dev": jax.device_put(params),
+            "param_ids": _param_key(params),
+        }
+        cache[key] = entry
+    return entry["pf"], entry["params_dev"]
+
+
+def _run_segment(owner, seg: _Segment, df, seg_index: int):
+    """Execute one fused segment: encode inputs, ONE device dispatch,
+    decode outputs, rebuild the frame (pass-through columns keep their
+    values and metadata; produced columns land in staged order)."""
+    from .dataframe import DataFrame
+    arrays = []
+    for n in seg.in_names:
+        a = encode_column(df.col(n))
+        if a is None:       # the cheap planner predicate over-promised
+            _m_fallbacks.inc()
+            log.warning("fused segment fell back to staged execution: "
+                        "column %r is not device-encodable", n)
+            cur = df
+            for stage, _ in seg.pairs:
+                _m_staged_stages.inc()
+                cur = stage.transform(cur)
+            return cur
+        arrays.append(np.ascontiguousarray(a))
+    pf, params_dev = _segment_program(owner, seg, seg_index)
+    _m_transfer.labels(direction="in").inc(
+        float(sum(a.nbytes for a in arrays)))
+    with telemetry.trace.span("pipeline/segment", stages=len(seg.pairs),
+                              rows=len(df)):
+        outs = pf(params_dev, tuple(arrays))
+    _m_fused_dispatches.inc()
+    outs = [np.asarray(o) for o in outs]
+    _m_transfer.labels(direction="out").inc(
+        float(sum(o.nbytes for o in outs)))
+    outmap = dict(zip(seg.out_names, outs))
+    data, meta = {}, {}
+    for n in seg.names:
+        if n in outmap:
+            arr = outmap[n]
+            if n in seg.host_cast:
+                arr = arr.astype(seg.host_cast[n])
+            data[n] = decode_column(arr)
+        else:
+            data[n] = df.col(n)
+            m = df.metadata(n)
+            if m:
+                meta[n] = m
+    cur = DataFrame(data, metadata=meta, npartitions=df.npartitions)
+    for _, cap in seg.pairs:
+        # a later stage may have renamed/dropped this capture's outputs
+        # (finalize hooks tag column metadata by name); tag only what
+        # survived the whole segment
+        if cap.finalize is not None and all(o in data
+                                            for o in cap.outputs):
+            cur = cap.finalize(cur)
+    return cur
+
+
+def stage_capture(stage, columns) -> Optional[StageCapture]:
+    """A stage's capture for the given column-name schema, honoring the
+    explicit ``_uncapturable`` marker; None when the stage cannot (or
+    declines to) describe its computation."""
+    if getattr(type(stage), "_uncapturable", False):
+        return None
+    cap_fn = getattr(stage, "capture", None)
+    if cap_fn is None:
+        return None
+    return cap_fn(list(columns))
+
+
+def run_fused_pipeline(owner, stages, df):
+    """``PipelineModel.transform`` with cross-stage fusion: walk the
+    stages left-to-right, accumulating consecutive capturable stages
+    (whose capture inputs are device-encodable under the running schema)
+    into maximal segments; each segment of >= 2 stages runs as ONE
+    compiled program, everything else runs its own ``transform``.
+    Uncapturable stages therefore split segments at prefix/middle/suffix
+    positions and the plan degrades gracefully to the staged chain."""
+    cur = df
+    pending: list = []
+    schema = {n: encodable(df.col(n)) for n in df.columns}
+    segments = 0
+
+    def flush():
+        nonlocal cur, pending, segments
+        if not pending:
+            return
+        if len(pending) >= 2:
+            seg = _Segment(pending, list(cur.columns))
+            cur = _run_segment(owner, seg, cur, segments)
+            segments += 1
+        else:
+            for stage, _ in pending:
+                _m_staged_stages.inc()
+                cur = stage.transform(cur)
+        pending = []
+
+    for stage in stages:
+        cap = stage_capture(stage, list(schema))
+        if cap is not None and all(schema.get(i, False)
+                                   for i in cap.inputs):
+            pending.append((stage, cap))
+            for d in cap.drops:
+                schema.pop(d, None)
+            for o in cap.outputs:
+                schema[o] = True
+        else:
+            flush()
+            _m_staged_stages.inc()
+            cur = stage.transform(cur)
+            schema = {n: encodable(cur.col(n)) for n in cur.columns}
+    flush()
+    _m_segments.set(segments)
+    return cur
+
+
+def whole_pipeline_capture(stages, input_cols: Sequence[str]):
+    """One :class:`_Segment` covering EVERY stage, or raise — the serving
+    composite's contract (``FusedServingStep.from_pipeline``): a bundle
+    must not silently serve a half-fused pipeline. ``input_cols`` seed
+    the schema (all assumed device-encodable wire inputs)."""
+    schema = {n: True for n in input_cols}
+    pairs = []
+    for stage in stages:
+        cap = stage_capture(stage, list(schema))
+        if cap is None:
+            raise ValueError(
+                f"stage {type(stage).__name__} ({stage.uid}) is not "
+                f"capturable; a pipeline serving composite needs every "
+                f"stage to expose a capture")
+        missing = [i for i in cap.inputs if not schema.get(i, False)]
+        if missing:
+            raise ValueError(
+                f"stage {type(stage).__name__} reads column(s) {missing} "
+                f"that no earlier stage produces and no input column "
+                f"provides")
+        pairs.append((stage, cap))
+        for d in cap.drops:
+            schema.pop(d, None)
+        for o in cap.outputs:
+            schema[o] = True
+    return _Segment(pairs, list(input_cols))
+
+
+def segment_body(seg: _Segment, out_name: str):
+    """``(body(params, cols_tuple) -> out array, params)`` for a serving
+    composite built over ``seg`` — the traced whole-pipeline callable the
+    fused serving step compiles per bucket."""
+    if out_name not in seg.out_names:
+        raise ValueError(f"pipeline produces {seg.out_names}, not "
+                         f"{out_name!r}")
+    caps = [c for _, c in seg.pairs]
+    fns = [(c.fn, c.inputs, c.drops, c.outputs) for c in caps]
+    in_names = list(seg.in_names)
+    params = tuple(c.params for c in caps)
+
+    def body(param_tuple, arrays):
+        cols = dict(zip(in_names, arrays))
+        for (fn, inputs, drops, outputs), p in zip(fns, param_tuple):
+            vals = fn(p, tuple(cols[i] for i in inputs))
+            if not isinstance(vals, (tuple, list)):
+                vals = (vals,)
+            for d in drops:
+                cols.pop(d, None)
+            cols.update(zip(outputs, vals))
+        return cols[out_name]
+
+    return body, params

@@ -15,7 +15,7 @@ import uuid as _uuid
 from typing import Optional
 
 from .dataframe import DataFrame
-from .params import ComplexParam, Params
+from .params import BooleanParam, ComplexParam, Params
 
 # fully-qualified name -> class, for serialization lookup and fuzzing coverage
 STAGE_REGISTRY: dict[str, type] = {}
@@ -77,8 +77,21 @@ class PipelineStage(Params):
 class Transformer(PipelineStage):
     _abstract = True
 
+    #: explicit "host-only stage" marker: a Transformer whose transform
+    #: dispatches device computation must either expose a capture() or
+    #: set this True (enforced by graftlint's pipeline-capture-coverage)
+    _uncapturable = False
+
     def transform(self, df: DataFrame) -> DataFrame:
         raise NotImplementedError
+
+    def capture(self, columns):
+        """This stage's device computation as a traced callable
+        (:class:`~.capture.StageCapture`), given the incoming column
+        names — or None when the stage cannot describe one (the default:
+        stages opt IN to cross-stage fusion). Host-only stages set
+        ``_uncapturable = True`` instead of overriding this."""
+        return None
 
     def __call__(self, df: DataFrame) -> DataFrame:
         return self.transform(df)
@@ -146,10 +159,28 @@ class Pipeline(Estimator):
 
 
 class PipelineModel(Model):
+    #: as a STAGE of an outer pipeline a nested PipelineModel runs its
+    #: own transform (which may itself fuse internally) — it does not
+    #: flatten into the outer segment
+    _uncapturable = True
     stages = ComplexParam("ordered list of fitted Transformers", default=())
+    fusePipeline = BooleanParam(
+        "compose consecutive capturable stages into maximal fused "
+        "segments, each compiled as ONE XLA program (core/capture.py): "
+        "arrays stay on device across stage boundaries inside a segment, "
+        "so an N-stage chain pays number-of-segments dispatches instead "
+        "of N, and zero host round-trips between fused stages. "
+        "Uncapturable stages split segments and run their own transform. "
+        "Fused compute runs in device dtypes (f32/i32); stages whose "
+        "host path computes in float64 differ at f32 precision "
+        "(docs/performance.md, Cross-stage fusion)", default=False)
 
     def transform(self, df: DataFrame) -> DataFrame:
+        stages = self.getOrDefault("stages")
+        if self.getOrDefault("fusePipeline") and len(stages) >= 2:
+            from .capture import run_fused_pipeline
+            return run_fused_pipeline(self, stages, df)
         cur = df
-        for stage in self.getOrDefault("stages"):
+        for stage in stages:
             cur = stage.transform(cur)
         return cur

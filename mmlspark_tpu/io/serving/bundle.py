@@ -53,6 +53,8 @@ log = get_logger("io.serving")
 #: the bundle head's canonical name (the manifest's multi-shard record)
 BUNDLE_HEAD = "serving_bundle.json"
 SCHEMA = "mmlspark-serving-bundle/v1"
+#: the pipeline composite's model-component shard (kind == "pipeline")
+_PIPELINE_SHARD = "bundle_pipeline.bin"
 
 _m_bundle_loads = telemetry.registry.counter(
     "mmlspark_serving_bundle_loads_total",
@@ -85,9 +87,11 @@ def save_bundle(directory: str, step: FusedServingStep,
     from jax.experimental import serialize_executable
     os.makedirs(directory, exist_ok=True)
     step.compile_buckets()
+    kind = getattr(step, "bundle_kind", "model")
     meta = {
         "schema": SCHEMA,
         "version": 1,
+        "kind": kind,
         "backend": jax.default_backend(),
         "jax": jax.__version__,
         "device_count": jax.device_count(),
@@ -99,13 +103,23 @@ def save_bundle(directory: str, step: FusedServingStep,
         "max_batch": step.policy.max_batch,
         "buckets": list(step.policy.buckets),
     }
+    if kind == "pipeline":
+        # a pipeline composite's "model" component is the serialized
+        # PipelineModel itself (stages + fitted params); the fused body
+        # and its capture params are rebuilt from it at load time
+        meta["input_col"] = step.input_col
+        meta["score_col"] = step.score_col
+        model_shard = (_PIPELINE_SHARD, pickle.dumps(step.pipeline))
+    else:
+        model_shard = ("bundle_model.msgpack",
+                       serialization.msgpack_serialize(
+                           jax.tree_util.tree_map(np.asarray,
+                                                  step.params)))
     if extra_meta:
         meta.update(extra_meta)
     shards = [("bundle_meta.json",
                json.dumps(meta, sort_keys=True).encode("utf-8")),
-              ("bundle_model.msgpack",
-               serialization.msgpack_serialize(
-                   jax.tree_util.tree_map(np.asarray, step.params)))]
+              model_shard]
     for b in step.policy.buckets:
         compiled = step.compile_bucket(b)
         shards.append((_exec_shard(b),
@@ -168,21 +182,39 @@ def load_bundle(directory: str, policy: Optional[BucketPolicy] = None,
             f"no committed serving bundle in {directory} (head "
             f"{BUNDLE_HEAD} missing or failed manifest verification)")
     meta_blob = _read_shard(directory, "bundle_meta.json")
-    model_blob = _read_shard(directory, "bundle_model.msgpack")
-    if meta_blob is None or model_blob is None:
+    if meta_blob is None:
+        _m_bundle_loads.labels(result="cold").inc()
+        ckpt.note_corrupt(BUNDLE_HEAD, "model/meta shard torn")
+        raise ckpt.CorruptCheckpoint(
+            f"serving bundle in {directory} has a torn meta shard")
+    meta = json.loads(meta_blob.decode("utf-8"))
+    kind = meta.get("kind", "model")
+    model_blob = _read_shard(
+        directory,
+        _PIPELINE_SHARD if kind == "pipeline" else "bundle_model.msgpack")
+    if model_blob is None:
         _m_bundle_loads.labels(result="cold").inc()
         ckpt.note_corrupt(BUNDLE_HEAD, "model/meta shard torn")
         raise ckpt.CorruptCheckpoint(
             f"serving bundle in {directory} has a torn model/meta shard")
-    meta = json.loads(meta_blob.decode("utf-8"))
-    params = serialization.msgpack_restore(model_blob)
     if policy is None:
         policy = BucketPolicy(max_batch=meta["max_batch"],
                               min_bucket=meta["min_bucket"])
-    step = FusedServingStep(meta["model_config"], params, policy=policy,
-                            row_shape=tuple(meta["row_shape"]),
-                            in_dtype=np.dtype(meta["in_dtype"]),
-                            output=meta["output"], **step_kwargs)
+    if kind == "pipeline":
+        pipeline = pickle.loads(model_blob)
+        step = FusedServingStep.from_pipeline(
+            pipeline, input_col=meta["input_col"],
+            score_col=meta["score_col"], policy=policy,
+            row_shape=tuple(meta["row_shape"]),
+            in_dtype=np.dtype(meta["in_dtype"]),
+            output=meta["output"], **step_kwargs)
+    else:
+        params = serialization.msgpack_restore(model_blob)
+        step = FusedServingStep(meta["model_config"], params,
+                                policy=policy,
+                                row_shape=tuple(meta["row_shape"]),
+                                in_dtype=np.dtype(meta["in_dtype"]),
+                                output=meta["output"], **step_kwargs)
     compatible = (meta.get("backend") == jax.default_backend()
                   and meta.get("jax") == jax.__version__
                   and int(meta.get("device_count", 0))

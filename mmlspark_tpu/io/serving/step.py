@@ -84,18 +84,18 @@ class FusedServingStep:
     the score rows. ``decode``/``encode`` override the payload codecs.
     """
 
-    def __init__(self, model_config: dict, params, *,
+    def __init__(self, model_config: Optional[dict], params, *,
                  policy: Optional[BucketPolicy] = None,
                  row_shape=(), in_dtype=np.uint8, output: str = "argmax",
                  decode: Optional[Callable] = None,
                  encode: Optional[Callable] = None,
-                 tag: str = "serving.step"):
+                 tag: str = "serving.step", _body: Optional[Callable] = None):
         import jax
         import jax.numpy as jnp
-        from ...models.modules import build_model
         if output not in ("argmax", "scores"):
             raise ValueError(f"output must be argmax|scores, got {output!r}")
-        self.model_config = dict(model_config)
+        self.model_config = None if model_config is None \
+            else dict(model_config)
         self.policy = policy or BucketPolicy()
         self.row_shape = tuple(int(d) for d in row_shape)
         self.in_dtype = np.dtype(in_dtype)
@@ -105,17 +105,61 @@ class FusedServingStep:
         self.encode = encode or _default_encode(output)
         self.params = params
         self._params_dev = jax.device_put(params)
-        module = build_model(self.model_config)
+        if _body is None:
+            from ...models.modules import build_model
+            module = build_model(self.model_config)
+            _body = module.apply
 
         def fused(p, x):
-            y = module.apply(p, x)
-            if output == "argmax":
+            y = _body(p, x)
+            if output == "argmax" and y.ndim > 1:
                 return jnp.argmax(y, axis=-1).astype(jnp.int32)
             return y
 
         # aot=True: the executable cache stays authoritative even with
         # profiling off — that cache IS the warm-start story
         self._pf = telemetry.profiler.wrap(jax.jit(fused), tag, aot=True)
+
+    @classmethod
+    def from_pipeline(cls, pipeline, *, input_col: str = "features",
+                      score_col: Optional[str] = None, row_shape=(),
+                      in_dtype=np.float32,
+                      policy: Optional[BucketPolicy] = None,
+                      output: str = "argmax",
+                      decode: Optional[Callable] = None,
+                      encode: Optional[Callable] = None,
+                      tag: str = "serving.pipeline") -> "FusedServingStep":
+        """A whole PIPELINE as the fused step body: every stage of
+        ``pipeline`` (a ``PipelineModel``) must expose a capture
+        (core/capture.py — uncapturable stages raise), and the composed
+        featurize→predict program compiles as ONE executable per bucket,
+        bundle-serializable like any model step — a serving worker loads
+        the pipeline composite warm. ``input_col`` is the wire column the
+        decoded payload feeds; ``score_col`` the pipeline output column
+        served (default: ``scores``/``probability``/``prediction``,
+        first match, else the last produced column)."""
+        from ...core import capture as capturelib
+        stages = tuple(pipeline.getOrDefault("stages"))
+        seg = capturelib.whole_pipeline_capture(stages, [input_col])
+        if list(seg.in_names) != [input_col]:
+            raise ValueError(
+                f"pipeline serving composites take ONE wire column "
+                f"({input_col!r}); this pipeline also reads "
+                f"{[n for n in seg.in_names if n != input_col]}")
+        if score_col is None:
+            score_col = next((c for c in ("scores", "probability",
+                                          "prediction")
+                              if c in seg.out_names), seg.out_names[-1])
+        body, params = capturelib.segment_body(seg, score_col)
+        step = cls(None, params, policy=policy, row_shape=row_shape,
+                   in_dtype=in_dtype, output=output, decode=decode,
+                   encode=encode, tag=tag,
+                   _body=lambda p, x: body(p, (x,)))
+        step.pipeline = pipeline
+        step.bundle_kind = "pipeline"
+        step.input_col = input_col
+        step.score_col = score_col
+        return step
 
     # ---- warmup / bundle surface ----
     def bucket_spec(self, bucket: int):

@@ -52,6 +52,47 @@ class _ProbClassifierModel(Model, HasFeaturesCol):
     def _probs(self, x: np.ndarray) -> np.ndarray:
         raise NotImplementedError
 
+    def _capture_params(self):
+        """Param pytree for the traced capture (the STORED arrays, so
+        identity changes — new weights — invalidate the cached fused
+        program), or None when the model has no traceable form."""
+        return None
+
+    def _traced_probs(self, p, x):
+        """Traced twin of ``_probs``: ``p`` = ``_capture_params()``
+        tree, ``x`` a traced (n, d) f32 array."""
+        raise NotImplementedError
+
+    def capture(self, columns):
+        """Probability + argmax as one traced body (cross-stage fusion,
+        core/capture.py). Host ``_probs`` computes in float64; the fused
+        path runs the device dtype (f32) — same values at f32
+        precision."""
+        from ..core.capture import StageCapture
+        from ..core.schema import SparkSchema
+        params = self._capture_params()
+        if params is None or self.getFeaturesCol() not in columns:
+            return None
+        prob_col, pred_col = self.getProbabilityCol(), self.getPredictionCol()
+
+        def fn(p, xs):
+            x = xs[0].astype(jnp.float32)
+            prob = self._traced_probs(p, x.reshape(x.shape[0], -1))
+            pred = jnp.argmax(prob, axis=-1).astype(jnp.float32)
+            return prob, pred
+
+        def finalize(df):
+            out = SparkSchema.setScoresColumnName(df, prob_col,
+                                                  "classification")
+            return SparkSchema.setScoredLabelsColumnName(
+                out, pred_col, "classification")
+
+        return StageCapture(fn, inputs=(self.getFeaturesCol(),),
+                            outputs=(prob_col, pred_col),
+                            params=params,
+                            host_cast={pred_col: np.float64},
+                            finalize=finalize, tag="classical.predict")
+
     def _features(self, df: DataFrame):
         """Feature matrix hook — models that can score a sparse matrix
         directly (multinomial NB's one matmul) override to skip _densify."""
@@ -119,6 +160,15 @@ class LogisticRegressionModel(_ProbClassifierModel):
         e = np.exp(z - z.max(axis=1, keepdims=True))
         return e / e.sum(axis=1, keepdims=True)
 
+    def _capture_params(self):
+        if self.getCoefficients() is None:
+            return None
+        return {"W": self.getCoefficients(), "b": self.getIntercept()}
+
+    def _traced_probs(self, p, x):
+        z = x @ p["W"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+        return jax.nn.softmax(z, axis=-1)
+
 
 class LogisticRegression(Estimator, HasFeaturesCol, HasLabelCol):
     regParam = FloatParam("L2 regularization", default=0.0, min=0.0)
@@ -150,6 +200,30 @@ class LinearRegressionModel(Model, HasFeaturesCol):
         out = df.withColumn(self.getPredictionCol(), pred)
         return SparkSchema.setScoresColumnName(out, self.getPredictionCol(),
                                                "regression")
+
+    def capture(self, columns):
+        from ..core.capture import StageCapture
+        if self.getCoefficients() is None \
+                or self.getFeaturesCol() not in columns:
+            return None
+        pred_col = self.getPredictionCol()
+
+        def fn(p, xs):
+            x = xs[0].astype(jnp.float32)
+            x = x.reshape(x.shape[0], -1)
+            z = x @ p["W"].astype(jnp.float32) + p["b"].astype(jnp.float32)
+            return (z[:, 0],)
+
+        def finalize(df):
+            return SparkSchema.setScoresColumnName(df, pred_col,
+                                                   "regression")
+
+        return StageCapture(fn, inputs=(self.getFeaturesCol(),),
+                            outputs=(pred_col,),
+                            params={"W": self.getCoefficients(),
+                                    "b": self.getIntercept()},
+                            host_cast={pred_col: np.float64},
+                            finalize=finalize, tag="classical.predict")
 
 
 class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol):
@@ -213,6 +287,30 @@ class NaiveBayesModel(_ProbClassifierModel):
             z = ll + lp[None]
         e = np.exp(z - z.max(axis=1, keepdims=True))
         return e / e.sum(axis=1, keepdims=True)
+
+    def _capture_params(self):
+        lp = self.getClassLogPriors()
+        if lp is None:
+            return None
+        if self._is_multinomial():
+            return {"lp": lp, "theta": self.getFeatureLogProbs()}
+        if self.getMeans() is None:
+            return None
+        return {"lp": lp, "mu": self.getMeans(),
+                "var": self.getVariances()}
+
+    def _traced_probs(self, p, x):
+        lp = p["lp"].astype(jnp.float32)
+        if "theta" in p:
+            z = x @ p["theta"].astype(jnp.float32).T + lp[None]
+        else:
+            mu = p["mu"].astype(jnp.float32)
+            var = p["var"].astype(jnp.float32)
+            ll = -0.5 * (jnp.log(2 * np.pi * var)[None]
+                         + (x[:, None, :] - mu[None]) ** 2
+                         / var[None]).sum(axis=2)
+            z = ll + lp[None]
+        return jax.nn.softmax(z, axis=-1)
 
 
 class NaiveBayes(Estimator, HasFeaturesCol, HasLabelCol):
@@ -389,6 +487,27 @@ class MLPClassificationModel(_ProbClassifierModel):
     inner = ComplexParam("fitted TpuModel", default=None)
     featureMean = ComplexParam("standardization mean", default=None)
     featureScale = ComplexParam("standardization scale", default=None)
+
+    def _capture_params(self):
+        tm = self.getInner()
+        if tm is None or tm.getModelParams() is None \
+                or tm.getModelConfig() is None:
+            return None
+        if tm._is_moe() or tm.getTensorParallel() > 1:
+            return None
+        p = {"inner": tm.getModelParams()}
+        if self.getFeatureMean() is not None:
+            p["mu"] = self.getFeatureMean()
+            p["sd"] = self.getFeatureScale()
+        return p
+
+    def _traced_probs(self, p, x):
+        from .modules import build_model
+        module = build_model(self.getInner().getModelConfig())
+        if "mu" in p:
+            x = (x - p["mu"].astype(jnp.float32)) \
+                / p["sd"].astype(jnp.float32)
+        return jax.nn.softmax(module.apply(p["inner"], x), axis=-1)
 
     def _probs(self, x):
         import scipy.special

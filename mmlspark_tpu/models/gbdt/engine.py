@@ -1572,6 +1572,38 @@ def predict_raw(ens, x: np.ndarray,
         table_nodes)
 
 
+def traced_raw_levelwise(params: dict, x, depth: int, K: int):
+    """The dense level-wise scoring body as a PURE TRACED function —
+    binning included — for cross-stage pipeline fusion
+    (core/capture.py): ``params = {feature, threshold, leaf, base,
+    edges}`` (the boosterState arrays), ``x`` raw (n, d) features.
+    Same math as :func:`predict_raw`'s dense path: per-feature
+    ``searchsorted`` binning (NaN -> bin 0, the ``bin_data`` contract)
+    then the per-tree test-table walk, all inside the caller's single
+    jitted program — no host bin matrix, no per-call table staging."""
+    xf = x.astype(jnp.float32)
+    edges = params["edges"].astype(jnp.float32)
+    bins = jax.vmap(lambda e, c: jnp.searchsorted(e, c, side="left"),
+                    in_axes=(0, 1), out_axes=1)(edges, xf)
+    bins = jnp.where(jnp.isnan(xf), 0, bins).astype(jnp.int32)
+    bins_t = bins.T
+
+    def body(raw, tree):
+        f, t, lv = tree
+        contrib = jnp.stack(
+            [_predict_tree_t(bins_t, f[k], t[k], lv[k], depth=depth)
+             for k in range(K)], axis=1)
+        return raw + contrib, None
+
+    init = jnp.broadcast_to(
+        params["base"].astype(jnp.float32)[None, :],
+        (x.shape[0], K))
+    raw, _ = jax.lax.scan(body, init, (params["feature"],
+                                       params["threshold"],
+                                       params["leaf"]))
+    return raw
+
+
 def prob_from_raw(objective: str, raw: np.ndarray) -> np.ndarray:
     """Raw margins -> probabilities (classification) or values (regression)."""
     if objective == "binary":

@@ -583,6 +583,28 @@ def _split_importances(state: dict, selection, bundles,
     return out
 
 
+def _gbdt_capture_params(state: dict) -> dict:
+    """The boosterState arrays as a capture-param pytree (the STORED
+    arrays — stable identity keeps the fused segment's program cache
+    warm across transforms)."""
+    return {"feature": state["feature"], "threshold": state["threshold"],
+            "leaf": state["leaf"], "base": state["base"],
+            "edges": state["bin_edges"]}
+
+
+def _gbdt_capture_eligible(model, columns) -> bool:
+    """Fused predict covers the dense level-wise path: no leaf-wise
+    routing, no sparse feature selection / EFB bundles (host sparse
+    work), and not an explicit pallas backend request (the fused body is
+    the dense traced walk)."""
+    state = model.getBoosterState()
+    return (state is not None and state.get("kind") != "leafwise"
+            and model.getFeatureSelection() is None
+            and not model.getFeatureBundles()
+            and model.getPredictImpl() in ("auto", "dense")
+            and model.getFeaturesCol() in columns)
+
+
 _PREDICT_IMPL_DOC = (
     "ensemble scoring backend: dense = the f32/int32 XLA test-table "
     "path; pallas = quantized structure-of-arrays tables (uint8 "
@@ -619,6 +641,47 @@ class LightGBMClassificationModel(Model, HasFeaturesCol):
         return _split_importances(self.getBoosterState(),
                                   self.getFeatureSelection(),
                                   self.getFeatureBundles(), n_features)
+
+    def capture(self, columns):
+        """The jitted dense predict body as a pipeline capture
+        (engine.traced_raw_levelwise): binning + tree walk + probability
+        + argmax fused into the enclosing segment's ONE program."""
+        from ...core.capture import StageCapture
+        from ...core.schema import SparkSchema
+        if not _gbdt_capture_eligible(self, columns):
+            return None
+        state = self.getBoosterState()
+        leaf = np.asarray(state["leaf"])
+        depth = int(np.log2(leaf.shape[2]))
+        K = leaf.shape[1]
+        objective = self.getObjective()
+        raw_col, prob_col = self.getRawPredictionCol(), self.getProbabilityCol()
+        pred_col = self.getPredictionCol()
+
+        def fn(p, xs):
+            import jax.numpy as jnp
+            x = xs[0].astype(jnp.float32)
+            raw = engine.traced_raw_levelwise(p, x.reshape(x.shape[0], -1),
+                                              depth=depth, K=K)
+            if objective == "binary":
+                p1 = jax.nn.sigmoid(raw[:, 0])
+                prob = jnp.stack([1.0 - p1, p1], axis=1)
+            else:
+                prob = jax.nn.softmax(raw, axis=-1)
+            pred = jnp.argmax(prob, axis=-1).astype(jnp.float32)
+            return raw, prob, pred
+
+        def finalize(df):
+            out = SparkSchema.setScoresColumnName(df, prob_col,
+                                                  "classification")
+            return SparkSchema.setScoredLabelsColumnName(
+                out, pred_col, "classification")
+
+        return StageCapture(fn, inputs=(self.getFeaturesCol(),),
+                            outputs=(raw_col, prob_col, pred_col),
+                            params=_gbdt_capture_params(state),
+                            host_cast={pred_col: np.float64},
+                            finalize=finalize, tag="gbdt.predict")
 
     def transform(self, df: DataFrame) -> DataFrame:
         x = _predict_features(df, self.getFeaturesCol(),
@@ -689,6 +752,36 @@ class LightGBMRegressionModel(Model, HasFeaturesCol):
         return _split_importances(self.getBoosterState(),
                                   self.getFeatureSelection(),
                                   self.getFeatureBundles(), n_features)
+
+    def capture(self, columns):
+        """Regression twin of the classifier capture: fused binning +
+        tree walk, prediction = summed raw margin."""
+        from ...core.capture import StageCapture
+        from ...core.schema import SparkSchema
+        if not _gbdt_capture_eligible(self, columns):
+            return None
+        state = self.getBoosterState()
+        leaf = np.asarray(state["leaf"])
+        depth = int(np.log2(leaf.shape[2]))
+        K = leaf.shape[1]
+        pred_col = self.getPredictionCol()
+
+        def fn(p, xs):
+            import jax.numpy as jnp
+            x = xs[0].astype(jnp.float32)
+            raw = engine.traced_raw_levelwise(p, x.reshape(x.shape[0], -1),
+                                              depth=depth, K=K)
+            return (raw[:, 0],)
+
+        def finalize(df):
+            return SparkSchema.setScoresColumnName(df, pred_col,
+                                                   "regression")
+
+        return StageCapture(fn, inputs=(self.getFeaturesCol(),),
+                            outputs=(pred_col,),
+                            params=_gbdt_capture_params(state),
+                            host_cast={pred_col: np.float64},
+                            finalize=finalize, tag="gbdt.predict")
 
     def transform(self, df: DataFrame) -> DataFrame:
         x = _predict_features(df, self.getFeaturesCol(),

@@ -300,6 +300,44 @@ class TpuModel(Transformer):
             t <<= 1
         return self
 
+    def capture(self, columns):
+        """The inference forward pass as a traced callable (cross-stage
+        fusion, core/capture.py): the SAME ``module.apply`` body the
+        jitted transform dispatches, minus the host-side chunking /
+        bucketing — the fused segment dispatches the whole batch as part
+        of ONE pipeline program. Offered for single-process, non-TP,
+        non-MoE models with flat float inputs (the wire shape a fused
+        column feed can produce); everything else keeps the staged
+        transform's windowed dispatch machinery."""
+        from ..core.capture import StageCapture
+        cfg = self.getModelConfig()
+        if (cfg is None or self.getModelParams() is None
+                or self.getInputCol() not in columns):
+            return None
+        if (self._is_moe() or self.getTensorParallel() > 1
+                or meshlib.effective_process_count() > 1
+                or self.getInputShape()):
+            return None
+        from .modules import example_input
+        try:
+            ex = example_input(cfg)
+        except Exception:
+            return None
+        if ex.ndim != 2 or np.asarray(ex).dtype.kind not in "f":
+            return None     # image/token models keep the staged wire path
+        from .modules import build_model
+        module = build_model(cfg)
+        ol = self.getOutputLayer() or None
+
+        def fn(p, xs):
+            return (module.apply(p, xs[0].astype(np.float32),
+                                 output_layer=ol),)
+
+        return StageCapture(fn, inputs=(self.getInputCol(),),
+                            outputs=(self.getOutputCol(),),
+                            params=self.getModelParams(),
+                            tag="tpu_model.apply")
+
     def transform(self, df: DataFrame) -> DataFrame:
         if self.getModelParams() is None:
             raise ValueError("TpuModel has no params; set modelParams or "

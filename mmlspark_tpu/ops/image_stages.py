@@ -39,6 +39,9 @@ class ImageTransformer(Transformer):
     param, and execute as one fused XLA program per shape bucket.
     """
 
+    #: image-struct rows (path/height/width/bytes dicts) have no columnar
+    #: device encoding — the stage runs its own per-shape-bucket programs
+    _uncapturable = True
     inputCol = StringParam("input image column", default="image")
     outputCol = StringParam("output image column", default="out")
     stages = ListParam("list of {op, **params} dicts", default=())

@@ -9,6 +9,7 @@ import time
 
 import numpy as np
 
+from ..core.capture import StageCapture
 from ..core.dataframe import DataFrame
 from ..core.params import (BooleanParam, ComplexParam, HasInputCol,
                            HasOutputCol, IntParam, ListParam, StringParam)
@@ -21,6 +22,7 @@ log = get_logger("stages")
 class Cacher(Transformer):
     """Materialize + cache (reference Cacher.scala:12). The columnar frame is
     already materialized; this pins it (no-op hook kept for API parity)."""
+    _uncapturable = True        # host materialization point by definition
     disable = BooleanParam("pass through without caching", default=False)
 
     def transform(self, df: DataFrame) -> DataFrame:
@@ -29,6 +31,7 @@ class Cacher(Transformer):
 
 class CheckpointData(Transformer):
     """Persist to memory/disk (reference CheckpointData.scala:47)."""
+    _uncapturable = True        # host persistence point
     diskIncluded = BooleanParam("also spill to disk", default=False)
     removeCheckpoint = BooleanParam("unpersist instead", default=False)
 
@@ -45,6 +48,11 @@ class DropColumns(Transformer):
             raise ValueError(f"cannot drop missing columns {missing}")
         return df.drop(*self.getCols())
 
+    def capture(self, columns):
+        if any(c not in columns for c in self.getCols()):
+            return None     # staged transform raises the real error
+        return StageCapture(lambda p, xs: (), drops=tuple(self.getCols()))
+
 
 class SelectColumns(Transformer):
     cols = ListParam("columns to keep", default=())
@@ -52,15 +60,31 @@ class SelectColumns(Transformer):
     def transform(self, df: DataFrame) -> DataFrame:
         return df.select(*self.getCols())
 
+    def capture(self, columns):
+        keep = set(self.getCols())
+        if any(c not in columns for c in keep):
+            return None     # staged transform raises the real error
+        return StageCapture(lambda p, xs: (),
+                            drops=tuple(c for c in columns
+                                        if c not in keep))
+
 
 class RenameColumn(Transformer, HasInputCol, HasOutputCol):
     def transform(self, df: DataFrame) -> DataFrame:
         return df.withColumnRenamed(self.getInputCol(), self.getOutputCol())
 
+    def capture(self, columns):
+        old, new = self.getInputCol(), self.getOutputCol()
+        if old not in columns:
+            return None
+        return StageCapture(lambda p, xs: (xs[0],), inputs=(old,),
+                            outputs=(new,), drops=(old,))
+
 
 class Repartition(Transformer):
     """Adjust logical partition count (reference Repartition.scala:18 with its
     `disable` flag)."""
+    _uncapturable = True        # host partition bookkeeping
     n = IntParam("target partition count", default=1, min=1)
     disable = BooleanParam("pass through unchanged", default=False)
 
@@ -72,6 +96,7 @@ class UDFTransformer(Transformer, HasInputCol, HasOutputCol):
     """Apply a python function per row value, or to the whole column when
     vectorized=True (reference UDFTransformer.scala:21; the python-UDF path
     of UDPyFParam)."""
+    _uncapturable = True        # arbitrary python — untraceable by contract
     udf = ComplexParam("function value->value (or column->column)", default=None)
     vectorized = BooleanParam("udf takes the whole column array", default=False)
 
@@ -116,6 +141,7 @@ class ClassBalancer(Estimator, HasInputCol, HasOutputCol):
 
 
 class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
+    _uncapturable = True        # dict lookup over arbitrary (string) keys
     weightTable = ComplexParam("class value -> weight", default=None)
 
     def transform(self, df: DataFrame) -> DataFrame:
@@ -129,6 +155,7 @@ class ClassBalancerModel(Model, HasInputCol, HasOutputCol):
 class MultiColumnAdapter(Transformer):
     """Map a unary stage over (inputCol, outputCol) pairs (reference
     MultiColumnAdapter.scala:17)."""
+    _uncapturable = True        # meta-stage: fit-and-transform inner stages
     baseStage = ComplexParam("unary PipelineStage to replicate", default=None)
     inputCols = ListParam("input columns", default=())
     outputCols = ListParam("output columns", default=())
@@ -158,6 +185,7 @@ class Timer(Transformer):
     Timer.scala:36-70 materializes to defeat laziness; our frames are eager so
     timing is direct). TPU upgrade: logToProfiler=True brackets the stage in a
     jax.profiler trace annotation for xplane tooling."""
+    _uncapturable = True        # wrapping semantics (times the inner stage)
     stage = ComplexParam("inner PipelineStage", default=None)
     logToConsole = BooleanParam("print timing", default=True)
     logToProfiler = BooleanParam("emit a jax.profiler annotation", default=False)
@@ -184,6 +212,7 @@ class Profiler(Transformer):
     ``traceDir`` for xplane/TensorBoard tooling — the first-class profiling
     stage the reference lacks (SURVEY.md §5: reference tracing is only the
     wall-clock Timer, pipeline-stages/.../Timer.scala:36-70)."""
+    _uncapturable = True        # wrapping semantics (profiles the inner stage)
     stage = ComplexParam("inner PipelineStage", default=None)
     traceDir = StringParam("directory for the xplane trace", default="")
 
@@ -243,3 +272,21 @@ class FastVectorAssembler(Transformer, HasOutputCol):
             offset += width
         meta = {MML_TAG: {"assembled": {"size": offset, "slots": slots}}}
         return df.withColumn(self.getOutputCol(), out, metadata=meta)
+
+    def capture(self, columns):
+        """Assembly is one concatenation — pure device work. The fused
+        form skips the categorical slot-range metadata (a FIT-time
+        concern: GBDT auto-categorical detection reads it when training,
+        and training always runs the staged transform)."""
+        cols = tuple(self.getInputCols())
+        if not cols or any(c not in columns for c in cols):
+            return None
+
+        def fn(p, xs):
+            import jax.numpy as jnp
+            parts = [jnp.reshape(x.astype(jnp.float32),
+                                 (x.shape[0], -1)) for x in xs]
+            return (jnp.concatenate(parts, axis=1),)
+
+        return StageCapture(fn, inputs=cols,
+                            outputs=(self.getOutputCol(),))

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..core.capture import StageCapture
 from ..core.dataframe import DataFrame
 from ..core.params import (BooleanParam, ComplexParam, DictParam, FloatParam,
                            HasInputCol, HasOutputCol, IntParam, ListParam,
@@ -84,6 +85,32 @@ class CleanMissingDataModel(Model):
             df = df.withColumn(o, np.where(np.isnan(vals), fills[c], vals))
         return df
 
+    def capture(self, columns):
+        """Imputation as one fused where(isnan) per column. The fused
+        path computes in float32 (device dtype) where the host path
+        returns float64; values are identical at f32 precision."""
+        ins = tuple(self.getInputCols())
+        outs = tuple(self.getOutputCols())
+        if not ins or len(ins) != len(outs) \
+                or any(c not in columns for c in ins):
+            return None
+        fills = self.getFillValues()
+        if fills is None or any(c not in fills for c in ins):
+            return None
+
+        def fn(p, xs):
+            import jax.numpy as jnp
+            out = []
+            for x, f in zip(xs, p["fills"]):
+                xf = x.astype(jnp.float32)
+                out.append(jnp.where(jnp.isnan(xf), f, xf))
+            return tuple(out)
+
+        return StageCapture(fn, inputs=ins, outputs=outs,
+                            params={"fills": [float(fills[c])
+                                              for c in ins]},
+                            host_cast={o: np.float64 for o in outs})
+
 
 class DataConversion(Transformer):
     """Column type casts + date reformat (reference DataConversion.scala:23).
@@ -120,10 +147,33 @@ class DataConversion(Transformer):
                 raise ValueError(f"unknown conversion target {target!r}")
         return df
 
+    #: numeric targets the fused path covers: device compute dtypes are
+    #: f32/i32, so wide targets cast at readback (host_cast) — values
+    #: identical wherever they fit the device dtype
+    _CAPTURE_TARGETS = {"float": (np.float32, np.float32),
+                        "double": (np.float32, np.float64),
+                        "integer": (np.int32, np.int32),
+                        "boolean": (np.bool_, np.bool_)}
+
+    def capture(self, columns):
+        target = self.getConvertTo()
+        cols = tuple(self.getCols())
+        if target not in self._CAPTURE_TARGETS or not cols \
+                or any(c not in columns for c in cols):
+            return None
+        dev_dtype, host_dtype = self._CAPTURE_TARGETS[target]
+
+        def fn(p, xs):
+            return tuple(x.astype(dev_dtype) for x in xs)
+
+        return StageCapture(fn, inputs=cols, outputs=cols,
+                            host_cast={c: host_dtype for c in cols})
+
 
 class PartitionSample(Transformer):
     """head / random % / assign-to-partition sampling (reference
     PartitionSample.scala:131)."""
+    _uncapturable = True        # host RNG + row-count-changing semantics
     mode = StringParam("Head|RandomSample|AssignToPartition",
                        default="RandomSample",
                        choices=("Head", "RandomSample", "AssignToPartition"))
@@ -148,6 +198,7 @@ class PartitionSample(Transformer):
 class SummarizeData(Transformer):
     """Per-column stats table (reference SummarizeData.scala:98): counts,
     basic moments, percentiles, error-count toggles."""
+    _uncapturable = True        # emits a fresh stats table, host collectives
     counts = BooleanParam("row/missing counts", default=True)
     basic = BooleanParam("mean/std/min/max", default=True)
     percentiles = BooleanParam("p25/p50/p75", default=True)
@@ -288,6 +339,7 @@ class SummarizeData(Transformer):
 class EnsembleByKey(Transformer):
     """Group rows by key column(s) and aggregate vector/double columns by
     mean or collect (reference EnsembleByKey.scala:21)."""
+    _uncapturable = True        # host groupBy over arbitrary key dtypes
     keys = ListParam("key columns", default=())
     cols = ListParam("value columns to aggregate", default=())
     strategy = StringParam("mean|collect", default="mean",
@@ -316,6 +368,7 @@ class EnsembleByKey(Transformer):
 class TextPreprocessor(Transformer, HasInputCol, HasOutputCol):
     """Longest-match substring replacement via a trie (reference
     TextPreprocessor.scala:97 builds a char trie over the map keys)."""
+    _uncapturable = True        # python string scanning
     map = DictParam("substring -> replacement", default=None)
     normFunc = StringParam("identity|lowerCase|upperCase", default="identity",
                            choices=("identity", "lowerCase", "upperCase"))

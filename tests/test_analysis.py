@@ -1033,6 +1033,118 @@ class TestProtocol:
 
 # -------------------------------------------------------- chaos coverage
 
+class TestPipelineCaptureCoverage:
+    _POSITIVE = """
+        import jax
+        from mmlspark_tpu.core.pipeline import Transformer
+
+        _scorer = jax.jit(lambda x: x * 2)
+
+        class DeviceStage(Transformer):
+            def transform(self, df):
+                return _scorer(df.col("x"))
+    """
+
+    def test_jit_dispatching_transform_without_capture_flagged(self, tmp_path):
+        fs = lint(tmp_path, self._POSITIVE,
+                  rules=["pipeline-capture-coverage"])
+        assert rules_of(fs) == ["pipeline-capture-coverage"]
+        assert "DeviceStage" in fs[0].message
+
+    def test_capture_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Transformer
+            from mmlspark_tpu.core.capture import StageCapture
+
+            _scorer = jax.jit(lambda x: x * 2)
+
+            class DeviceStage(Transformer):
+                def transform(self, df):
+                    return _scorer(df.col("x"))
+
+                def capture(self, columns):
+                    return StageCapture(lambda p, xs: (xs[0] * 2,),
+                                        inputs=("x",), outputs=("x",))
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_uncapturable_marker_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Transformer
+
+            _scorer = jax.jit(lambda x: x * 2)
+
+            class DeviceStage(Transformer):
+                _uncapturable = True    # replies ride a host side channel
+                def transform(self, df):
+                    return _scorer(df.col("x"))
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_interprocedural_dispatch_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Transformer
+
+            def _score_rows(x):
+                run = jax.jit(lambda v: v + 1)
+                return run(x)
+
+            class IndirectStage(Transformer):
+                def transform(self, df):
+                    return _score_rows(df.col("x"))
+        """, rules=["pipeline-capture-coverage"])
+        assert rules_of(fs) == ["pipeline-capture-coverage"]
+
+    def test_host_only_transform_not_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            from mmlspark_tpu.core.pipeline import Transformer
+
+            class HostStage(Transformer):
+                def transform(self, df):
+                    return df.withColumn("y", [1] * len(df))
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_delegating_wrapper_not_flagged(self, tmp_path):
+        # a Timer-shaped stage delegating to an INNER stage's transform
+        # does not inherit the inner stage's dispatch obligation
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Transformer
+
+            _scorer = jax.jit(lambda x: x)
+
+            class Inner(Transformer):
+                def transform(self, df):
+                    return _scorer(df)
+
+                def capture(self, columns):
+                    return None
+
+            class Wrapper(Transformer):
+                def transform(self, df):
+                    return self.getStage().transform(df)
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_abstract_stage_not_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Transformer
+
+            _scorer = jax.jit(lambda x: x)
+
+            class Base(Transformer):
+                _abstract = True
+                def transform(self, df):
+                    return _scorer(df)
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+
 class TestChaosCoverage:
     def _project(self, tmp_path, test_text, user_text):
         (tmp_path / "faults.py").write_text(textwrap.dedent("""

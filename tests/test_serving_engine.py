@@ -370,8 +370,10 @@ class TestBundle:
         assert loaded.compiles() == 0            # scoring stayed warm
         snap = telemetry.snapshot()
         series = snap["mmlspark_serving_bundle_loads_total"]["series"]
+        # other outcomes' children may exist at 0 from earlier tests
+        # (reset zeroes cells in place, it does not drop children)
         assert {tuple(sorted(s["labels"].items())): s["value"]
-                for s in series} == {(("result", "warm"),): 1.0}
+                for s in series if s["value"]} == {(("result", "warm"),): 1.0}
 
     def test_torn_exec_shard_falls_back_to_cold_compile(self, tel,
                                                         tiny_params,
