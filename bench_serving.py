@@ -370,16 +370,39 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
                           "rate": rate, **results["polling"]}))
 
     if "continuous" in engines:
+        from mmlspark_tpu import telemetry
+        from mmlspark_tpu.telemetry.federation import (FederatedSampler,
+                                                       FleetScraper)
         step = FusedServingStep(cfg, params,
                                 policy=BucketPolicy(max_batch=max_batch),
                                 row_shape=(32, 32, 3),
                                 in_dtype=np.uint8, output="argmax")
+        # fleet-view vs driver-view: sample the server's own request
+        # histogram and scrape it back over HTTP, exactly the way fleet
+        # federation sees a worker — the divergence between the merged
+        # (server-side) percentiles and the client-observed ones is the
+        # part of latency the server never sees (connect + queueing in
+        # the kernel + bucket-grid quantization)
+        telemetry.timeseries.start(interval=0.25)
         source, loop = serve_continuous(step, max_wait=max_wait,
                                         max_queue_depth=max_queue_depth)
+        scraper = FleetScraper(
+            targets=[("serving", f"{source.url}timeseries")],
+            interval=0.25, sampler=FederatedSampler(interval=0.25))
         try:
+            scraper.scrape_once()   # seed round: baselines, zero deltas
             results["continuous"] = run_open_loop(source.url, payload,
                                                   schedule, deadline,
                                                   pool)
+            time.sleep(0.6)         # let the sampler tick the last rows
+            scraper.scrape_once()
+            for q, label in ((0.50, "p50"), (0.99, "p99")):
+                p = scraper.sampler.worker_percentile(
+                    "serving", "mmlspark_http_request_seconds", q,
+                    window=duration + 120.0)
+                if p is not None:
+                    results["continuous"][f"fleet_{label}_ms"] = round(
+                        p * 1e3, 1)
         finally:
             loop.stop()
             source.close()
@@ -401,6 +424,17 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
             metrics.append({"metric": f"serving_open_loop_{q}_ms",
                             "value": cont[f"{q}_ms"], "unit": "ms",
                             "arrival": arrival, "rate": rate})
+        for q in ("p50", "p99"):
+            if f"fleet_{q}_ms" not in cont:
+                continue
+            metrics.append({"metric": f"serving_open_loop_fleet_{q}_ms",
+                            "value": cont[f"fleet_{q}_ms"], "unit": "ms",
+                            "arrival": arrival, "rate": rate})
+            metrics.append(
+                {"metric": f"serving_open_loop_view_divergence_{q}_ms",
+                 "value": round(cont[f"{q}_ms"] - cont[f"fleet_{q}_ms"],
+                                1),
+                 "unit": "ms", "arrival": arrival, "rate": rate})
     if poll:
         metrics.append({"metric": "serving_open_loop_polling_goodput_rps",
                         "value": poll["goodput_rps"], "unit": "req/s",

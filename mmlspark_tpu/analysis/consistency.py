@@ -9,6 +9,13 @@
   ``telemetry.trace.span/instant/complete`` (or handed to a prefetcher
   via a ``span=`` keyword) must appear in the span catalogue table, and
   vice versa.
+* ``metric-aggregation`` — every metric catalogue row must declare its
+  fleet-federation merge rule in the **Aggregation** column (counters
+  ``sum``, histograms ``histogram``, gauges ``sum``/``max``/``last``),
+  and the gauge cells must match the ``GAUGE_POLICIES`` table in
+  ``telemetry/federation.py`` in BOTH directions — the merge the
+  federated sampler performs and the merge the docs promise must be the
+  same merge.
 * ``fault-site`` — every ``faults.inject("<site>")`` call site must name
   a site registered in ``resilience/faults.py``'s ``SITES`` tuple, and
   every registered site must have at least one injection call — a chaos
@@ -216,6 +223,134 @@ def check_metric_catalogue(project: Project) -> Iterable[Finding]:
                     f"row or renamed metric)",
             hint="fix or drop the catalogue row", context="<doc>",
             code=name)
+
+
+_AGG_VALUES = {"sum", "max", "last", "histogram"}
+
+
+def _doc_metric_rows(doc_text: str):
+    """(names, type_cell, agg_cell, line_no) per metric-catalogue row.
+    ``agg_cell`` is None when the table has no Aggregation column."""
+    rows = []
+    in_section = False
+    type_i = agg_i = None
+    for ln, line in enumerate(doc_text.splitlines(), start=1):
+        if line.startswith("## "):
+            in_section = line[3:].strip().lower().startswith(
+                "metric catalogue")
+            type_i = agg_i = None
+            continue
+        if not in_section or not line.strip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.strip().strip("|").split("|")]
+        if not cells or set(cells[0]) <= {"-", " ", ":"}:
+            continue
+        if type_i is None:
+            headers = [c.lower() for c in cells]
+            type_i = headers.index("type") if "type" in headers else 1
+            agg_i = (headers.index("aggregation")
+                     if "aggregation" in headers else None)
+            continue
+        names = {re.sub(r"\{[^}]*\}", "", tok).strip()
+                 for tok in re.findall(r"`([^`]+)`", cells[0])}
+        names = {n for n in names
+                 if re.fullmatch(r"[A-Za-z_]\w*", n) and "_" in n}
+        if not names:
+            continue
+        typ = cells[type_i] if type_i < len(cells) else ""
+        agg = (cells[agg_i] if agg_i is not None and agg_i < len(cells)
+               else None)
+        rows.append((names, typ, agg, ln))
+    return rows
+
+
+def _gauge_policies(project: Project):
+    """(SourceFile, node, {name: policy}) from the GAUGE_POLICIES dict
+    literal in telemetry/federation.py."""
+    for sf in project.files:
+        if not sf.rel.endswith("federation.py"):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "GAUGE_POLICIES"
+                    for t in node.targets) \
+                    and isinstance(node.value, ast.Dict):
+                pol = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(v, ast.Constant):
+                        pol[k.value] = v.value
+                return sf, node, pol
+    return None, None, {}
+
+
+@rule("metric-aggregation", "consistency",
+      "the metric catalogue's Aggregation column vs the federation "
+      "merge policy (GAUGE_POLICIES) — both directions",
+      scope="project")
+def check_metric_aggregation(project: Project) -> Iterable[Finding]:
+    pol_sf, pol_node, policies = _gauge_policies(project)
+    if pol_sf is None:
+        return          # no federation layer in this project
+    doc = _doc_path(project)
+    if doc is None:
+        return
+    with open(doc, "r", encoding="utf-8") as fh:
+        doc_text = fh.read()
+    rows = _doc_metric_rows(doc_text)
+    if not rows:
+        return
+    rel_doc = os.path.relpath(doc, _repo_root(project)).replace(os.sep, "/")
+    documented_gauges: dict[str, str] = {}
+    for names, typ, agg, ln in rows:
+        first = sorted(names)[0]
+        if agg is None or agg not in _AGG_VALUES:
+            yield Finding(
+                rule="metric-aggregation", path=rel_doc, line=ln,
+                message=f"catalogue row for `{first}` declares no valid "
+                        f"Aggregation cell (got {agg!r}) — the fleet "
+                        f"federation merge rule for this metric is "
+                        f"undocumented",
+                hint="add the Aggregation column cell: counters `sum`, "
+                     "histograms `histogram`, gauges `sum`/`max`/`last`",
+                context="<doc>", code=first)
+            continue
+        expected = {"counter": "sum", "histogram": "histogram"}.get(typ)
+        if expected is not None and agg != expected:
+            yield Finding(
+                rule="metric-aggregation", path=rel_doc, line=ln,
+                message=f"catalogue row for `{first}` ({typ}) declares "
+                        f"Aggregation `{agg}` but every {typ} merges as "
+                        f"`{expected}` across the fleet",
+                hint=f"set the cell to `{expected}`",
+                context="<doc>", code=first)
+        if typ == "gauge":
+            for n in names:
+                base = n[:-6] if n.endswith("_total") else n
+                documented_gauges[base] = agg
+                declared = policies.get(n, policies.get(base, "sum"))
+                if agg != declared:
+                    yield Finding(
+                        rule="metric-aggregation", path=rel_doc, line=ln,
+                        message=f"catalogue row declares gauge `{n}` "
+                                f"merges by `{agg}` but "
+                                f"telemetry/federation.py GAUGE_POLICIES "
+                                f"resolves it to `{declared}` — the docs "
+                                f"and the federated sampler disagree",
+                        hint="fix the Aggregation cell or the "
+                             "GAUGE_POLICIES entry",
+                        context="<doc>", code=n)
+    for name, declared in sorted(policies.items()):
+        if name not in documented_gauges:
+            f = pol_sf.finding(
+                "metric-aggregation", pol_node,
+                f"GAUGE_POLICIES declares `{name}` merges by "
+                f"`{declared}` but no gauge row in the metric catalogue "
+                f"documents it — stale policy entry or renamed metric",
+                hint="drop the entry or fix the catalogue row",
+                context="GAUGE_POLICIES")
+            if f:
+                yield f
 
 
 @rule("span-catalogue", "consistency",

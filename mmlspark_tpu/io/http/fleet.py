@@ -209,7 +209,8 @@ class ProcessHTTPSource:
 
     def __init__(self, n_workers: int = 2, host: str = "127.0.0.1",
                  base_port: int = 0, poll_timeout: float = 0.02,
-                 max_queue_depth: int = 0, workers: list = None):
+                 max_queue_depth: int = 0, workers: list = None,
+                 extra_argv: tuple = ()):
         if workers is not None:
             # pre-built handles (in-process chaos tests, custom spawners)
             self.workers: list[_Worker] = list(workers)
@@ -219,7 +220,8 @@ class ProcessHTTPSource:
             try:
                 for _ in range(n_workers):
                     w = _Worker(host, port, 0,
-                                max_queue_depth=max_queue_depth)
+                                max_queue_depth=max_queue_depth,
+                                extra_argv=extra_argv)
                     self.workers.append(w)
                     if base_port:
                         port = w.port + 1
@@ -229,6 +231,9 @@ class ProcessHTTPSource:
                     w.kill()
                 raise
         self.poll_timeout = poll_timeout
+        # optional telemetry.federation.FleetScraper attached by
+        # serve_fleet(federate=True); close() stops it with the fleet
+        self.federation = None
         # the replayable offset log and everything hanging off it is
         # shared between the serving loop, the supervisor thread, and
         # HTTPSink callers — all mutations go through self._lock (the
@@ -644,6 +649,8 @@ class ProcessHTTPSource:
         self.workers[i].kill()
 
     def close(self) -> None:
+        if self.federation is not None:
+            self.federation.stop()
         for w in self.workers:
             w.kill()
 
@@ -746,10 +753,11 @@ class ReplayServingLoop:
 
 
 def fleet_doc(source: ProcessHTTPSource, autoscaler=None,
-              reconciler=None) -> dict:
+              reconciler=None, scraper=None) -> dict:
     """The single-probe fleet health doc: per-worker ``/healthz``
-    aggregation plus the ``autoscale`` and ``reconciler`` control-plane
-    sections. Wire it to a driver-side
+    aggregation plus the ``autoscale``, ``reconciler`` and
+    ``federation`` (scrape freshness + per-worker latency skew)
+    control-plane sections. Wire it to a driver-side
     :class:`~.server.HTTPSource`'s ``fleet_state`` so ``GET /healthz``
     on the driver shows the whole fleet."""
     doc = source.fleet_healthz()
@@ -758,31 +766,44 @@ def fleet_doc(source: ProcessHTTPSource, autoscaler=None,
     if reconciler is not None:
         doc["reconciler"] = reconciler.state()
         doc["ok"] = doc["ok"] and reconciler.state()["last_error"] is None
+    if scraper is not None:
+        doc["federation"] = scraper.healthz()
     return doc
 
 
 class AutoscaledFleet:
     """Handle over an SLO-driven elastic serving fleet: the worker
     source, the optional driver batch loop, the reconciler, the
-    autoscaler, and the driver health server. ``stop()`` tears all of
-    it down in dependency order."""
+    autoscaler, the metric-federation scraper, and the driver health
+    server. ``stop()`` tears all of it down in dependency order."""
 
-    def __init__(self, source, loop, reconciler, autoscaler, health):
+    def __init__(self, source, loop, reconciler, autoscaler, health,
+                 scraper=None):
         self.source = source
         self.loop = loop
         self.reconciler = reconciler
         self.autoscaler = autoscaler
         self.health = health
+        self.scraper = scraper
 
     @property
     def urls(self) -> list[str]:
         return self.source.urls
 
+    @property
+    def federated(self):
+        """The fleet-wide :class:`~...telemetry.federation
+        .FederatedSampler` (None when federation is off)."""
+        return self.scraper.sampler if self.scraper is not None else None
+
     def healthz(self) -> dict:
-        return fleet_doc(self.source, self.autoscaler, self.reconciler)
+        return fleet_doc(self.source, self.autoscaler, self.reconciler,
+                         self.scraper)
 
     def stop(self):
         self.autoscaler.stop()
+        if self.scraper is not None:
+            self.scraper.stop()
         self.reconciler.stop()
         if self.loop is not None:
             self.loop.stop()        # also closes the source
@@ -803,7 +824,9 @@ def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
                      probe_interval: float = 0.25,
                      reconcile_interval: float = 0.25,
                      autoscale_interval: float = 0.5,
-                     objectives=None, load_fn=None) -> AutoscaledFleet:
+                     objectives=None, load_fn=None,
+                     federate: bool = True,
+                     scrape_interval: float = 0.5) -> AutoscaledFleet:
     """Spin up the SLO-driven elastic serving fleet.
 
     ``slo`` is an :class:`~...telemetry.slo.SLOEngine` (or a config
@@ -816,22 +839,40 @@ def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
     * ``transformer`` — the classic driver micro-batch loop
       (:class:`ReplayServingLoop`) over the worker fleet.
 
-    The engine must evaluate over series visible in THIS process's
-    registry (in-process worker fleets share it; subprocess fleets
-    scale on driver-side series such as a goodput objective over the
-    offset log, or a custom ``load_fn``).
+    With ``federate=True`` (the default) the engine evaluates
+    FLEET-WIDE series: workers arm their samplers (``--timeseries``), a
+    :class:`~...telemetry.federation.FleetScraper` pulls every worker's
+    ``GET /timeseries`` each ``scrape_interval`` seconds, and the
+    engine is re-bound to the merged
+    :class:`~...telemetry.federation.FederatedSampler` (driver-local
+    series keep riding along as pseudo-worker ``driver``) — so latency
+    objectives over worker-side request histograms burn, the autoscaler
+    grows on what the fleet actually serves, and the scraper pushes the
+    shed verdict (with its burn-derived Retry-After) to every worker
+    door. With ``federate=False`` the engine sees only series in THIS
+    process's registry — in-process worker fleets share it; subprocess
+    fleets then scale on driver-side series such as a goodput objective
+    over the offset log, or a custom ``load_fn``.
 
     ``health_port`` (0 = kernel-assigned) additionally starts a
     driver-side health server whose ``GET /healthz`` embeds the
-    fleet-level doc (per-worker health + autoscale + reconciler)."""
+    fleet-level doc (per-worker health + autoscale + reconciler +
+    federation) and, when federating, serves ``GET /fleet/metrics``
+    (aggregated exposition) and ``GET /timeseries?scope=fleet``."""
     from ...resilience.autoscale import ServingAutoscaler
     from ...resilience.reconciler import FleetReconciler
+    from ...telemetry.federation import FleetScraper
     from ...telemetry.slo import SLOEngine
     if (transformer is None) == (bundle_dir is None):
         raise ValueError("pass exactly one of transformer / bundle_dir")
     if not isinstance(slo, SLOEngine):
         slo = SLOEngine.from_config(slo)
     extra_argv = ("--bundle", bundle_dir) if bundle_dir else ()
+    if federate:
+        # workers must sample their own registries for the scraper to
+        # have history to pull; respawned/grown workers inherit the flag
+        # through the reconciler's preserved extra_argv
+        extra_argv += ("--timeseries", str(scrape_interval))
     replicas = max(min_workers, min(max_workers, replicas))
     workers = []
     try:
@@ -844,6 +885,15 @@ def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
             w.kill()
         raise
     source = ProcessHTTPSource(workers=workers)
+    scraper = None
+    if federate:
+        scraper = FleetScraper(source=source, interval=scrape_interval,
+                               slo=slo, push_shed=True)
+        # the engine now evaluates merged fleet-wide series — the same
+        # read surface, so objectives need no change
+        slo.sampler = scraper.sampler
+        source.federation = scraper
+        scraper.start()
     reconciler = FleetReconciler(
         source, replicas, min_workers=min_workers,
         max_workers=max_workers, interval=reconcile_interval,
@@ -863,22 +913,49 @@ def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
         health = HTTPSource(host=host, port=health_port,
                             name="fleet-driver", slo=slo)
         health.fleet_state = lambda: fleet_doc(source, autoscaler,
-                                               reconciler)
-    return AutoscaledFleet(source, loop, reconciler, autoscaler, health)
+                                               reconciler, scraper)
+        if scraper is not None:
+            health.fleet_metrics = scraper.sampler.prometheus_text
+            health.fleet_timeseries = scraper.sampler.snapshot
+    return AutoscaledFleet(source, loop, reconciler, autoscaler, health,
+                           scraper=scraper)
 
 
 def serve_fleet(transformer, n_workers: int = 2, host: str = "127.0.0.1",
                 base_port: int = 0, prefetch_depth: int = 2,
                 max_queue_depth: int = 0, supervise: bool = False,
-                probe_interval: float = 0.25):
+                probe_interval: float = 0.25, federate: bool = False,
+                scrape_interval: float = 0.5, slo=None):
     """Spawn the worker fleet + replay loop; returns (source, loop). One
     transformer call per micro-batch serves every worker process's
     in-flight requests. ``supervise=True`` attaches a
     :class:`~mmlspark_tpu.resilience.FleetSupervisor` (health probing +
-    automatic restart of dead workers), stopped by ``loop.stop()``."""
+    automatic restart of dead workers), stopped by ``loop.stop()``.
+
+    ``federate=True`` arms every worker's sampler (``--timeseries``) and
+    attaches a :class:`~...telemetry.federation.FleetScraper` pulling
+    each worker's control-plane ``GET /timeseries`` every
+    ``scrape_interval`` seconds into a merged
+    :class:`~...telemetry.federation.FederatedSampler`
+    (``source.federation.sampler``); pass ``slo`` (an
+    :class:`~...telemetry.slo.SLOEngine`) to re-bind its objectives onto
+    the fleet-wide series and push burn-derived shed hints to worker
+    doors. The scraper stops with ``source.close()``."""
+    extra_argv = ()
+    if federate:
+        extra_argv = ("--timeseries", str(scrape_interval))
     source = ProcessHTTPSource(n_workers=n_workers, host=host,
                                base_port=base_port,
-                               max_queue_depth=max_queue_depth)
+                               max_queue_depth=max_queue_depth,
+                               extra_argv=extra_argv)
+    if federate:
+        from ...telemetry.federation import FleetScraper
+        scraper = FleetScraper(source=source, interval=scrape_interval,
+                               slo=slo, push_shed=slo is not None)
+        if slo is not None:
+            slo.sampler = scraper.sampler
+        source.federation = scraper
+        scraper.start()
     supervisor = None
     if supervise:
         from ...resilience.supervisor import FleetSupervisor

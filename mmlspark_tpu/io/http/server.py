@@ -132,6 +132,17 @@ class HTTPSource:
         # (and in-process worker sources) must not recurse through the
         # aggregation probe.
         self.fleet_state = None
+        # driver-only federation surface, same instance-scoping rule:
+        # ``fleet_metrics`` (-> exposition text) answers GET
+        # /fleet/metrics; ``fleet_timeseries`` (-> snapshot dict) answers
+        # GET /timeseries?scope=fleet. Both stay None on workers.
+        self.fleet_metrics = None
+        self.fleet_timeseries = None
+        # fleet-burn shed hint pushed by the driver's FleetScraper
+        # (control POST /shed): while set, this door sheds with the
+        # driver-computed burn-derived Retry-After — the engine runs on
+        # the driver, the admission control runs here
+        self._shed_hint = None   # Retry-After seconds, or None
         self._t0 = time.monotonic()
         # live requests awaiting batch pickup. NOT _pending.qsize(): a
         # timed-out client's exchange lingers in the queue until a later
@@ -154,7 +165,8 @@ class HTTPSource:
                 if telemetry.enabled():
                     ctx = (telemetry.context.from_headers(self.headers)
                            or telemetry.context.new_trace())
-                shed = source._draining
+                hint = source._shed_hint
+                shed = source._draining or hint is not None
                 if not shed and source.max_queue_depth:
                     with source._lock:
                         shed = source._n_pending >= source.max_queue_depth
@@ -165,10 +177,13 @@ class HTTPSource:
                     shed = source.slo.should_shed()
                 if shed:
                     # Retry-After is derived from the SLO burn severity
-                    # (fast-window ratio) when an engine is attached:
-                    # clients back off proportionally to the overload
-                    # instead of stampeding back after a fixed second
-                    retry_after = (source.slo.retry_after()
+                    # (fast-window ratio): a local engine computes it
+                    # here; a fleet worker gets it pushed as the shed
+                    # hint (the driver's engine evaluated FLEET burn).
+                    # Clients back off proportionally to the overload
+                    # instead of stampeding back after a fixed second.
+                    retry_after = (hint if hint is not None
+                                   else source.slo.retry_after()
                                    if source.slo is not None else 1)
                     _m_shed.inc()
                     _m_replies.labels(code="503").inc()
@@ -232,10 +247,13 @@ class HTTPSource:
                 except Exception:
                     self.send_error(503, "injected debug-plane fault")
                     return
+                path, _, query = self.path.partition("?")
+                params = dict(p.partition("=")[::2]
+                              for p in query.split("&") if p)
                 # Prometheus scrape surface: every serving process (the
                 # single-process loop AND each fleet worker) answers
                 # GET /metrics with its own registry's exposition
-                if self.path == "/metrics":
+                if path == "/metrics":
                     payload = telemetry.prometheus_text().encode("utf-8")
                     self.send_response(200)
                     # the full 0.0.4 exposition content type — Prometheus
@@ -246,7 +264,24 @@ class HTTPSource:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
-                elif self.path == "/debug/flight":
+                elif path == "/fleet/metrics":
+                    # the federation surface: fleet-wide merged series
+                    # (aggregates + worker= children) in exposition form.
+                    # Only the driver wires fleet_metrics; elsewhere 404.
+                    if source.fleet_metrics is None:
+                        self.send_error(404,
+                                        "no fleet federation on this "
+                                        "server")
+                        return
+                    payload = source.fleet_metrics().encode("utf-8")
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif path == "/debug/flight":
                     # the flight-recorder bundle on demand: recent span
                     # events, metric deltas, and the armed fault plan —
                     # "it hung once" becomes an artifact
@@ -258,7 +293,7 @@ class HTTPSource:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
-                elif self.path == "/healthz":
+                elif path == "/healthz":
                     # liveness + load surface for the fleet supervisor and
                     # external orchestrators (k8s-style probes)
                     payload = json.dumps(source.health()).encode("utf-8")
@@ -267,11 +302,21 @@ class HTTPSource:
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
-                elif self.path == "/timeseries":
+                elif path == "/timeseries":
                     # the sampler's ring buffers as JSON: recent history
-                    # of every metric series, not just the last scrape
-                    payload = json.dumps(
-                        telemetry.timeseries.snapshot()).encode("utf-8")
+                    # of every metric series, not just the last scrape.
+                    # ?scope=fleet asks for the FEDERATED rings (merged
+                    # worker series) — driver-only, 404 elsewhere.
+                    if params.get("scope") == "fleet":
+                        if source.fleet_timeseries is None:
+                            self.send_error(404,
+                                            "no fleet federation on "
+                                            "this server")
+                            return
+                        doc = source.fleet_timeseries()
+                    else:
+                        doc = telemetry.timeseries.snapshot()
+                    payload = json.dumps(doc).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
@@ -304,6 +349,16 @@ class HTTPSource:
             log.info("serving source on port %d draining: new requests "
                      "shed, %d in flight", self.port, self.inflight())
 
+    def set_shed_hint(self, retry_after) -> None:
+        """Install (or clear, with ``None``) the fleet-burn shed hint:
+        the driver's federated SLO engine decided admission control for
+        the whole fleet and pushed its burn-derived Retry-After here —
+        new requests shed 503 while the hint is set."""
+        self._shed_hint = int(retry_after) if retry_after else None
+        if self._shed_hint is not None:
+            log.info("serving source on port %d shedding on fleet burn "
+                     "(Retry-After %ds)", self.port, self._shed_hint)
+
     def inflight(self) -> int:
         """Admitted exchanges not yet replied (queued + in a batch) —
         the count graceful drain waits out."""
@@ -322,6 +377,7 @@ class HTTPSource:
                "queue_depth": depth,
                "inflight": inflight,
                "draining": self._draining,
+               "fleet_shed_retry_after": self._shed_hint,
                "max_queue_depth": self.max_queue_depth,
                "breakers": CircuitBreaker.snapshot_all()}
         if self.slo is not None:

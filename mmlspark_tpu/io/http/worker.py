@@ -48,7 +48,15 @@ class WorkerServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  control_port: int = 0, max_queue_depth: int = 0,
-                 bundle: str = None, max_wait: float = 0.01):
+                 bundle: str = None, max_wait: float = 0.01,
+                 timeseries: float = None):
+        if timeseries:
+            # arm this process's sampler so the control-plane GET
+            # /timeseries has history for the driver's FleetScraper to
+            # federate (spawners pass --timeseries when federating; the
+            # MMLSPARK_TPU_TIMESERIES env arms it for everything else)
+            from ... import telemetry
+            telemetry.timeseries.start(interval=float(timeseries))
         self.source = HTTPSource(host=host, port=port, name="worker",
                                  max_queue_depth=max_queue_depth)
         self.serving = None
@@ -179,6 +187,20 @@ class WorkerServer:
                         worker.source.respond(str(ex_id), int(code),
                                               str(body))
                     self._json(200, {})
+                elif self.path == "/shed":
+                    # fleet-burn admission control, pushed: the DRIVER's
+                    # federated SLO engine saw the fleet-wide budget
+                    # burning and tells this door to shed with its
+                    # burn-derived Retry-After (cleared the same way once
+                    # the burn recovers)
+                    if req.get("shed"):
+                        worker.source.set_shed_hint(
+                            req.get("retry_after") or 1)
+                    else:
+                        worker.source.set_shed_hint(None)
+                    self._json(200, {
+                        "shed": worker.source._shed_hint is not None,
+                        "retry_after": worker.source._shed_hint})
                 elif self.path == "/drain":
                     # graceful scale-down, step 1: stop admitting. New
                     # client POSTs shed 503 + Retry-After; everything
@@ -231,10 +253,15 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wait", type=float, default=0.01,
                     help="continuous batcher's max-wait deadline seconds "
                          "(bundle mode)")
+    ap.add_argument("--timeseries", type=float, default=None,
+                    help="arm the in-process time-series sampler at this "
+                         "tick interval (seconds) so the driver's fleet "
+                         "federation can scrape GET /timeseries")
     args = ap.parse_args(argv)
     w = WorkerServer(args.host, args.port, args.control_port,
                      max_queue_depth=args.max_queue_depth,
-                     bundle=args.bundle, max_wait=args.max_wait)
+                     bundle=args.bundle, max_wait=args.max_wait,
+                     timeseries=args.timeseries)
     print(json.dumps({"port": w.source.port, "control": w.control_port}),
           flush=True)
     try:

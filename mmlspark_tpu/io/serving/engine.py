@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
 from ... import telemetry
@@ -27,6 +28,13 @@ from .batcher import BucketPolicy, ContinuousBatcher
 from .step import FusedServingStep
 
 log = get_logger("io.serving")
+
+_m_dispatch = telemetry.registry.histogram(
+    "mmlspark_serving_dispatch_seconds",
+    "device dispatch + reply encode per bucket batch (the worker-side "
+    "half of request latency; fleet federation merges it bucket-wise "
+    "across workers for per-worker attribution)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
 
 
 class ContinuousServingLoop:
@@ -95,10 +103,13 @@ class ContinuousServingLoop:
                 out = self.step.score_rows(rows, bucket)
                 for ex, y in zip(exchanges, out):
                     self.source.respond(ex.id, 200, self.step.encode(y))
+        t0 = time.perf_counter()
         try:
             self._retry.run(attempt)
         except Exception as e:   # reply 500s, never hang clients
             self._fail(exchanges, e)
+        finally:
+            _m_dispatch.observe(time.perf_counter() - t0)
 
     def _run(self):
         from ...parallel import prefetch as prefetchlib

@@ -9,7 +9,13 @@ verdicts on latency/goodput objectives ARE the scaling signal.
 
 :class:`ServingAutoscaler` evaluates the engine each tick and turns
 sustained pressure into verdicts written to the
-:class:`~.reconciler.FleetReconciler`'s desired replica count:
+:class:`~.reconciler.FleetReconciler`'s desired replica count. The
+engine's sampler decides WHOSE latency burns the budget: under fleet
+federation (``serve_autoscaled(federate=True)``) it is the merged
+:class:`~..telemetry.federation.FederatedSampler`, so a latency breach
+that exists only inside worker processes — invisible to every
+driver-side series — still burns, grows the fleet, and sheds with a
+burn-derived Retry-After at every worker door:
 
 * **GROW** — a watched objective (by default every ``latency`` /
   ``goodput`` objective) in **breach** continuously for ``grow_window``

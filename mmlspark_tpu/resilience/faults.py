@@ -38,7 +38,7 @@ Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``elastic.remesh``, ``elastic.evict``, ``autoscale.verdict``,
 ``distributed.rendezvous``, ``distributed.lease``, ``ckpt.write``,
 ``ckpt.rename``, ``ckpt.shard``, ``downloader.fetch``,
-``codegen.write``.
+``codegen.write``, ``federation.scrape``, ``federation.merge``.
 """
 
 from __future__ import annotations
@@ -75,7 +75,7 @@ SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
          "elastic.evict", "autoscale.verdict",
          "distributed.rendezvous", "distributed.lease", "ckpt.write",
          "ckpt.rename", "ckpt.shard", "downloader.fetch",
-         "codegen.write")
+         "codegen.write", "federation.scrape", "federation.merge")
 
 
 class InjectedFault(ConnectionError):

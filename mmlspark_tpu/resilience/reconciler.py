@@ -17,7 +17,11 @@ way a Deployment controller converges replicas (PAPERS.md arxiv
      (health probes, exponential-backoff respawn): a kill -9'd worker is
      relaunched **into the same slot** — same ports, same
      ``extra_argv`` (``--bundle`` included, so the fresh incarnation
-     answers warm) — the serving fleet's "same rendezvous lineage";
+     answers warm; ``--timeseries`` included, so a federated fleet's
+     respawned worker keeps feeding the driver's
+     :class:`~..telemetry.federation.FleetScraper` — its counters
+     restart at zero and the merge absorbs the reset) — the serving
+     fleet's "same rendezvous lineage";
   2. *drain progress* — draining workers are retired the moment
      :meth:`~...io.http.fleet.ProcessHTTPSource.drainComplete` holds
      (nothing in flight anywhere: zero loss by construction), or

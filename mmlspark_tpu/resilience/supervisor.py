@@ -70,7 +70,10 @@ _m_restart_failures = telemetry.registry.counter(
 def _default_respawn(wi: int, old):
     """Respawn the worker subprocess on the old incarnation's ports (the
     server sockets use SO_REUSEADDR, so the rebind succeeds immediately
-    and client retries land on the same URL)."""
+    and client retries land on the same URL). ``extra_argv`` is
+    preserved, so a federated worker's ``--timeseries`` flag survives
+    the restart — the fresh incarnation's cumulative series restart at
+    zero, a monotonic reset the driver's FederatedSampler absorbs."""
     from ..io.http.fleet import _Worker
     try:
         old.kill()   # reap the zombie; no-op for already-waited procs

@@ -45,9 +45,20 @@ timeseries = SAMPLER
 __all__ = ["registry", "trace", "enabled", "enable", "disable",
            "snapshot", "prometheus_text", "warn_once", "merge_traces",
            "context", "profiler", "flight", "timeseries", "slo",
+           "federation",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "TimeSeriesSampler",
            "DEFAULT_TIME_BUCKETS", "pow2_buckets"]
+
+
+def __getattr__(name):
+    # lazy: federation pulls in resilience.policy (retry/breaker), which
+    # imports this package — a deferred submodule import instead of a
+    # cycle at package init
+    if name == "federation":
+        import importlib
+        return importlib.import_module(".federation", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def enabled() -> bool:

@@ -54,6 +54,18 @@ _m_ticks = REGISTRY.counter(
 _m_series = REGISTRY.gauge(
     "mmlspark_timeseries_series",
     "live series held in the time-series sampler's rings")
+_m_resets = REGISTRY.counter(
+    "mmlspark_timeseries_resets",
+    "monotonic resets observed on cumulative series (registry.reset / "
+    "process restart); window_delta clamps at zero across the boundary")
+
+
+def is_cumulative(key: str) -> bool:
+    """True for series whose values only grow between resets: counters
+    (``_total``) and flattened histogram components. Gauges may move
+    either way, so reset clamping never applies to them."""
+    base = key.partition("{")[0]
+    return base.endswith(("_total", "_count", "_sum", "_bucket"))
 
 
 def _expo(name: str, kind: str) -> str:
@@ -118,6 +130,7 @@ class TimeSeriesSampler:
         changed, token = self.registry.snapshot_delta(self._token)
         points = [(key, v) for name, fam in changed.items()
                   for key, v in flatten_family(name, fam)]
+        resets = 0
         with self._lock:
             self._token = token
             for key, v in points:
@@ -127,10 +140,20 @@ class TimeSeriesSampler:
                         maxlen=self.capacity)
                     if first:
                         self._seeded.add(key)
+                elif ring and v < ring[-1][1] and is_cumulative(key):
+                    # a cumulative value moved BACKWARD: registry.reset()
+                    # or a counter re-registered by a restarted component.
+                    # Recorded so the zero-clamped window_delta reads that
+                    # follow are explainable from the trace.
+                    resets += 1
                 ring.append((t, v))
             n_series = len(self._rings)
         _m_ticks.inc()
         _m_series.set(n_series)
+        if resets:
+            _m_resets.inc(resets)
+            from . import trace
+            trace.instant("timeseries/reset", series=resets)
         return len(points)
 
     # -------------------------------------------------------------- reading
@@ -162,7 +185,14 @@ class TimeSeriesSampler:
         mid-sampling (a labeled child minted by its first write — e.g.
         the first 500 reply ever) was 0 before its first point, so the
         baseline is 0 and that first burst is fully visible. None only
-        when the series is empty or starts after ``now``."""
+        when the series is empty or starts after ``now``.
+
+        A cumulative series whose window spans a reset boundary
+        (``registry.reset()``, a restarted component) would read
+        NEGATIVE — the end value restarted below the baseline. That is
+        clamped at zero (and the reset was recorded as a
+        ``timeseries/reset`` instant at tick time): one quiet window
+        beats a nonsense rate poisoning every burn evaluation above."""
         with self._lock:
             ring = self._rings.get(key)
             pts = list(ring) if ring is not None else []
@@ -178,7 +208,10 @@ class TimeSeriesSampler:
         i_start = bisect.bisect_right(times, t - window)
         start = pts[i_start - 1][1] if i_start else \
             (pts[0][1] if seeded else 0.0)
-        return end - start
+        delta = end - start
+        if delta < 0 and is_cumulative(key):
+            return 0.0
+        return delta
 
     def window_points(self, key: str, window: float,
                       now: Optional[float] = None) -> list:

@@ -1,0 +1,632 @@
+"""Fleet metric federation: the single-process reset clamp, merged-ring
+semantics (counter sums + worker children, monotonic-reset absorption,
+bucket-wise histogram parity, gauge policies, staleness windows), the
+two new chaos sites (`federation.scrape`, `federation.merge`), breaker
+open/half-open recovery, per-worker latency-skew attribution, driver
+fleet endpoints, pushed shed verdicts, and the subprocess e2e: latency
+that exists ONLY in worker histograms burns the driver's SLO engine and
+grows the fleet."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.core.utils import object_column
+from mmlspark_tpu.io.http.fleet import (ProcessHTTPSource,
+                                        ReplayServingLoop, _Worker)
+from mmlspark_tpu.io.http.server import HTTPSource
+from mmlspark_tpu.io.http.worker import WorkerServer
+from mmlspark_tpu.resilience import faults
+from mmlspark_tpu.resilience.autoscale import ServingAutoscaler
+from mmlspark_tpu.resilience.reconciler import FleetReconciler
+from mmlspark_tpu.telemetry.federation import (FederatedSampler,
+                                               FleetScraper)
+from mmlspark_tpu.telemetry.slo import SLOEngine, _key_labels
+from mmlspark_tpu.telemetry.timeseries import (TimeSeriesSampler,
+                                               percentile_from_buckets)
+
+T0 = 1000.0
+
+
+@pytest.fixture
+def tel():
+    telemetry.enable()
+    telemetry.registry.reset()
+    yield telemetry
+    telemetry.disable()
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+def _counter_total(name):
+    snap = telemetry.snapshot()
+    return sum(s["value"] for s in snap.get(name, {}).get("series", []))
+
+
+def _scrapes(outcome):
+    snap = telemetry.snapshot()
+    return sum(s["value"]
+               for s in snap.get("mmlspark_federation_scrapes",
+                                 {}).get("series", [])
+               if s.get("labels", {}).get("outcome") == outcome)
+
+
+def _get_json(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post_json(url, obj, timeout=5.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _snap(series, t=T0):
+    """A synthetic mmlspark-timeseries/v1 snapshot: one point per key."""
+    return {"schema": "mmlspark-timeseries/v1", "interval": 1.0,
+            "capacity": 600,
+            "series": {k: [[t, float(v)]] for k, v in series.items()}}
+
+
+def _worker_ts_url(ws):
+    return f"http://127.0.0.1:{ws.control_port}/timeseries"
+
+
+# ------------------------------------------- single-process reset clamp
+
+class TestResetClamp:
+    """Satellite pin: window_delta over a registry.reset() boundary
+    clamps at zero for cumulative series (and only those), and the
+    sampler counts the reset + drops a `timeseries/reset` instant."""
+
+    def test_cumulative_window_delta_clamps_at_zero(self, tel):
+        c = tel.registry.counter("test_fed_clamp", "reset-clamp pin")
+        g = tel.registry.gauge("test_fed_level", "gauge control")
+        s = TimeSeriesSampler(interval=1.0)
+        c.inc(5)
+        g.set(5)
+        s.tick(now=T0)
+        c.inc(5)
+        g.set(4)
+        s.tick(now=T0 + 1)
+        resets0 = _counter_total("mmlspark_timeseries_resets")
+        tel.registry.reset()              # the restart stand-in
+        c.inc(2)
+        g.set(2)
+        s.tick(now=T0 + 2)
+        # counter: 10 -> 2 is a reset, not -8 worth of negative progress
+        assert s.window_delta("test_fed_clamp_total", 10.0, T0 + 2) == 0.0
+        # gauge: levels legitimately fall; no clamp
+        assert s.window_delta("test_fed_level", 10.0, T0 + 2) == 2 - 5
+        assert _counter_total("mmlspark_timeseries_resets") > resets0
+        assert "timeseries/reset" in [e.get("name")
+                                      for e in telemetry.trace.events()]
+
+
+# ------------------------------------------------- merged-ring semantics
+
+class TestFederatedMerge:
+    def _armed(self, **kw):
+        """A sampler past its first merge round, so rings born from the
+        next merge are born-mid-sampling (baseline 0 -> full deltas)."""
+        fed = FederatedSampler(interval=1.0, **kw)
+        fed.merge(now=T0)
+        return fed
+
+    def test_counters_sum_with_worker_children(self, tel):
+        fed = self._armed()
+        fed.ingest("w0", _snap({"test_fed_requests_total": 9}), now=T0 + 1)
+        fed.ingest("w1", _snap({"test_fed_requests_total": 7}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        assert fed.value_at("test_fed_requests_total", T0 + 1) == 16.0
+        assert fed.value_at(
+            'test_fed_requests_total{worker="w0"}', T0 + 1) == 9.0
+        assert fed.value_at(
+            'test_fed_requests_total{worker="w1"}', T0 + 1) == 7.0
+        assert fed.window_delta("test_fed_requests_total",
+                                60.0, T0 + 1) == 16.0
+
+    def test_counter_reset_absorbed_monotonically(self, tel):
+        fed = self._armed()
+        fed.ingest("w0", _snap({"test_fed_requests_total": 9}), now=T0 + 1)
+        fed.ingest("w1", _snap({"test_fed_requests_total": 7}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        # w1 restarts: its counter drops 7 -> 1; the plateau is absorbed
+        fed.ingest("w1", _snap({"test_fed_requests_total": 1}), now=T0 + 2)
+        fed.merge(now=T0 + 2)
+        assert fed.value_at("test_fed_requests_total", T0 + 2) == 17.0
+        assert fed.value_at(
+            'test_fed_requests_total{worker="w1"}', T0 + 2) == 8.0
+        assert _counter_total("mmlspark_federation_counter_resets") == 1
+        assert "federation/reset" in [e.get("name")
+                                      for e in telemetry.trace.events()]
+
+    def test_forget_worker_parks_its_contribution(self, tel):
+        fed = self._armed()
+        fed.ingest("w0", _snap({"test_fed_requests_total": 9}), now=T0 + 1)
+        fed.ingest("w1", _snap({"test_fed_requests_total": 7}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        fed.forget_worker("w1", absorb=True)
+        fed.ingest("w0", _snap({"test_fed_requests_total": 12}), now=T0 + 2)
+        fed.merge(now=T0 + 2)
+        # retired w1's 7 counted events don't un-happen: 12 + 7
+        assert fed.value_at("test_fed_requests_total", T0 + 2) == 19.0
+        assert fed.fresh_workers(T0 + 2) == ["w0"]
+        assert fed.stale_workers(T0 + 2) == []
+
+    def test_histogram_merge_matches_single_process(self, tel):
+        """Bucket-wise merge by `le`: window deltas and quantiles over
+        two workers' split traffic equal the single-process histogram
+        over the union of that traffic."""
+        hist = tel.registry.histogram("test_fed_parity_seconds",
+                                      "merge-parity synthetic latency")
+        traffic_a = [0.001] * 50 + [0.02] * 10
+        traffic_b = [0.003] * 30 + [0.2] * 5
+
+        def run(traffic):
+            s = TimeSeriesSampler(interval=1.0)
+            s.tick(now=T0)
+            for v in traffic:
+                hist.observe(v)
+            s.tick(now=T0 + 1)
+            snap = s.snapshot()
+            tel.registry.reset()
+            return s, snap
+
+        _sa, snap_a = run(traffic_a)
+        _sb, snap_b = run(traffic_b)
+        s_full, _ = run(traffic_a + traffic_b)
+
+        fed = self._armed()
+        fed.ingest("w0", snap_a, now=T0 + 1)
+        fed.ingest("w1", snap_b, now=T0 + 1)
+        fed.merge(now=T0 + 1)
+
+        def deltas(sampler):
+            out = {}
+            for key in sampler.keys():
+                base, labels = _key_labels(key)
+                if (base != "test_fed_parity_seconds_bucket"
+                        or "worker" in labels):
+                    continue
+                d = sampler.window_delta(key, 60.0, T0 + 1)
+                if d:
+                    out[labels["le"]] = out.get(labels["le"], 0.0) + d
+            return out
+
+        want, got = deltas(s_full), deltas(fed)
+        assert want and got == want
+        for q in (0.5, 0.99):
+            assert (percentile_from_buckets(got, q)
+                    == percentile_from_buckets(want, q))
+        assert (fed.window_delta("test_fed_parity_seconds_count",
+                                 60.0, T0 + 1)
+                == s_full.window_delta("test_fed_parity_seconds_count",
+                                       60.0, T0 + 1)
+                == len(traffic_a) + len(traffic_b))
+
+    def test_gauge_policies_sum_max_last(self, tel):
+        fed = self._armed(gauge_policies={"test_fed_peak": "max",
+                                          "test_fed_owner": "last"})
+        fed.ingest("w0", _snap({"test_fed_depth": 3, "test_fed_peak": 5,
+                                "test_fed_owner": 1}), now=T0 + 1)
+        fed.ingest("w1", _snap({"test_fed_depth": 4, "test_fed_peak": 2,
+                                "test_fed_owner": 9}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        assert fed.value_at("test_fed_depth", T0 + 1) == 7.0   # default sum
+        assert fed.value_at("test_fed_peak", T0 + 1) == 5.0
+        assert fed.value_at("test_fed_owner", T0 + 1) == 9.0
+
+    def test_stale_worker_frozen_in_sums_dropped_from_gauges(self, tel):
+        fed = FederatedSampler(interval=1.0, staleness=5.0)
+        fed.merge(now=T0)
+        for w, c, g in (("w0", 5, 2), ("w1", 3, 4)):
+            fed.ingest(w, _snap({"test_fed_requests_total": c,
+                                 "test_fed_depth": g}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        assert fed.value_at("test_fed_depth", T0 + 1) == 6.0
+        # only w0 keeps answering; w1 crosses the staleness window
+        fed.ingest("w0", _snap({"test_fed_requests_total": 6,
+                                "test_fed_depth": 2}), now=T0 + 8)
+        fed.merge(now=T0 + 8)
+        assert fed.fresh_workers(T0 + 8) == ["w0"]
+        assert fed.stale_workers(T0 + 8) == ["w1"]
+        # cumulative: w1's counted events stay frozen in the sum
+        assert fed.value_at("test_fed_requests_total", T0 + 8) == 9.0
+        assert fed.value_at(
+            'test_fed_requests_total{worker="w1"}', T0 + 8) == 3.0
+        # gauge: a stale level is stale air — fresh workers only
+        assert fed.value_at("test_fed_depth", T0 + 8) == 2.0
+
+    def test_tick_is_disabled(self, tel):
+        with pytest.raises(NotImplementedError):
+            FederatedSampler().tick()
+
+    def test_prometheus_text_exposes_aggregates_and_children(self, tel):
+        fed = self._armed()
+        fed.ingest("w0", _snap({"test_fed_requests_total": 9}), now=T0 + 1)
+        fed.merge(now=T0 + 1)
+        text = fed.prometheus_text(now=T0 + 1)
+        assert "test_fed_requests_total 9" in text
+        assert 'test_fed_requests_total{worker="w0"} 9' in text
+
+
+# ----------------------------------------------------- chaos: scrape/merge
+
+class TestFederationChaos:
+    @pytest.mark.chaos
+    def test_scrape_fault_one_shot_absorbed_by_retry(self, tel):
+        """One injected `federation.scrape` fault costs one in-line retry,
+        not the round: the worker stays fresh and the scrape counts ok."""
+        ws = WorkerServer(timeseries=0.05)
+        try:
+            scraper = FleetScraper(
+                targets=[("w0", _worker_ts_url(ws))], interval=0.5,
+                sampler=FederatedSampler(interval=0.5))
+            faults.configure("federation.scrape:error:1.0:0:1")
+            assert scraper.scrape_once(now=T0) == {"w0": True}
+            assert _counter_total("mmlspark_faults_injected_total") == 1
+            assert _scrapes("ok") == 1 and _scrapes("error") == 0
+            assert scraper.sampler.fresh_workers(T0) == ["w0"]
+        finally:
+            ws.close()
+            telemetry.timeseries.stop()
+            telemetry.timeseries.clear()
+
+    @pytest.mark.chaos
+    def test_persistent_scrape_fault_opens_breaker_then_recovers(self, tel):
+        """A worker whose scrape keeps failing trips its breaker and goes
+        stale — frozen in the sums, excluded from fresh — and the
+        half-open probe brings it all the way back."""
+        ws = WorkerServer(timeseries=0.05)
+        try:
+            fed = FederatedSampler(interval=0.2, staleness=0.5)
+            scraper = FleetScraper(targets=[("w0", _worker_ts_url(ws))],
+                                   interval=0.2, sampler=fed)
+            t0 = time.time()
+            time.sleep(0.15)          # let the worker sampler tick once
+            assert scraper.scrape_once(now=t0)["w0"] is True
+            ticks = fed.value_at("mmlspark_timeseries_ticks_total", t0)
+            assert ticks is not None
+            faults.configure("federation.scrape:error:1.0")
+            for i in range(1, 4):     # failure_threshold=3 rounds
+                assert scraper.scrape_once(now=t0 + i)["w0"] is False
+            assert scraper.breaker.snapshot()["w0"] == "open"
+            assert scraper.scrape_once(now=t0 + 4)["w0"] is False
+            assert _scrapes("error") == 3 and _scrapes("skipped") >= 1
+            assert fed.stale_workers(t0 + 4) == ["w0"]
+            assert fed.fresh_workers(t0 + 4) == []
+            # frozen, not dropped: the merged counter still answers
+            assert fed.value_at("mmlspark_timeseries_ticks_total",
+                                t0 + 4) >= ticks
+            assert "w0" in scraper._errors
+            faults.clear()
+            time.sleep(1.05)          # past reset_timeout: half-open probe
+            assert scraper.scrape_once(now=t0 + 5)["w0"] is True
+            assert scraper.breaker.snapshot()["w0"] == "closed"
+            assert fed.fresh_workers(t0 + 5) == ["w0"]
+            h = scraper.healthz()
+            assert h["rounds"] == 6 and h["scrape_errors"] == {}
+        finally:
+            ws.close()
+            telemetry.timeseries.stop()
+            telemetry.timeseries.clear()
+
+    @pytest.mark.chaos
+    def test_merge_fault_one_shot_skips_round_then_recovers(self, tel):
+        fed = FederatedSampler(interval=1.0)
+        fed.ingest("w0", _snap({"test_fed_requests_total": 5}), now=T0)
+        faults.configure("federation.merge:error:1.0:0:1")
+        assert fed.merge(now=T0) == 0
+        assert _counter_total("mmlspark_federation_merge_errors") == 1
+        assert fed.value_at("test_fed_requests_total", T0) is None
+        # nothing was lost: the next round merges the held values
+        assert fed.merge(now=T0 + 1) > 0
+        assert fed.value_at("test_fed_requests_total", T0 + 1) == 5.0
+
+    @pytest.mark.chaos
+    def test_dead_target_degrades_slo_to_survivors(self, tel):
+        """A never-answering target stays out of the fleet view entirely;
+        the SLO engine keeps evaluating over the survivors without
+        erroring."""
+        ws = WorkerServer(timeseries=0.05)
+        try:
+            fed = FederatedSampler(interval=1.0, staleness=10.0)
+            slo = SLOEngine([{"name": "tick-goodput", "kind": "goodput",
+                              "series": "mmlspark_timeseries_ticks_total",
+                              "min": 0.1, "windows": (2.0, 4.0)}],
+                            sampler=fed)
+            scraper = FleetScraper(
+                targets=[("live", _worker_ts_url(ws)),
+                         ("dead", "http://127.0.0.1:9/timeseries")],
+                interval=1.0, sampler=fed, slo=slo)
+            t0 = time.time()
+            for i in range(5):
+                time.sleep(0.12)
+                scraper.scrape_once(now=t0 + i)
+            assert fed.fresh_workers(t0 + 4) == ["live"]
+            assert "dead" in scraper._errors
+            assert scraper.breaker.snapshot()["dead"] == "open"
+            res = slo.evaluate(now=t0 + 4)["tick-goodput"]
+            assert res["state"] == "ok" and res["burn_fast"] < 1.0
+            h = scraper.healthz()
+            assert h["fresh_workers"] == ["live"]
+            assert h["breakers"]["dead"] == "open"
+        finally:
+            ws.close()
+            telemetry.timeseries.stop()
+            telemetry.timeseries.clear()
+
+
+# ----------------------------------------------- per-worker skew detection
+
+class TestSkewAttribution:
+    def _bucket_snap(self, le_counts, t):
+        series = {}
+        for le, n in le_counts.items():
+            key = f'mmlspark_http_request_seconds_bucket{{le="{le}"}}'
+            series[key] = [[t, float(n)]]
+        return {"schema": "mmlspark-timeseries/v1", "interval": 1.0,
+                "capacity": 600, "series": series}
+
+    def test_slow_worker_flagged_and_cleared(self, tel):
+        fed = FederatedSampler(interval=1.0, staleness=60.0)
+        scraper = FleetScraper(targets=[], interval=1.0, sampler=fed)
+        for r in range(1, 9):
+            t = T0 + r
+            for w in ("w0", "w1", "w2"):
+                fed.ingest(w, self._bucket_snap(
+                    {"0.01": 100 * r, "+Inf": 100 * r}, t), now=t)
+            fed.ingest("w3", self._bucket_snap(
+                {"0.5": 100 * r, "+Inf": 100 * r}, t), now=t)
+            scraper.scrape_once(now=t)
+        assert scraper.skew.stragglers() == {"w3"}
+        assert scraper._skewed == {"w3"}
+        assert _counter_total("mmlspark_federation_skew_flagged") == 1
+        names = [e.get("name") for e in telemetry.trace.events()]
+        assert "serving/skew" in names
+        assert scraper.healthz()["skew"]["stragglers"] == ["w3"]
+        # the flag is advisory and self-clearing: once w3 serves at fleet
+        # speed its slow-bucket delta ages out of the attribution window,
+        # the rolling median converges, and the verdict drops
+        for r in range(9, 70):
+            t = T0 + r
+            for w in ("w0", "w1", "w2"):
+                fed.ingest(w, self._bucket_snap(
+                    {"0.01": 100 * r, "+Inf": 100 * r}, t), now=t)
+            # cumulative by le: w3's new fast traffic lands in BOTH the
+            # 0.01 and (by inclusion) the 0.5 bucket; its slow plateau
+            # stays at 800
+            fed.ingest("w3", self._bucket_snap(
+                {"0.01": 100 * (r - 8), "0.5": 100 * (r - 8) + 800,
+                 "+Inf": 100 * r}, t), now=t)
+            scraper.scrape_once(now=t)
+            if not scraper._skewed:
+                break
+        assert scraper._skewed == set()
+        cleared = [e for e in telemetry.trace.events()
+                   if e.get("name") == "serving/skew"
+                   and e.get("args", {}).get("cleared")]
+        assert cleared
+
+
+# --------------------------------------------- driver endpoints + shed push
+
+class TestDriverSurface:
+    def test_fleet_endpoints_404_until_wired_then_serve(self, tel):
+        src = HTTPSource(name="fed-endpoints")
+        try:
+            for path in ("fleet/metrics", "timeseries?scope=fleet"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(f"{src.url}{path}", timeout=5)
+                assert ei.value.code == 404
+            fed = FederatedSampler(interval=1.0)
+            fed.merge(now=T0)
+            fed.ingest("w0", _snap({"test_fed_requests_total": 9}),
+                       now=T0 + 1)
+            fed.ingest("w1", _snap({"test_fed_requests_total": 7}),
+                       now=T0 + 1)
+            fed.merge(now=T0 + 1)
+            src.fleet_metrics = fed.prometheus_text
+            src.fleet_timeseries = fed.snapshot
+            with urllib.request.urlopen(f"{src.url}fleet/metrics",
+                                        timeout=5) as r:
+                text = r.read().decode()
+            assert "test_fed_requests_total 16" in text
+            assert 'test_fed_requests_total{worker="w0"} 9' in text
+            _code, doc = _get_json(f"{src.url}timeseries?scope=fleet")
+            assert doc["schema"] == "mmlspark-timeseries/v1"
+            assert doc["series"]["test_fed_requests_total"][-1][1] == 16.0
+            # the unscoped endpoint still answers with LOCAL rings
+            _code, local = _get_json(f"{src.url}timeseries")
+            assert "test_fed_requests_total" not in local.get("series", {})
+        finally:
+            src.close()
+
+    def test_pushed_shed_verdict_drives_worker_door(self, tel):
+        ws = WorkerServer()
+        try:
+            shed_url = f"http://127.0.0.1:{ws.control_port}/shed"
+            code, body = _post_json(shed_url,
+                                    {"shed": True, "retry_after": 7})
+            assert code == 200
+            assert body == {"shed": True, "retry_after": 7}
+            # the public door now sheds with the driver-derived hint
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"http://127.0.0.1:{ws.source.port}/", data=b"row"),
+                    timeout=5)
+            assert ei.value.code == 503
+            assert ei.value.headers["Retry-After"] == "7"
+            _code, h = _get_json(
+                f"http://127.0.0.1:{ws.control_port}/healthz")
+            assert h["fleet_shed_retry_after"] == 7
+            _code, body = _post_json(shed_url, {"shed": False})
+            assert body == {"shed": False, "retry_after": None}
+            _code, h = _get_json(
+                f"http://127.0.0.1:{ws.control_port}/healthz")
+            assert h["fleet_shed_retry_after"] is None
+        finally:
+            ws.close()
+
+
+# ------------------------------------------------------- subprocess fleets
+
+class _SlowEcho:
+    """Echo with a per-batch stall: latency the WORKERS observe in their
+    request histograms while the driver process serves nothing."""
+
+    def __init__(self, delay=0.12):
+        self.delay = delay
+
+    def transform(self, df):
+        time.sleep(self.delay)
+        return df.withColumn("reply", object_column(
+            [json.dumps({"echo": v}) for v in df.col("value")]))
+
+
+@pytest.mark.extended
+def test_counter_reset_absorbed_across_worker_kill_and_restart(tel):
+    """kill -9 + warm restart on the same ports: the fresh incarnation's
+    counters restart at zero, the merged fleet series never steps down,
+    and the absorption is counted."""
+    w, w2 = None, None
+    try:
+        w = _Worker("127.0.0.1", 0, 0, spawn=True,
+                    extra_argv=("--timeseries", "0.05"))
+        fed = FederatedSampler(interval=0.2, staleness=30.0)
+        scraper = FleetScraper(
+            targets=[("w0", f"http://127.0.0.1:{w.control}/timeseries")],
+            interval=0.2, sampler=fed)
+        deadline = time.monotonic() + 20
+        v1 = 0.0
+        while time.monotonic() < deadline:
+            scraper.scrape_once()
+            v1 = fed.value_at("mmlspark_timeseries_ticks_total",
+                              time.time()) or 0.0
+            if v1 >= 30:
+                break
+            time.sleep(0.1)
+        assert v1 >= 30, "first incarnation never accumulated ticks"
+        w.kill()
+        w2 = _Worker("127.0.0.1", w.port, w.control, spawn=True,
+                     extra_argv=("--timeseries", "0.05"))
+        resets0 = _counter_total("mmlspark_federation_counter_resets")
+        deadline = time.monotonic() + 20
+        low_water = v1
+        seen_reset = False
+        while time.monotonic() < deadline:
+            scraper.scrape_once()
+            v = fed.value_at("mmlspark_timeseries_ticks_total", time.time())
+            if v is not None:
+                assert v >= low_water - 1e-9, \
+                    "merged cumulative series stepped down across restart"
+                low_water = max(low_water, v)
+            if _counter_total(
+                    "mmlspark_federation_counter_resets") > resets0:
+                seen_reset = True
+                break
+            time.sleep(0.1)
+        assert seen_reset, "restart reset was never absorbed"
+    finally:
+        for ww in (w, w2):
+            if ww is not None:
+                try:
+                    ww.kill()
+                except Exception:
+                    pass
+
+
+@pytest.mark.extended
+def test_worker_only_latency_burns_driver_slo_grows_and_sheds(tel):
+    """The tentpole e2e: request latency observed ONLY inside worker
+    processes reaches the driver's unchanged SLO engine through the
+    federated sampler, sustains a breach, grows the autoscaler's desired
+    replicas, and pushes a burn-derived Retry-After to the worker
+    doors."""
+    src, loop, scraper = None, None, None
+    stop = threading.Event()
+    try:
+        src = ProcessHTTPSource(n_workers=2,
+                                extra_argv=("--timeseries", "0.1"))
+        loop = ReplayServingLoop(src, _SlowEcho(0.12)).start()
+        fed = FederatedSampler(interval=0.2, staleness=5.0)  # no local: the
+        # driver contributes nothing — any burn is worker-fed
+        slo = SLOEngine([{"name": "p99-latency", "kind": "latency",
+                          "hist": "mmlspark_http_request_seconds",
+                          "threshold_s": 0.05, "target": 0.99,
+                          "windows": (1.5, 3.0),
+                          "shed_on_breach": True}], sampler=fed)
+        scraper = FleetScraper(source=src, interval=0.2, sampler=fed,
+                               slo=slo, push_shed=True)
+        src.federation = scraper
+        rec = FleetReconciler(src, 2, min_workers=1, max_workers=3,
+                              supervise=False,
+                              extra_argv=("--timeseries", "0.1"))
+        asc = ServingAutoscaler(slo, rec, grow_window=0.4,
+                                shrink_window=120.0, cooldown=120.0,
+                                interval=0.2)
+        scraper.start()
+
+        def client(i):
+            n = 0
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        src.urls[i % len(src.urls)],
+                        data=f"r{i}-{n}".encode()), timeout=10)
+                except Exception:
+                    time.sleep(0.05)
+                n += 1
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and rec.desired < 3:
+            asc.tick()
+            time.sleep(0.1)
+        assert rec.desired == 3, (slo.healthz(), scraper.healthz())
+        assert _counter_total("mmlspark_autoscale_verdicts") >= 1
+        # the latency evidence lives only in the workers: the driver's
+        # own registry never observed a request
+        fam = telemetry.snapshot().get("mmlspark_http_request_seconds",
+                                       {"series": []})
+        assert all(s.get("count", 0) == 0 for s in fam["series"])
+        assert fed.window_delta("mmlspark_http_request_seconds_count",
+                                30.0) > 0
+        # the pushed verdict reaches the doors: burn-derived Retry-After
+        shed_seen = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and shed_seen is None:
+            asc.tick()
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    src.urls[0], data=b"probe"), timeout=10)
+            except urllib.error.HTTPError as e:
+                if e.code == 503 and e.headers.get("Retry-After"):
+                    shed_seen = int(e.headers["Retry-After"])
+            except Exception:
+                time.sleep(0.05)
+        assert shed_seen is not None and shed_seen >= 1
+    finally:
+        stop.set()
+        if scraper is not None:
+            scraper.stop()
+        if loop is not None:
+            loop.stop()
+        elif src is not None:
+            src.close()
