@@ -666,11 +666,11 @@ def pipeline_fused_scenario():
     telemetry.enable()      # the transfer-bytes counters are the point
     try:
         tb = capturelib._m_transfer
-        in0 = tb.labels(direction="in").value
-        out0 = tb.labels(direction="out").value
+        in0 = tb.labels(direction="in", phase="transform").value
+        out0 = tb.labels(direction="out", phase="transform").value
         fused_probs = np.stack(list(pm.transform(df).col("probability")))
-        in1 = tb.labels(direction="in").value
-        out1 = tb.labels(direction="out").value
+        in1 = tb.labels(direction="in", phase="transform").value
+        out1 = tb.labels(direction="out", phase="transform").value
     finally:
         if not was_enabled:
             telemetry.disable()
@@ -697,6 +697,129 @@ def pipeline_fused_scenario():
                "config": cfg}),
            _with_baseline({
                "metric": "pipeline_staged_seconds",
+               "value": round(staged_s, 4), "unit": "s",
+               "vs_baseline": None, "config": cfg})]
+    for r in out:
+        print(json.dumps(r))
+    return out
+
+
+def pipeline_fit_fused_scenario():
+    """Fit-side pipeline fusion (Pipeline.fusePipeline on the FIT path):
+    a featurize→TpuLearner pipeline fit as the staged chain (host
+    assembly, f32-widened epoch uploads) vs the fused program (raw
+    wire-dtype uploads, featurize folded into every train dispatch).
+    Parity is asserted on the fitted params, ONE compile per fused
+    program (flat across every epoch) and a kill-and-resume leg are
+    asserted, and fit-phase H2D bytes must be strictly below the staged
+    path before any number is published."""
+    import tempfile
+
+    import jax
+    from mmlspark_tpu import DataFrame, Pipeline, telemetry
+    from mmlspark_tpu.core import capture as capturelib
+    from mmlspark_tpu.models.trainer import TpuLearner
+    from mmlspark_tpu.stages.basic import FastVectorAssembler
+
+    if jax.default_backend() == "cpu":
+        n, d, epochs, bs = 100_000, 24, 3, 8192
+    else:
+        n, d, epochs, bs = 2_000_000, 64, 3, 16384
+    rng = np.random.default_rng(0)
+    cols = {f"f{i}": rng.integers(-30, 30, size=n).astype(np.int8)
+            for i in range(d)}
+    label = (np.sum([cols[f"f{i}"] for i in range(4)], axis=0) > 0)
+    df = DataFrame({**cols, "label": label.astype(np.int32)})
+    feats = [f"f{i}" for i in range(d)]
+
+    def pipe(fuse, ckpt=""):
+        lr = (TpuLearner()
+              .setModelConfig({"type": "mlp", "hidden": (32,),
+                               "num_classes": 2})
+              .setEpochs(epochs).setBatchSize(bs).setSeed(3)
+              .setLearningRate(0.05).setShuffle(True))
+        if ckpt:
+            lr.setCheckpointDir(ckpt)
+        asm = (FastVectorAssembler().setInputCols(feats)
+               .setOutputCol("features"))
+        return Pipeline().setStages((asm, lr)).setFusePipeline(fuse), lr
+
+    def leaves_digest(model):
+        import hashlib
+        h = hashlib.sha256()
+        for leaf in jax.tree_util.tree_leaves(
+                model.getOrDefault("modelParams")):
+            h.update(np.asarray(leaf).tobytes())
+        return h.hexdigest()
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()          # the fit-phase H2D counters are the point
+    try:
+        tb = capturelib._m_transfer
+        trainer_tb = None
+        from mmlspark_tpu.models import trainer as trainerlib
+        trainer_tb = trainerlib._m_transfer_bytes
+
+        p0, _ = pipe(False)
+        b0 = trainer_tb.value
+        t0 = time.perf_counter()
+        pm_staged = p0.fit(df)
+        staged_s = time.perf_counter() - t0
+        staged_h2d = trainer_tb.value - b0
+
+        p1, lr1 = pipe(True)
+        b1 = trainer_tb.value
+        fin0 = tb.labels(direction="in", phase="fit").value
+        t0 = time.perf_counter()
+        pm_fused = p1.fit(df)
+        fused_s = time.perf_counter() - t0
+        fused_h2d = trainer_tb.value - b1
+        fit_in = tb.labels(direction="in", phase="fit").value - fin0
+
+        # never publish numbers for a fused fit that lost parity: same
+        # data, same seed -> identical fitted params (f32 exact for the
+        # small-int wire values)
+        d_staged = leaves_digest(pm_staged.getOrDefault("stages")[-1])
+        d_fused = leaves_digest(pm_fused.getOrDefault("stages")[-1])
+        assert d_staged == d_fused, "fused fit parity broke"
+        # ONE compile per fused program, flat across every epoch
+        progs = list(lr1._fused_programs.values())
+        assert progs, "fused fit never engaged"
+        for pf in progs:
+            assert pf.compiles == 1, (pf.name, pf.compiles, pf.causes)
+        # raw wire rows must beat the staged f32-widened uploads
+        assert fused_h2d < staged_h2d, (fused_h2d, staged_h2d)
+
+        # kill-and-resume: an interrupted fused fit picked up by a fresh
+        # learner stays on the fused path with its ONE compile
+        with tempfile.TemporaryDirectory() as ck:
+            pk, _ = pipe(True, ckpt=ck)
+            pk.getOrDefault("stages")[-1].setEpochs(max(1, epochs - 1))
+            pk.fit(df)                       # "killed" after epochs-1
+            pr, lrr = pipe(True, ckpt=ck)
+            pm_res = pr.fit(df)              # resumes the final epoch
+            for pf in lrr._fused_programs.values():
+                assert pf.compiles == 1, (pf.name, pf.compiles, pf.causes)
+            assert leaves_digest(pm_res.getOrDefault("stages")[-1]) \
+                == d_fused, "resume broke bit-exactness"
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+    cfg = (f"{n} rows x {d} int8 cols, assemble->mlp(32), "
+           f"{epochs} epochs, batch {bs}")
+    out = [_with_baseline({
+               "metric": "pipeline_fit_fused_seconds",
+               "value": round(fused_s, 4), "unit": "s",
+               "vs_baseline": None,
+               "speedup_vs_staged": round(staged_s / fused_s, 2),
+               "fit_h2d_bytes_fused": int(fused_h2d),
+               "fit_h2d_bytes_staged": int(staged_h2d),
+               "fit_phase_transfer_in_bytes": int(fit_in),
+               "segment_compiles": 1,
+               "config": cfg}),
+           _with_baseline({
+               "metric": "pipeline_fit_staged_seconds",
                "value": round(staged_s, 4), "unit": "s",
                "vs_baseline": None, "config": cfg})]
     for r in out:
@@ -764,6 +887,7 @@ def suite(profile: bool = False):
                  ("gbdt", gbdt_scenario),
                  ("gbdt_predict_quant", gbdt_predict_quant_scenario),
                  ("pipeline_fused", pipeline_fused_scenario),
+                 ("pipeline_fit_fused", pipeline_fit_fused_scenario),
                  ("serving", serving_scenario),
                  ("loader", loader_scenario))
     scen_out: dict = {}

@@ -30,11 +30,16 @@
   either expose a ``capture()`` (the cross-stage fusion entry point,
   core/capture.py) or carry the explicit ``_uncapturable = True``
   marker, so the fused pipeline path can distinguish "host-only by
-  design" from "capture forgotten". Dispatch is an interprocedural
-  fixed point over jit-bound names (``x = jax.jit(...)``, jit-decorated
-  defs, ``profiler.wrap``), excluding delegation through the stage
-  algebra's own ``transform``/``fit`` edges (composition stages like
-  Timer defer the obligation to their inner stages).
+  design" from "capture forgotten". Since the fit side fuses too
+  (``Pipeline.fusePipeline``), the same obligation covers estimator FIT
+  bodies: a concrete ``Estimator`` whose ``fit`` dispatches a jitted
+  computation must expose ``_fit_captured(df, plan)`` (the fused-fit
+  hook) or carry ``_uncapturable = True``. Dispatch is an
+  interprocedural fixed point over jit-bound names
+  (``x = jax.jit(...)``, jit-decorated defs, ``profiler.wrap``),
+  excluding delegation through the stage algebra's own
+  ``transform``/``fit`` edges (composition stages like Timer defer the
+  obligation to their inner stages).
 
 Chaos-coverage rules (a fault-injection framework only pays for itself
 when every recovery path it guards is actually rehearsed):
@@ -628,9 +633,10 @@ _CC_JIT_WRAPPERS = {
 #: own capture obligation
 _CC_NO_PROPAGATE = {"transform", "fit", "__call__", "capture"}
 _CC_STAGE_BASES = {"Transformer", "Model", "UnaryTransformer"}
+_CC_ESTIMATOR_BASES = {"Estimator"}
 #: the core contract classes whose default capture()/_uncapturable must
 #: NOT satisfy the rule for subclasses
-_CC_CORE_BASES = _CC_STAGE_BASES | {"PipelineStage"}
+_CC_CORE_BASES = _CC_STAGE_BASES | _CC_ESTIMATOR_BASES | {"PipelineStage"}
 
 
 class _CCFunc:
@@ -733,8 +739,9 @@ def _cc_scan_file(sf: SourceFile):
 
 
 @rule("pipeline-capture-coverage", "consistency",
-      "every Transformer whose transform dispatches a jitted computation "
-      "must expose a capture() or carry an explicit _uncapturable marker",
+      "every Transformer whose transform (and Estimator whose fit) "
+      "dispatches a jitted computation must expose a capture() (resp. "
+      "_fit_captured()) or carry an explicit _uncapturable marker",
       scope="project")
 def check_pipeline_capture_coverage(project: Project) -> Iterable[Finding]:
     all_funcs: list[_CCFunc] = []
@@ -769,7 +776,7 @@ def check_pipeline_capture_coverage(project: Project) -> Iterable[Finding]:
                     changed = True
                     break
 
-    def is_stage_class(name: str, seen: set) -> bool:
+    def reaches_base(name: str, bases: set, seen: set) -> bool:
         if name in seen:
             return False
         seen.add(name)
@@ -777,9 +784,12 @@ def check_pipeline_capture_coverage(project: Project) -> Iterable[Finding]:
         if info is None:
             return False
         for b in info["bases"]:
-            if b in _CC_STAGE_BASES or is_stage_class(b, seen):
+            if b in bases or reaches_base(b, bases, seen):
                 return True
         return False
+
+    def is_stage_class(name: str, seen: set) -> bool:
+        return reaches_base(name, _CC_STAGE_BASES, seen)
 
     def chain(name: str):
         """The class + its project-defined ancestors, nearest first,
@@ -821,6 +831,41 @@ def check_pipeline_capture_coverage(project: Project) -> Iterable[Finding]:
             f"from \"capture forgotten\"",
             hint="implement capture(columns) returning a StageCapture "
                  "(preferred for device stages), or declare "
+                 "`_uncapturable = True` with a one-line justification",
+            context=name)
+        if f:
+            yield f
+
+    # fit-side twin: a trainer whose fit dispatches jitted computation
+    # must either accept a fused featurize plan (_fit_captured — the
+    # Pipeline.fusePipeline fit hook) or declare itself out of the fused
+    # fit path explicitly
+    for name, info in sorted(all_classes.items()):
+        if info["abstract"] \
+                or not reaches_base(name, _CC_ESTIMATOR_BASES, set()) \
+                or is_stage_class(name, set()):
+            continue
+        lineage = chain(name)
+        fit_def = next((c["methods"]["fit"] for c in lineage
+                        if "fit" in c["methods"]), None)
+        if fit_def is None:
+            continue
+        ff = next((f for f in all_funcs if f.node is fit_def), None)
+        if ff is None or id(ff) not in dispatching:
+            continue
+        covered = any("_fit_captured" in c["methods"] or c["uncapturable"]
+                      for c in lineage)
+        if covered:
+            continue
+        f = info["sf"].finding(
+            "pipeline-capture-coverage", info["node"],
+            f"Estimator `{name}` dispatches a jitted computation in its "
+            f"fit but neither exposes a _fit_captured() fused-fit hook "
+            f"nor carries the explicit `_uncapturable = True` marker — "
+            f"the fit-side fusion path (Pipeline.fusePipeline) cannot "
+            f"tell \"staged fit by design\" from \"hook forgotten\"",
+            hint="implement _fit_captured(df, plan) accepting a "
+                 "FitCapturePlan (preferred for trainers), or declare "
                  "`_uncapturable = True` with a one-line justification",
             context=name)
         if f:

@@ -110,6 +110,30 @@ def _check_not_poisoned(tree, label: str) -> None:
                 f"consumed, rebind from the call's outputs")
 
 
+def _dealias_outputs(out, hazards):
+    """Copy any output leaf whose device buffer IS one of the host
+    buffers about to be poisoned. The CPU backend may zero-copy a
+    (suitably aligned) numpy input and then donate that very memory as
+    the output buffer — poisoning it would corrupt a live result the
+    caller legitimately owns."""
+    import jax
+    np = _np()
+    spans = [(a.__array_interface__["data"][0],
+              a.__array_interface__["data"][0] + a.nbytes)
+             for a in hazards]
+
+    def dealias(leaf):
+        try:
+            p = leaf.unsafe_buffer_pointer()
+        except Exception:
+            return leaf          # sharded/host leaf: no single buffer
+        if any(lo <= p < hi for lo, hi in spans):
+            return jax.device_put(np.array(leaf, copy=True))
+        return leaf
+
+    return jax.tree_util.tree_map(dealias, out)
+
+
 def clear() -> None:
     """Forget poisoned-buffer identities (test isolation)."""
     _poisoned.clear()
@@ -131,6 +155,12 @@ def wrap_donated(fn, donate_argnums, label: str = "step"):
             if i < len(args):
                 hazards.extend(_host_buffers(args[i]))
         out = fn(*args, **kwargs)
+        if hazards:
+            # the dispatch is async: the program may still be READING the
+            # host-aliased buffers — they are only dead once it completes
+            import jax
+            out = jax.block_until_ready(out)
+            out = _dealias_outputs(out, hazards)
         for arr in hazards:
             _poison(arr)
         if hazards:

@@ -67,8 +67,27 @@ _m_fallbacks = telemetry.registry.counter(
 _m_transfer = telemetry.registry.counter(
     "mmlspark_pipeline_transfer_bytes_total",
     "host<->device bytes moved at fused-segment boundaries; within a "
-    "segment stage-to-stage traffic is zero by construction",
-    labels=("direction",))
+    "segment stage-to-stage traffic is zero by construction. phase="
+    "transform counts PipelineModel.transform segments, phase=fit the "
+    "fused featurize->train fit path (raw wire-dtype rows in, learner "
+    "state out)",
+    labels=("direction", "phase"))
+_m_fit_fused = telemetry.registry.counter(
+    "mmlspark_fit_fused_dispatches_total",
+    "fused featurize->train device dispatches on the fit side (one per "
+    "train step / scan window / binning slab whose featurization ran "
+    "inside the same XLA program as the consumer)")
+_m_fit_fallbacks = telemetry.registry.counter(
+    "mmlspark_fit_fusion_fallbacks_total",
+    "Pipeline.fit calls that requested fusePipeline but fell back to "
+    "the staged fit (uncapturable prefix stage, non-encodable raw "
+    "column, or a learner that declined the fused plan)")
+
+
+def count_fit_transfer(direction: str, nbytes) -> None:
+    """Account fit-side host<->device traffic under phase="fit" (the
+    trainer's raw-row uploads and the GBDT fused-binning slabs)."""
+    _m_transfer.labels(direction=direction, phase="fit").inc(float(nbytes))
 
 
 class StageCapture:
@@ -258,14 +277,14 @@ def _run_segment(owner, seg: _Segment, df, seg_index: int):
             return cur
         arrays.append(np.ascontiguousarray(a))
     pf, params_dev = _segment_program(owner, seg, seg_index)
-    _m_transfer.labels(direction="in").inc(
+    _m_transfer.labels(direction="in", phase="transform").inc(
         float(sum(a.nbytes for a in arrays)))
     with telemetry.trace.span("pipeline/segment", stages=len(seg.pairs),
                               rows=len(df)):
         outs = pf(params_dev, tuple(arrays))
     _m_fused_dispatches.inc()
     outs = [np.asarray(o) for o in outs]
-    _m_transfer.labels(direction="out").inc(
+    _m_transfer.labels(direction="out", phase="transform").inc(
         float(sum(o.nbytes for o in outs)))
     outmap = dict(zip(seg.out_names, outs))
     data, meta = {}, {}
@@ -401,3 +420,198 @@ def segment_body(seg: _Segment, out_name: str):
         return cols[out_name]
 
     return body, params
+
+
+# ------------------------------------------------------------- fit-side plan
+
+class FitCapturePlan:
+    """The featurize prefix of a ``Pipeline.fit``, composed into ONE traced
+    ``body(param_tuple, raw_arrays) -> (xb, yb)``.
+
+    Built by :func:`compose_fit_capture` when EVERY stage ahead of the
+    final estimator captures; the learner folds :meth:`body` into its
+    per-step program (train step, scan body, or GBDT binning slab), so
+    raw wire-dtype rows are the only fit-time H2D traffic and the
+    intermediate featurized columns never exist on host.
+
+    ``params`` are fit-constants (fill values, conversion targets —
+    computed once, before training): checkpoints store learner state
+    only and record :meth:`digest` in the manifest so a resume can
+    verify it re-enters the *same* fused program bit-exact.
+
+    ``fitted`` holds the prefix stages as they would appear in the
+    resulting ``PipelineModel`` (transformers as-is, estimators as their
+    fitted models) — also the staged-fallback executor
+    (:meth:`apply_staged`). ``metadata`` carries column metadata a stage
+    chose to surface without staging (``capture_metadata`` hook — the
+    assembled categorical slot ranges GBDT reads)."""
+
+    __slots__ = ("pairs", "fitted", "in_names", "features_col",
+                 "label_col", "params", "metadata", "_fns", "_params_dev")
+
+    def __init__(self, pairs, fitted, df_columns, features_col: str,
+                 label_col: str, metadata: Optional[dict] = None):
+        self.pairs = list(pairs)
+        self.fitted = list(fitted)
+        seg = _Segment(self.pairs, df_columns)
+        in_names = list(seg.in_names)
+        produced = set()
+        for _, cap in self.pairs:
+            produced.update(cap.outputs)
+        for need in (features_col, label_col):
+            # raw pass-through targets (an untouched label column) ride
+            # along as extra wire inputs
+            if need not in produced and need not in in_names:
+                in_names.append(need)
+        self.in_names = in_names
+        self.features_col = features_col
+        self.label_col = label_col
+        self.params = tuple(cap.params for _, cap in self.pairs)
+        self.metadata = dict(metadata or {})
+        self._fns = [(cap.fn, cap.inputs, cap.drops, cap.outputs)
+                     for _, cap in self.pairs]
+        self._params_dev = None
+
+    def body(self, param_tuple, arrays):
+        """Pure traceable featurize composition: raw column arrays (one
+        per :attr:`in_names` entry, batch-leading) -> ``(xb, yb)``.
+        Computes in device dtypes — ``host_cast`` is a readback concern
+        the fit side never pays."""
+        cols = dict(zip(self.in_names, arrays))
+        for (fn, inputs, drops, outputs), p in zip(self._fns, param_tuple):
+            vals = fn(p, tuple(cols[i] for i in inputs))
+            if not isinstance(vals, (tuple, list)):
+                vals = (vals,)
+            for d in drops:
+                cols.pop(d, None)
+            cols.update(zip(outputs, vals))
+        return cols[self.features_col], cols[self.label_col]
+
+    # ---- host-side helpers -------------------------------------------
+    def encode(self, df) -> Optional[list]:
+        """Raw wire arrays for :attr:`in_names` (contiguous, wire dtypes
+        — ints/bools ship un-widened); None when a column turns out not
+        to be device-encodable (caller falls back staged)."""
+        arrays = []
+        for n in self.in_names:
+            a = encode_column(df.col(n))
+            if a is None:
+                return None
+            arrays.append(np.ascontiguousarray(a))
+        return arrays
+
+    def device_params(self):
+        """The capture params, device-put once per plan (fit-constants —
+        re-shipping them per step would defeat the donated step)."""
+        if self._params_dev is None:
+            import jax
+            self._params_dev = jax.device_put(self.params)
+        return self._params_dev
+
+    def apply_staged(self, df):
+        """The staged equivalent (fallback path): run every fitted
+        prefix stage's own transform."""
+        cur = df
+        for stage in self.fitted:
+            _m_staged_stages.inc()
+            cur = stage.transform(cur)
+        return cur
+
+    def key(self) -> tuple:
+        """Trace-identity key for caching the fused program wrapper —
+        same convention as :func:`_segment_program` (stage uids + json
+        params pin the traced structure, ``_param_key`` pins the
+        constant leaves)."""
+        return (tuple(s.uid for s, _ in self.pairs),
+                tuple(repr(sorted(s._jsonParams().items()))
+                      for s, _ in self.pairs),
+                tuple(self.in_names), self.features_col, self.label_col,
+                _param_key(self.params))
+
+    def digest(self) -> str:
+        """Content hash over the plan's structure AND param bytes —
+        recorded in checkpoint manifests so resume verifies the fused
+        featurization is byte-identical to the one that produced the
+        checkpoint (fill values recomputed over different data would
+        silently change the model being trained)."""
+        import hashlib
+        import jax
+        h = hashlib.sha256()
+        for stage, _ in self.pairs:
+            h.update(type(stage).__name__.encode())
+            h.update(repr(sorted(stage._jsonParams().items())).encode())
+        h.update(("|".join(self.in_names) + "->" + self.features_col
+                  + "," + self.label_col).encode())
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            arr = np.asarray(leaf)
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()
+
+
+def compose_fit_capture(stages, df, features_col: Optional[str],
+                        label_col: Optional[str]):
+    """Compose the featurize prefix of a fit into a
+    :class:`FitCapturePlan`, or None when it must stay staged.
+
+    Walks ``stages`` (everything ahead of the final learner) like
+    :func:`run_fused_pipeline`, but the fused fit only engages when the
+    prefix is *fully* capturable — a staged stage in the middle would
+    re-materialize the frame and forfeit the raw-wire H2D win, so any
+    uncapturable stage (or a capture input that is not device-encodable
+    under the running schema) rejects the whole plan.
+
+    Estimator prefix stages (CleanMissingData) use fit-then-capture:
+    the staged frame is materialized lazily, only up to the stage being
+    fitted, to compute its fit-constants — a one-time host pass, after
+    which training runs fused. Transformer-only prefixes stage nothing.
+    """
+    from .pipeline import Estimator, Transformer
+    if not stages or features_col is None or label_col is None:
+        return None
+    schema = {n: encodable(df.col(n)) for n in df.columns}
+    pairs: list = []
+    fitted: list = []
+    metadata: dict = {}
+    staged = {"df": df, "applied": 0}
+
+    def staged_upto(k):
+        # lazy staged materialization for fit-then-capture estimators
+        while staged["applied"] < k:
+            staged["df"] = fitted[staged["applied"]].transform(staged["df"])
+            staged["applied"] += 1
+        return staged["df"]
+
+    for stage in stages:
+        if isinstance(stage, Estimator) and not isinstance(stage,
+                                                           Transformer):
+            model = stage.fit(staged_upto(len(fitted)))
+        else:
+            model = stage
+        cap = stage_capture(model, list(schema))
+        if cap is None or not all(schema.get(i, False)
+                                  for i in cap.inputs):
+            log.info("fit-side fusion declined: stage %s does not "
+                     "capture under the running schema",
+                     type(stage).__name__)
+            return None
+        meta_fn = getattr(model, "capture_metadata", None)
+        if meta_fn is not None and cap.outputs:
+            m = meta_fn(df)
+            if m:
+                metadata[cap.outputs[0]] = m
+        pairs.append((model, cap))
+        fitted.append(model)
+        for d in cap.drops:
+            schema.pop(d, None)
+        for o in cap.outputs:
+            schema[o] = True
+    if not schema.get(features_col, False) \
+            or not schema.get(label_col, False):
+        log.info("fit-side fusion declined: %r/%r not produced by the "
+                 "prefix and not device-encodable in the raw frame",
+                 features_col, label_col)
+        return None
+    return FitCapturePlan(pairs, fitted, list(df.columns), features_col,
+                          label_col, metadata=metadata)

@@ -127,11 +127,28 @@ class Pipeline(Estimator):
     (same contract as Spark ML Pipeline, which reference notebooks rely on)."""
 
     stages = ComplexParam("ordered list of PipelineStages", default=())
+    fusePipeline = BooleanParam(
+        "fuse the FIT side: compose the maximal prefix of capturable "
+        "featurize stages into ONE traced featurize body folded into the "
+        "final estimator's per-step training program (core/capture.py "
+        "fit-side capture) — raw wire-dtype rows are the only fit-time "
+        "host->device traffic and intermediate featurized columns never "
+        "touch host. Engages only when EVERY stage ahead of the final "
+        "estimator captures AND the estimator accepts a fused plan "
+        "(TpuLearner, LightGBM*); anything else falls back to the staged "
+        "fit (mmlspark_fit_fusion_fallbacks_total counts these). The "
+        "returned PipelineModel has fusePipeline set so transform fuses "
+        "too. Fused featurization computes in device dtypes "
+        "(docs/performance.md, Fit-side fusion)", default=False)
 
     def fit(self, df: DataFrame) -> "PipelineModel":
+        stages = list(self.getOrDefault("stages"))
+        if self.getOrDefault("fusePipeline") and len(stages) >= 2:
+            fused = self._fit_fused(df, stages)
+            if fused is not None:
+                return fused
         fitted = []
         cur = df
-        stages = list(self.getOrDefault("stages"))
         for i, stage in enumerate(stages):
             if isinstance(stage, Estimator):
                 model = stage.fit(cur)
@@ -145,6 +162,37 @@ class Pipeline(Estimator):
             else:
                 raise TypeError(f"stage {stage!r} is neither Estimator nor Transformer")
         return PipelineModel().setStages(tuple(fitted))
+
+    def _fit_fused(self, df: DataFrame, stages) -> Optional["PipelineModel"]:
+        """The fused featurize->train fit, or None to fall back staged.
+
+        The final stage must be an Estimator exposing ``_fit_captured``
+        (the fused-fit hook: takes the raw frame plus a
+        :class:`~.capture.FitCapturePlan`, may itself return None to
+        decline — e.g. a GBDT configured for a path the fused binner
+        does not cover). Every stage ahead of it must capture; a partial
+        prefix would still stage the remainder and forfeit the raw-wire
+        H2D win, so it is not worth the second code path."""
+        from .capture import _m_fit_fallbacks, compose_fit_capture
+        last = stages[-1]
+        hook = getattr(last, "_fit_captured", None)
+        if not isinstance(last, Estimator) or hook is None:
+            _m_fit_fallbacks.inc()
+            return None
+        get_f = getattr(last, "getFeaturesCol", None)
+        get_l = getattr(last, "getLabelCol", None)
+        plan = compose_fit_capture(
+            stages[:-1], df,
+            get_f() if get_f else None, get_l() if get_l else None)
+        if plan is None:
+            _m_fit_fallbacks.inc()
+            return None
+        model = hook(df, plan)
+        if model is None:
+            _m_fit_fallbacks.inc()
+            return None
+        pm = PipelineModel().setStages(tuple(plan.fitted + [model]))
+        return pm.setFusePipeline(True)
 
     def transform(self, df: DataFrame) -> DataFrame:
         """Only valid for all-transformer pipelines; refitting estimators on

@@ -171,6 +171,9 @@ class LogisticRegressionModel(_ProbClassifierModel):
 
 
 class LogisticRegression(Estimator, HasFeaturesCol, HasLabelCol):
+    #: whole-matrix full-batch solver — no per-step featurize seam for the
+    #: fused fit path to fold into; pipelines fit it staged
+    _uncapturable = True
     regParam = FloatParam("L2 regularization", default=0.0, min=0.0)
     maxIter = IntParam("optimizer iterations", default=200, min=1)
     stepSize = FloatParam("Adam learning rate", default=0.05, min=0.0)
@@ -227,6 +230,9 @@ class LinearRegressionModel(Model, HasFeaturesCol):
 
 
 class LinearRegression(Estimator, HasFeaturesCol, HasLabelCol):
+    #: whole-matrix full-batch solver — no per-step featurize seam for the
+    #: fused fit path to fold into; pipelines fit it staged
+    _uncapturable = True
     regParam = FloatParam("L2 regularization", default=0.0, min=0.0)
     maxIter = IntParam("optimizer iterations", default=300, min=1)
     stepSize = FloatParam("Adam learning rate", default=0.05, min=0.0)

@@ -827,7 +827,7 @@ def _loss(raw, y, objective: str, alpha):
 def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
              mesh=None, sample_weight: Optional[np.ndarray] = None,
              eval_set: Optional[tuple] = None,
-             elastic_ctx=None) -> TreeEnsemble:
+             elastic_ctx=None, binned: Optional[tuple] = None) -> TreeEnsemble:
     """Train a boosted ensemble. With a `mesh`, `params.tree_learner` picks
     the distributed mode: "data" shards rows and psums histograms over ICI
     (explicit shard_map — LightGBM's socket-allreduce ring), "feature"
@@ -839,14 +839,24 @@ def fit_gbdt(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     the per-step host-loss/grow check, and the completed boosting state
     (trees so far, raw scores, RNG streams, early-stopping bookkeeping)
     is snapshotted host-side as the per-iteration checkpoint candidate a
-    re-meshed attempt resumes from — see :func:`fit_gbdt_elastic`."""
-    with telemetry.trace.span("gbdt/fit", rows=int(x.shape[0]),
-                              features=int(x.shape[1]),
+    re-meshed attempt resumes from — see :func:`fit_gbdt_elastic`.
+
+    ``binned=(bins, edges)`` supplies an ALREADY-BINNED (n, d) uint8
+    matrix plus its quantile edges — the fit-side pipeline fusion path,
+    where a fused featurize->bin program produced the wire matrix from
+    raw columns on device and ``x`` never materialized (pass x=None).
+    Edge computation and binning are skipped; the early-stopping holdout
+    slices the binned matrix directly; a user ``eval_set`` (raw feature
+    rows, which would need the skipped binner) is rejected."""
+    n, d = (binned[0].shape if binned is not None else x.shape)
+    with telemetry.trace.span("gbdt/fit", rows=int(n),
+                              features=int(d),
                               objective=params.objective,
                               iterations=params.num_iterations):
         return _fit_gbdt_impl(x, y, params, mesh=mesh,
                               sample_weight=sample_weight,
-                              eval_set=eval_set, elastic_ctx=elastic_ctx)
+                              eval_set=eval_set, elastic_ctx=elastic_ctx,
+                              binned=binned)
 
 
 def fit_gbdt_elastic(x: np.ndarray, y: np.ndarray, params: GBDTParams,
@@ -898,13 +908,23 @@ def fit_gbdt_elastic(x: np.ndarray, y: np.ndarray, params: GBDTParams,
 def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                    mesh=None, sample_weight: Optional[np.ndarray] = None,
                    eval_set: Optional[tuple] = None,
-                   elastic_ctx=None) -> TreeEnsemble:
+                   elastic_ctx=None,
+                   binned: Optional[tuple] = None) -> TreeEnsemble:
     # persistent compile cache: a first single-process fit in a fresh
     # interpreter otherwise pays full XLA recompile of cacheable programs
     from ...parallel.distributed import configure_xla_cache
     configure_xla_cache()
     p = params
-    n, d = x.shape
+    if binned is not None:
+        if eval_set is not None:
+            raise ValueError(
+                "binned fits draw their early-stopping holdout from the "
+                "binned matrix itself; a raw-feature eval_set would need "
+                "the skipped binner — pass eval_set=None")
+        bins, edges = np.asarray(binned[0]), np.asarray(binned[1])
+        n, d = bins.shape
+    else:
+        n, d = x.shape
     if p.tree_learner not in ("serial", "data", "feature", "auto"):
         raise ValueError(f"unknown tree_learner {p.tree_learner!r}; expected "
                          "serial|data|feature|auto")
@@ -934,6 +954,10 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
             raise ValueError(f"categorical_feature index {j} out of range "
                              f"for {d} features")
         cat_arr[j] = True
+        if binned is not None:
+            # identity binning already clipped the codes; the raw column
+            # never materialized, so the top-code warning cannot run
+            continue
         with np.errstate(invalid="ignore"):
             top = float(np.nanmax(x[:, j])) if len(x) else 0.0
         if top >= p.max_bin:
@@ -967,6 +991,10 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
     real = slice(None) if sample_weight is None else sample_weight > 0
     from ...parallel import mesh as _meshlib
     nproc = _meshlib.effective_process_count()
+    if binned is not None and nproc > 1:
+        raise ValueError(
+            "binned fits are single-process (fit-side pipeline fusion); "
+            "multi-process fits pool bin edges from raw row shards")
     if nproc > 1:
         # MULTI-PROCESS fit: `x` is THIS process's row shard (the Spark-
         # partition analog; the reference's per-partition LightGBM workers,
@@ -997,13 +1025,17 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
         gy = np.concatenate([b for _, b in pooled])
         edges = compute_bin_edges(gx, p.max_bin)
         base_global = _init_score(gy, p)
+    elif binned is not None:
+        base_global = None       # bins + edges arrived precomputed
     else:
         edges = compute_bin_edges(x[real], p.max_bin)
         base_global = None
-    with telemetry.trace.span("gbdt/bin", rows=n, features=d), \
-            _m_bin_time.time():
-        bins = bin_data_auto(x, edges, cat_arr if cat_arr.any() else None,
-                             p.max_bin)
+    if binned is None:
+        with telemetry.trace.span("gbdt/bin", rows=n, features=d), \
+                _m_bin_time.time():
+            bins = bin_data_auto(x, edges,
+                                 cat_arr if cat_arr.any() else None,
+                                 p.max_bin)
     d_pad = d
     if tree_learner == "feature":
         # pad the feature axis to a device multiple; padded columns carry
@@ -1072,16 +1104,20 @@ def _fit_gbdt_impl(x: np.ndarray, y: np.ndarray, params: GBDTParams,
                       else np.flatnonzero(sample_weight > 0))
         idx = rng.permutation(candidates)
         n_val = max(1, len(candidates) // 5)
-        eval_set = (x[idx[:n_val]], y[idx[:n_val]])
+        # binned fits slice the wire matrix (row-wise binning is
+        # deterministic, so bins[idx] == bin(x[idx]) bit-for-bit)
+        eval_set = ((bins[idx[:n_val]] if binned is not None
+                     else x[idx[:n_val]]), y[idx[:n_val]])
         # held-out rows must not train: zero them in the weight mask
         holdout = np.ones(n, dtype=np.float32)
         holdout[idx[:n_val]] = 0.0
         sample_weight = (holdout if sample_weight is None
                          else sample_weight * holdout)
     if eval_set is not None:
-        bins_val = jnp.asarray(bin_data_auto(
-            np.asarray(eval_set[0], dtype=np.float32), edges,
-            cat_arr if cat_arr.any() else None, p.max_bin))
+        bins_val = (jnp.asarray(eval_set[0]) if binned is not None
+                    else jnp.asarray(bin_data_auto(
+                        np.asarray(eval_set[0], dtype=np.float32), edges,
+                        cat_arr if cat_arr.any() else None, p.max_bin)))
         # transposed once for the per-iteration eval predicts (the _t
         # scoring forms); re-transposing per class per iteration is waste
         bins_val_t = bins_val.T

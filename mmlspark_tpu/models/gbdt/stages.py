@@ -425,13 +425,23 @@ def _global_rows(n_local: int) -> int:
 
 
 def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
-                  categorical=()):
+                  categorical=(), binned=None):
+    """``binned=(bins, edges)`` is the fit-side pipeline-fusion form: the
+    uint8 wire matrix was produced ON DEVICE from raw columns
+    (_fused_bin_matrix) and ``x`` is None — the engine skips edge
+    computation and binning. Single-process only; the fused hook gates
+    multi-process and elastic fits back to the staged path."""
+    n_local = int(binned[0].shape[0]) if binned is not None else x.shape[0]
     p = params_holder._engine_params(objective, num_class, alpha, categorical,
-                                     n_rows=_global_rows(x.shape[0]))
-    mesh = params_holder._mesh(x.shape[0])
+                                     n_rows=_global_rows(n_local))
+    mesh = params_holder._mesh(n_local)
     nproc = meshlib.effective_process_count()
     ecfg = params_holder.getOrDefault("elasticConfig")
     if ecfg:
+        if binned is not None:
+            raise ValueError(
+                "binned (fused) fits do not support elasticConfig; the "
+                "fused hook should have declined this fit")
         if not ecfg.get("checkpointDir"):
             raise ValueError("elasticConfig needs 'checkpointDir' (hosts "
                              "the heartbeat files)")
@@ -469,19 +479,169 @@ def _fit_ensemble(params_holder, x, y, objective, num_class=1, alpha=0.9,
             if len(x) < target:
                 x = np.concatenate(
                     [x, np.zeros((target - len(x),) + x.shape[1:], x.dtype)])
+        elif binned is not None:
+            bp, n = meshlib.pad_batch_to_devices(binned[0], mesh)
+            binned = (bp, binned[1])
         else:
             x, n = meshlib.pad_batch_to_devices(x, mesh)
-        y = np.concatenate([y, np.zeros(len(x) - n, y.dtype)])
+        rows = len(binned[0]) if binned is not None else len(x)
+        y = np.concatenate([y, np.zeros(rows - n, y.dtype)])
         w = np.concatenate([np.ones(n, np.float32),
-                            np.zeros(len(x) - n, np.float32)])
+                            np.zeros(rows - n, np.float32)])
     else:
         w = None
     if mesh is None:
-        return engine.fit_gbdt(x, y, p, mesh=None, sample_weight=w)
+        return engine.fit_gbdt(x, y, p, mesh=None, sample_weight=w,
+                               binned=binned)
     # collective programs from concurrent threads (tuner pool) interleave
     # across devices and deadlock — one distributed fit at a time
     with meshlib.collective_fit_lock:
-        return engine.fit_gbdt(x, y, p, mesh=mesh, sample_weight=w)
+        return engine.fit_gbdt(x, y, p, mesh=mesh, sample_weight=w,
+                               binned=binned)
+
+
+def _fused_categorical_slots(plan, feat_col, explicit):
+    """Fit-side twin of :func:`_categorical_slots`: the assembled
+    slot-range metadata comes from the capture plan
+    (FastVectorAssembler.capture_metadata, computed from the RAW frame)
+    instead of a materialized features column. No sparse selection on
+    the fused path, so no index remapping."""
+    from ...core.schema import MML_TAG
+    idxs = [int(i) for i in explicit]
+    if not idxs:
+        meta = (plan.metadata or {}).get(feat_col) or {}
+        asm = meta.get(MML_TAG, {}).get("assembled")
+        if asm:
+            for slot in asm.get("slots", {}).values():
+                if slot.get("categorical") is not None \
+                        and slot.get("width") == 1:
+                    idxs.append(int(slot["start"]))
+    return tuple(sorted(set(idxs)))
+
+
+def _fused_bin_matrix(plan, raws, edges, cat_arr, max_bin):
+    """featurize->bin as ONE device program per slab: raw wire-dtype
+    columns go up, the uint8 bin matrix (and the f32 label column) come
+    back — the staged featurized f32 matrix never exists, on host or in
+    HBM. Slabs pad to pow2 buckets like bin_data_device, with the same
+    2-deep async-dispatch window. Returns (bins (n,d) uint8, y (n,)
+    f32)."""
+    import jax.numpy as jnp
+
+    from ...core import capture as capturelib
+    from ...telemetry import profiler
+    n = len(raws[0])
+    d = int(edges.shape[0])
+    edges_t = jnp.asarray(np.ascontiguousarray(edges.T))
+    cat = jnp.asarray(np.asarray(cat_arr, dtype=bool))
+    n_edges = int(edges.shape[1])
+    fp_dev = plan.device_params()
+
+    def body(fp, arrs):
+        xb, yb = plan.body(fp, arrs)
+        xb = xb.astype(jnp.float32)
+        xb = xb.reshape(xb.shape[0], -1)
+        bins = engine._bin_slab_device(xb, edges_t, cat,
+                                       max_bin=int(max_bin),
+                                       n_edges=n_edges)
+        return bins, yb.astype(jnp.float32)
+
+    prog = profiler.wrap(jax.jit(body), "gbdt.fused_bin", aot=True)
+    slab = engine._BIN_SLAB
+    out = np.empty((n, d), dtype=np.uint8)
+    yout = np.empty(n, dtype=np.float32)
+    pending: list = []
+    uploaded = 0
+
+    def drain(entry):
+        start, m, bd, yd = entry
+        out[start:start + m] = np.asarray(bd)[:m]
+        yout[start:start + m] = np.asarray(yd)[:m]
+
+    for start in range(0, n, slab):
+        sl = [np.ascontiguousarray(r[start:start + slab]) for r in raws]
+        m = len(sl[0])
+        target = min(1 << max(0, int(np.ceil(np.log2(max(m, 1))))), slab)
+        if m < target:
+            sl = [np.concatenate(
+                [c, np.zeros((target - m,) + c.shape[1:], c.dtype)])
+                for c in sl]
+        uploaded += sum(int(c.nbytes) for c in sl)
+        bd, yd = prog(fp_dev, tuple(jnp.asarray(c) for c in sl))
+        pending.append((start, m, bd, yd))
+        if len(pending) > 2:
+            drain(pending.pop(0))
+        capturelib._m_fit_fused.inc()
+    for entry in pending:
+        drain(entry)
+    capturelib.count_fit_transfer("in", uploaded)
+    return out, yout
+
+
+def _booster_fit_captured(stage, df, plan, finish):
+    """Shared LightGBM fused-fit hook (Pipeline.fit fusePipeline): the
+    composed featurize body feeds the device binner directly, so a
+    featurize->booster pipeline bins on device from raw columns with no
+    staged featurize materialization. Returns None (-> Pipeline falls
+    back to the staged fit) when the path doesn't cover this fit:
+    multi-process (bin edges pool from raw row shards), elastic (the
+    wrapper re-pads raw rows per attempt), sparse-wide features (EFB /
+    selection need the host matrix), or raw columns the plan cannot
+    encode."""
+    from ...core import capture as capturelib
+    if meshlib.effective_process_count() > 1:
+        return None
+    if stage.getOrDefault("elasticConfig"):
+        return None
+    raws = plan.encode(df)
+    if raws is None:
+        return None
+    import jax.numpy as jnp
+    n = len(raws[0])
+    try:
+        xb_s, _ = jax.eval_shape(
+            plan.body, plan.params,
+            tuple(jax.ShapeDtypeStruct((2,) + r.shape[1:], r.dtype)
+                  for r in raws))
+    except Exception:
+        return None
+    d = int(np.prod(xb_s.shape[1:])) if len(xb_s.shape) > 1 else 1
+    if d > stage.getMaxDenseFeatures():
+        return None
+    max_bin = int(stage.getOrDefault("maxBin"))
+    cats = _fused_categorical_slots(plan, stage.getFeaturesCol(),
+                                    stage.getCategoricalSlotIndexes())
+    cat_arr = np.zeros(d, dtype=bool)
+    for j in cats:
+        cat_arr[j] = True
+    # quantile edges from a <= 200k-row featurized sample READBACK — the
+    # SAME rows compute_bin_edges would sample from the staged matrix
+    # (same rng seed, same cap), so the edges match the staged fit
+    # bit-for-bit; nanquantile is order-invariant
+    cap = 200_000
+    fp_dev = plan.device_params()
+    if n > cap:
+        sidx = np.random.default_rng(0).choice(n, cap, replace=False)
+        s_raws = [r[sidx] for r in raws]
+    else:
+        s_raws = raws
+    xs_d, _ = jax.jit(plan.body)(
+        fp_dev, tuple(jnp.asarray(r) for r in s_raws))
+    xs = np.asarray(xs_d, dtype=np.float32).reshape(len(s_raws[0]), -1)
+    capturelib.count_fit_transfer("in",
+                                  sum(int(r.nbytes) for r in s_raws))
+    capturelib.count_fit_transfer("out", xs.nbytes)
+    edges = engine.compute_bin_edges(xs, max_bin)
+    with telemetry_span_fused_fit(plan, n):
+        bins, y = _fused_bin_matrix(plan, raws, edges, cat_arr, max_bin)
+        return finish(y, bins, edges, cats)
+
+
+def telemetry_span_fused_fit(plan, rows):
+    from ... import telemetry
+    return telemetry.trace.span("pipeline/fit_segment",
+                                stages=len(plan.pairs), rows=rows,
+                                path="gbdt")
 
 
 def _ensemble_to_state(ens) -> dict:
@@ -733,6 +893,33 @@ class LightGBMClassifier(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams)
                 .setFeatureBundles(bundles)
                 .setBoosterState(_ensemble_to_state(ens)))
 
+    def _fit_captured(self, df: DataFrame, plan):
+        """Fused-fit hook (Pipeline fusePipeline): featurize->bin on
+        device from raw columns, then grow trees from the binned matrix
+        — the staged featurized f32 matrix never materializes. Returns
+        None to fall back staged when the fused binner does not cover
+        this fit (see _booster_fit_captured)."""
+        def finish(y, bins, edges, cats):
+            classes = np.unique(y.astype(np.int64))
+            if not np.array_equal(classes, np.arange(len(classes))) or \
+                    not np.allclose(y, y.astype(np.int64)):
+                raise ValueError(
+                    f"labels must be consecutive integers 0..K-1, got "
+                    f"classes {classes.tolist()}; index them first "
+                    f"(e.g. ValueIndexer)")
+            num_class = len(classes)
+            objective = "binary" if num_class <= 2 else "multiclass"
+            ens = _fit_ensemble(
+                self, None, y, objective,
+                num_class=(num_class if objective == "multiclass" else 1),
+                categorical=cats, binned=(bins, edges))
+            return (LightGBMClassificationModel()
+                    .setFeaturesCol(self.getFeaturesCol())
+                    .setObjective(objective)
+                    .setBoosterState(_ensemble_to_state(ens)))
+        with _fleet_fit_guard():
+            return _booster_fit_captured(self, df, plan, finish)
+
 
 class LightGBMRegressionModel(Model, HasFeaturesCol):
     predictionCol = StringParam("prediction column", default="prediction")
@@ -819,3 +1006,16 @@ class LightGBMRegressor(Estimator, HasFeaturesCol, HasLabelCol, _BoosterParams):
                 .setFeatureSelection(sel)
                 .setFeatureBundles(bundles)
                 .setBoosterState(_ensemble_to_state(ens)))
+
+    def _fit_captured(self, df: DataFrame, plan):
+        """Regression twin of LightGBMClassifier._fit_captured."""
+        def finish(y, bins, edges, cats):
+            ens = _fit_ensemble(self, None, y, self.getApplication(),
+                                alpha=self.getAlpha(),
+                                categorical=cats, binned=(bins, edges))
+            return (LightGBMRegressionModel()
+                    .setFeaturesCol(self.getFeaturesCol())
+                    .setObjective(self.getApplication())
+                    .setBoosterState(_ensemble_to_state(ens)))
+        with _fleet_fit_guard():
+            return _booster_fit_captured(self, df, plan, finish)

@@ -351,8 +351,17 @@ def _make_pp_step_body(cfg: dict, mesh, tx, loss_fn, n_micro: int):
 
 def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
                      step_body=None, mixed: bool = False,
-                     grad_clip: float = 0.0):
+                     grad_clip: float = 0.0, featurize=None):
     """One jitted optimizer step (fitStream / multi-host feed path).
+
+    ``featurize`` (fit-side pipeline fusion, core/capture.py) is a pure
+    traced ``(fparams, raw_arrays) -> (xb, yb)`` body run INSIDE the
+    same program as the optimizer step: the step signature becomes
+    ``(params, opt_state, fparams, raws, wb)`` (mixed: scale_state after
+    opt_state), the raw column tuple is donated in place of (xb, yb),
+    and the featurized intermediates only ever exist as XLA temporaries
+    — they never touch host, and the H2D transfer is the raw wire-dtype
+    rows. ``fparams`` are fit-constants placed once, never donated.
 
     The batch buffers (xb, yb) are DONATED on accelerator backends: the
     feed path uploads a fresh batch every step and never reads it back, so
@@ -377,9 +386,35 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
     CPU, so nothing is lost."""
     from ..analysis import sanitize
     cpu = jax.default_backend() == "cpu"
-    # `mixed` is a host-side factory flag, static at build time (the
-    # profiler.wrap discovery over-approximates this FACTORY as a traced
-    # body — only the returned step functions are ever traced)
+    # `mixed`/`featurize` are host-side factory flags, static at build
+    # time (the profiler.wrap discovery over-approximates this FACTORY
+    # as a traced body — only the returned step functions are ever
+    # traced)
+    if featurize is not None:   # graftlint: disable=jit-traced-branch
+        if mixed:   # graftlint: disable=jit-traced-branch
+            inner = step_body or _make_mixed_step_body(
+                module, tx, loss_fn, is_moe, moe_aux, grad_clip)
+
+            def fused_mixed(params, opt_state, scale_state, fparams,
+                            raws, wb):
+                xb, yb = featurize(fparams, raws)
+                return inner(params, opt_state, scale_state, xb, yb, wb)
+
+            donate = (0, 1, 2) if cpu else (0, 1, 2, 4)
+            return sanitize.wrap_donated(
+                jax.jit(fused_mixed, donate_argnums=donate), donate,
+                label="trainer.step_fused_mixed")
+        inner = step_body or _make_step_body(module, tx, loss_fn, is_moe,
+                                             moe_aux, grad_clip)
+
+        def fused_step(params, opt_state, fparams, raws, wb):
+            xb, yb = featurize(fparams, raws)
+            return inner(params, opt_state, xb, yb, wb)
+
+        donate = () if cpu else (3,)
+        return sanitize.wrap_donated(
+            jax.jit(fused_step, donate_argnums=donate), donate,
+            label="trainer.step_fused")
     if mixed:   # graftlint: disable=jit-traced-branch
         body = step_body or _make_mixed_step_body(
             module, tx, loss_fn, is_moe, moe_aux, grad_clip)
@@ -397,7 +432,7 @@ def _make_train_step(module, tx, loss_fn, is_moe: bool, moe_aux: float,
 
 def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
                         mesh, bs: int, step_body=None, mixed: bool = False,
-                        grad_clip: float = 0.0):
+                        grad_clip: float = 0.0, featurize=None):
     """A whole epoch of optimizer steps per XLA dispatch over
     DEVICE-RESIDENT data.
 
@@ -431,7 +466,61 @@ def _make_scan_epoch_fn(module, tx, loss_fn, is_moe: bool, moe_aux: float,
             yb = jax.lax.with_sharding_constraint(yb, data_sh)
         return xb, yb, wb
 
-    # host-side factory flag, static at build time (see _make_train_step)
+    # host-side factory flags, static at build time (see _make_train_step)
+    if featurize is not None:   # graftlint: disable=jit-traced-branch
+        # fit-side pipeline fusion: the epoch data stays resident as RAW
+        # wire-dtype columns and every scan window featurizes inside the
+        # same dispatch as its optimizer step — the featurized epoch
+        # never exists anywhere, not even in HBM
+        def fused_window(fparams, raw_alls, w_all, o):
+            rs = tuple(jax.lax.dynamic_slice_in_dim(r, o, bs, 0)
+                       for r in raw_alls)
+            wb = jax.lax.dynamic_slice_in_dim(w_all, o, bs, 0)
+            xb, yb = featurize(fparams, rs)
+            if mesh.size > 1:
+                xb = jax.lax.with_sharding_constraint(xb, data_sh)
+                yb = jax.lax.with_sharding_constraint(yb, data_sh)
+            return xb, yb, wb
+
+        from ..analysis import sanitize
+        if mixed:   # graftlint: disable=jit-traced-branch
+            mixed_body = step_body or _make_mixed_step_body(
+                module, tx, loss_fn, is_moe, moe_aux, grad_clip)
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def run_epoch_fused_mixed(params, opt_state, scale_state,
+                                      fparams, raw_alls, w_all, starts):
+                def body(carry, o):
+                    p, opt, s = carry
+                    xb, yb, wb = fused_window(fparams, raw_alls, w_all, o)
+                    p, opt, s, loss = mixed_body(p, opt, s, xb, yb, wb)
+                    return (p, opt, s), loss
+                (params, opt_state, scale_state), losses = jax.lax.scan(
+                    body, (params, opt_state, scale_state), starts)
+                return params, opt_state, scale_state, losses[-1]
+
+            return sanitize.wrap_donated(
+                run_epoch_fused_mixed, (0, 1, 2),
+                label="trainer.scan_epoch_fused_mixed")
+
+        plain_body = step_body or _make_step_body(module, tx, loss_fn,
+                                                  is_moe, moe_aux,
+                                                  grad_clip)
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def run_epoch_fused(params, opt_state, fparams, raw_alls, w_all,
+                            starts):
+            def body(carry, o):
+                p, opt = carry
+                xb, yb, wb = fused_window(fparams, raw_alls, w_all, o)
+                p, opt, loss = plain_body(p, opt, xb, yb, wb)
+                return (p, opt), loss
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), starts)
+            return params, opt_state, losses[-1]
+
+        return sanitize.wrap_donated(run_epoch_fused, (0, 1),
+                                     label="trainer.scan_epoch_fused")
     if mixed:   # graftlint: disable=jit-traced-branch
         mixed_body = step_body or _make_mixed_step_body(
             module, tx, loss_fn, is_moe, moe_aux, grad_clip)
@@ -792,6 +881,12 @@ class TpuLearner(Estimator):
                          state_donated: Optional[bool] = None):
         from ..resilience import ckpt as ckptlib
         os.makedirs(self.getCheckpointDir(), exist_ok=True)
+        # fused fits store LEARNER state only — featurize params are fit
+        # constants, recorded by digest so resume rejects a checkpoint
+        # written under a different featurize plan
+        fplan = getattr(self, "_featurize_plan", None)
+        extra = ({"featurize_digest": fplan.digest()}
+                 if fplan is not None else None)
 
         # params are ALWAYS the f32 masters (bf16 compute casts per-layer
         # inside the step and never writes back), so every precision mode
@@ -869,13 +964,16 @@ class TpuLearner(Estimator):
                 else:
                     payload = (lambda: serialization.msgpack_serialize(
                         build_state()))
-                self._ckpt_writer().submit(path, payload,
-                                           on_commit=on_commit)
+                self._ckpt_writer().submit(
+                    path, payload, on_commit=on_commit,
+                    publish_fn=((lambda p, d: ckptlib.publish(
+                        p, d, extra=extra)) if extra else None))
                 if step is None:
                     self._ckpt_barrier()  # epoch boundaries stay ordered
             else:
                 ckptlib.publish(
-                    path, serialization.msgpack_serialize(build_state()))
+                    path, serialization.msgpack_serialize(build_state()),
+                    extra=extra)
                 on_commit()
             return
 
@@ -916,7 +1014,7 @@ class TpuLearner(Estimator):
                     # -> never a candidate) instead of stalling the fit
                     if ckptlib.await_shards(os.path.dirname(p),
                                             shard_names, timeout=30.0):
-                        ckptlib.commit_sharded(p, shard_names)
+                        ckptlib.commit_sharded(p, shard_names, extra=extra)
                     else:
                         committed["ok"] = False
                         log.warning("sharded checkpoint %s left "
@@ -930,7 +1028,8 @@ class TpuLearner(Estimator):
                     {keys[i]: flat[keys[i]] for i in idxs})
                     for idxs in parts]
 
-            publish_fn = ckptlib.publish_sharded
+            def publish_fn(p, payloads):
+                ckptlib.publish_sharded(p, payloads, extra=extra)
 
         def on_commit_sharded():
             # only a commit that actually landed (head + manifest) may
@@ -1029,8 +1128,26 @@ class TpuLearner(Estimator):
         # candidates (elastic re-entry resumes what the writer published)
         self._ckpt_barrier()
         d = self.getCheckpointDir()
+        # fused fits (fit-side pipeline fusion) record the featurize plan
+        # by digest: a candidate committed under a DIFFERENT plan trained
+        # on different features — resuming its learner state would be
+        # silent garbage, so it is skipped (absent digest = pre-fusion
+        # checkpoint or staged fit: allowed)
+        fplan = getattr(self, "_featurize_plan", None)
+        fdig = fplan.digest() if fplan is not None else None
+        manifest = (ckptlib.load_manifest(d) or {}) if d else {}
+
+        def _plan_ok(f):
+            rec = (manifest.get(f) or {}).get("featurize_digest")
+            if rec is None or fdig is None or rec == fdig:
+                return True
+            log.warning("checkpoint %s was written under a different "
+                        "featurize plan — skipping it as a resume "
+                        "candidate", f)
+            return False
+
         cands = [pos for pos, f in self._ckpt_candidates()
-                 if ckptlib.verify(d, f)] if d else []
+                 if ckptlib.verify(d, f) and _plan_ok(f)] if d else []
         placed = (params, opt_state)
         resume = restored = None
         for cand in cands:
@@ -1166,6 +1283,89 @@ class TpuLearner(Estimator):
             max_hosts=self.getElasticMaxHosts(),
             evict_after=self.getStragglerEvictAfter())
 
+    # ---- fit-side pipeline fusion (core/capture.py) ----
+    def _fit_captured(self, df: DataFrame, plan) -> Optional[TpuModel]:
+        """The fused-fit hook ``Pipeline.fit(fusePipeline=True)`` calls:
+        train with ``plan`` (a :class:`~..core.capture.FitCapturePlan`)
+        folded into the per-step program, or return None to decline (the
+        pipeline then falls back to the staged fit). Declines the model
+        families whose input is not a featurized vector batch (token
+        models) and the mesh axes the fused window does not thread
+        (seq/expert/pipe)."""
+        cfg = dict(self.getModelConfig() or {})
+        if (cfg.get("type") in TOKEN_MODELS
+                or self.getSequenceParallel() > 1
+                or self.getExpertParallel() > 1
+                or self.getPipelineParallel() > 1):
+            return None
+        self._featurize_plan = plan
+        try:
+            return self.fit(df)
+        finally:
+            self._featurize_plan = None
+
+    def fitStreamCaptured(self, batches_fn, plan) -> TpuModel:
+        """:meth:`fitStream` with a fit-side capture plan: every item
+        ``batches_fn()`` yields is a tuple of RAW column arrays aligned
+        with ``plan.in_names`` (wire dtypes; featurization runs inside
+        the jitted step). Single-process only — the fused stream does
+        not implement the multi-host signature lockstep."""
+        if meshlib.effective_process_count() > 1:
+            raise ValueError("fitStreamCaptured is single-process; "
+                             "multi-host streams run staged fitStream")
+        cfg = dict(self.getModelConfig() or {})
+        if cfg.get("type") in TOKEN_MODELS:
+            raise ValueError("fused stream fit needs a featurized-vector "
+                             "model family, not a token model")
+        self._featurize_plan = plan
+        try:
+            return self.fitStream(batches_fn)
+        finally:
+            self._featurize_plan = None
+
+    def _featurize_fn(self, plan, cfg: dict):
+        """The traced featurize adapter folded into the step program:
+        ``plan.body`` plus the staged path's input conventions
+        (f32 features, inputShape reshape to NHWC, loss-dtype labels) so
+        fused and staged fits see identical (xb, yb)."""
+        shape = tuple(self.getInputShape())
+        loss_name = self.getLoss()
+
+        def feat(fparams, raw_arrays):
+            xb, yb = plan.body(fparams, raw_arrays)
+            xb = xb.astype(jnp.float32)
+            if xb.ndim == 1:
+                xb = xb[:, None]
+            if shape:
+                c, h, w = shape
+                xb = xb.reshape(-1, c, h, w).transpose(0, 2, 3, 1)
+            yb = (yb.astype(jnp.int32) if loss_name == "cross_entropy"
+                  else yb.astype(jnp.float32))
+            return xb, yb
+
+        return feat
+
+    def _fused_program(self, kind: str, plan, factory, extra_key=()):
+        """Cache of fused step/scan programs, keyed on everything that
+        pins the traced structure (learner params + plan identity + the
+        caller's shape/mesh key) and kept ON THE LEARNER: a kill-and-
+        resume re-enters fit() on the same instance, and reusing the
+        same :class:`~..telemetry.profiler.ProfiledFunction` (aot mode)
+        is what makes "zero recompiles across a resume" an assertable
+        metric — a rebuilt jit callable would recompile even for an
+        identical trace."""
+        cache = getattr(self, "_fused_programs", None)
+        if cache is None:
+            cache = self._fused_programs = {}
+        key = (kind, plan.key(),
+               repr(sorted(self._jsonParams().items())), tuple(extra_key))
+        pf = cache.get(key)
+        if pf is None:
+            pf = telemetry.profiler.wrap(factory(), f"trainer.{kind}",
+                                         aot=True)
+            cache[key] = pf
+        return pf
+
     def fit(self, df: DataFrame) -> TpuModel:
         with self._slo_session():
             if self.getElastic():
@@ -1189,12 +1389,34 @@ class TpuLearner(Estimator):
             elastic_ctx is not None
             and getattr(elastic_ctx._coord, "_multiproc", False))
         cfg = self._cfg_with_precision(dict(self.getModelConfig()))
-        x = _prep_input(df, self.getFeaturesCol(), tuple(self.getInputShape()))
-        if cfg.get("type") in TOKEN_MODELS:
-            x = x.astype(np.int32)
-        y = np.asarray(df.col(self.getLabelCol()))
-        y = (y.astype(np.int32) if self.getLoss() == "cross_entropy"
-             else y.astype(np.float32))
+        # fit-side pipeline fusion: when Pipeline.fit composed the
+        # featurize prefix into a capture plan (_fit_captured), training
+        # consumes RAW wire-dtype columns and featurization runs inside
+        # the per-step program — the staged (x, y) materialization below
+        # is skipped entirely
+        plan = getattr(self, "_featurize_plan", None)
+        raws = feat_fn = None
+        if plan is not None:
+            raws = plan.encode(df)
+            if raws is None:
+                from ..core import capture as capturelib
+                capturelib._m_fit_fallbacks.inc()
+                log.warning("fused fit fell back to staged featurization:"
+                            " a raw input column is not device-encodable")
+                df = plan.apply_staged(df)
+                plan = None
+            else:
+                feat_fn = self._featurize_fn(plan, cfg)
+        if plan is None:
+            x = _prep_input(df, self.getFeaturesCol(),
+                            tuple(self.getInputShape()))
+            if cfg.get("type") in TOKEN_MODELS:
+                x = x.astype(np.int32)
+            y = np.asarray(df.col(self.getLabelCol()))
+            y = (y.astype(np.int32) if self.getLoss() == "cross_entropy"
+                 else y.astype(np.float32))
+        else:
+            x = y = None
 
         tp = self.getTensorParallel()
         sp = self.getSequenceParallel()
@@ -1277,7 +1499,17 @@ class TpuLearner(Estimator):
         # init batch must satisfy the shard_map divisibility of the sp
         # attention (batch % data-axis == 0); data-axis size always works
         init_b = dict(mesh.shape).get("data", 1) if sp > 1 else 2
-        if attn_fn is not None and meshlib.effective_process_count() > 1:
+        if plan is not None:
+            # the featurized batch never exists on host: derive its
+            # abstract shape through the traced featurize body and init
+            # from zeros of that shape (flax initializers draw from rng
+            # + shape only, so the params match a staged init exactly)
+            xb_s, _ = jax.eval_shape(
+                feat_fn, plan.params,
+                tuple(jax.ShapeDtypeStruct((init_b,) + r.shape[1:],
+                                           r.dtype) for r in raws))
+            params = module.init(rng, jnp.zeros(xb_s.shape, xb_s.dtype))
+        elif attn_fn is not None and meshlib.effective_process_count() > 1:
             # the sp attention is a shard_map over a process-spanning mesh —
             # flax's EAGER init cannot execute that collectively. The
             # attention callable holds no params (projections are separate
@@ -1321,7 +1553,7 @@ class TpuLearner(Estimator):
         # SPMD demands identical shapes and step counts everywhere, so both
         # are derived from GLOBAL quantities: every process contributes
         # exactly bs rows per step (short shards wrap around their rows).
-        n = len(x)
+        n = len(x) if plan is None else len(raws[0])
         if nproc > 1:
             from jax.experimental import multihost_utils
             n_global = int(multihost_utils.process_allgather(
@@ -1343,21 +1575,42 @@ class TpuLearner(Estimator):
         # checkpoints and the per-dispatch host-loss check both need the
         # host in the loop between steps (the scan path's whole-epoch
         # dispatch would turn a mid-epoch host loss into a lost epoch)
-        if nproc == 1 and elastic_ctx is None \
-                and x.nbytes + y.nbytes <= data_cap:
-            scan_fn = telemetry.profiler.wrap(_make_scan_epoch_fn(
-                module, tx, loss_fn, is_moe, moe_aux, mesh,
-                _scan_batch(bs_global, mesh, pp), step_body=pp_body,
-                mixed=mixed, grad_clip=grad_clip),
-                "trainer.scan_epoch")
+        data_bytes = (x.nbytes + y.nbytes if plan is None
+                      else sum(r.nbytes for r in raws))
+        mesh_key = tuple(sorted(dict(mesh.shape).items()))
+        if nproc == 1 and elastic_ctx is None and data_bytes <= data_cap:
+            if plan is not None:
+                bs_pad = _scan_batch(bs_global, mesh, pp)
+                scan_fn = self._fused_program(
+                    "scan_epoch_fused", plan,
+                    lambda: _make_scan_epoch_fn(
+                        module, tx, loss_fn, is_moe, moe_aux, mesh,
+                        bs_pad, step_body=pp_body, mixed=mixed,
+                        grad_clip=grad_clip, featurize=feat_fn),
+                    extra_key=(mesh_key, bs_pad))
+            else:
+                scan_fn = telemetry.profiler.wrap(_make_scan_epoch_fn(
+                    module, tx, loss_fn, is_moe, moe_aux, mesh,
+                    _scan_batch(bs_global, mesh, pp), step_body=pp_body,
+                    mixed=mixed, grad_clip=grad_clip),
+                    "trainer.scan_epoch")
         else:
             # multi-host (per-process shards feed put_global_batch) or a
             # dataset too big for HBM residency: per-step host feed
-            train_step = telemetry.profiler.wrap(
-                _make_train_step(module, tx, loss_fn, is_moe,
-                                 moe_aux, step_body=pp_body, mixed=mixed,
-                                 grad_clip=grad_clip),
-                "trainer.step")
+            if plan is not None:
+                train_step = self._fused_program(
+                    "step_fused", plan,
+                    lambda: _make_train_step(
+                        module, tx, loss_fn, is_moe, moe_aux,
+                        step_body=pp_body, mixed=mixed,
+                        grad_clip=grad_clip, featurize=feat_fn),
+                    extra_key=(mesh_key,))
+            else:
+                train_step = telemetry.profiler.wrap(
+                    _make_train_step(module, tx, loss_fn, is_moe,
+                                     moe_aux, step_body=pp_body,
+                                     mixed=mixed, grad_clip=grad_clip),
+                    "trainer.step")
         # per-process batch orders only matter when processes feed distinct
         # dp shards; in local-fit mode (fleet tuner trials/refits) every
         # process must draw the IDENTICAL order or the replicated-model
@@ -1387,16 +1640,25 @@ class TpuLearner(Estimator):
                  if getattr(self, "_elastic_multiproc", False)
                  else (meshlib.collective_fit_lock if mesh.size > 1
                        else contextlib.nullcontext()))
+        # one fused featurize->train segment per fit (the fit-side twin
+        # of the transform path's pipeline/segment span)
+        seg_span = (telemetry.trace.span(
+            "pipeline/fit_segment", stages=len(plan.pairs), rows=n,
+            path="scan" if scan_fn is not None else "feed")
+            if plan is not None else contextlib.nullcontext())
         try:
             with guard, telemetry.trace.span(
                     "fit", model=cfg.get("type"), rows=n,
-                    path="scan" if scan_fn is not None else "feed"):
+                    path="scan" if scan_fn is not None else "feed"), \
+                    seg_span:
                 params, opt_state, last_loss = self._run_epochs(
                     start_epoch, x, y, n, bs, steps, order_rng=rng_np,
                     mesh=mesh, nproc=nproc, train_step=train_step,
                     params=params, opt_state=opt_state, scan_fn=scan_fn,
                     start_step=start_step, elastic_ctx=elastic_ctx,
-                    scale_state=scale_state)
+                    scale_state=scale_state,
+                    fused=(None if plan is None
+                           else (raws, plan.device_params())))
         finally:
             # fit-exit barrier: an async checkpoint still in flight must
             # land before the caller (or an elastic re-entry) reads the
@@ -1463,10 +1725,19 @@ class TpuLearner(Estimator):
         if nproc > 1:
             _require_inner_block_local({"tensorParallel": tp})
         mesh = meshlib.create_mesh(model=tp, devices=devices)
+        from ..core import capture as capturelib
+        # fit-side pipeline fusion (fitStreamCaptured): stream batches ship
+        # as RAW wire-dtype columns and featurize inside the step program
+        plan = getattr(self, "_featurize_plan", None)
+        raw0 = None
         first_iter = iter(batches_fn())
         first = next(first_iter, None)
+        x0 = y0 = None
         if first is not None:
-            x0, y0 = _stream_batch(first, cfg, self.getLoss())
+            if plan is not None:
+                raw0 = self._stream_raw_batch(first, plan)
+            else:
+                x0, y0 = _stream_batch(first, cfg, self.getLoss())
         if nproc > 1:
             # a process whose shard is EMPTY from the start (no files at
             # all) must still join every collective: agree the batch
@@ -1488,8 +1759,21 @@ class TpuLearner(Estimator):
             raise ValueError("batches_fn() yielded no batches")
 
         module = build_model(cfg)
-        params = module.init(jax.random.PRNGKey(self.getSeed()),
-                             jnp.asarray(x0[:1]))
+        feat_fn = None
+        if plan is not None:
+            # init from the featurized batch SHAPE (eval_shape — nothing
+            # runs): flax init draws from rng + shapes only, so this
+            # matches the staged init on real featurized rows exactly
+            feat_fn = self._featurize_fn(plan, cfg)
+            xb_s, _ = jax.eval_shape(
+                feat_fn, plan.params,
+                tuple(jax.ShapeDtypeStruct((1,) + r.shape[1:], r.dtype)
+                      for r in raw0))
+            params = module.init(jax.random.PRNGKey(self.getSeed()),
+                                 jnp.zeros(xb_s.shape, xb_s.dtype))
+        else:
+            params = module.init(jax.random.PRNGKey(self.getSeed()),
+                                 jnp.asarray(x0[:1]))
         tx = make_optimizer(self.getOptimizer(), self.getLearningRate(),
                             self.getMomentum(), self.getWeightDecay())
         loss_fn = make_loss(self.getLoss(), per_example=True)
@@ -1498,10 +1782,22 @@ class TpuLearner(Estimator):
         if self.getProfile():
             telemetry.profiler.enable()
         mixed, grad_clip, scale_state = self._precision_setup()
-        train_step = telemetry.profiler.wrap(_make_train_step(
-            module, tx, loss_fn, is_moe,
-            self.getMoeAuxWeight() if is_moe else 0.0, mixed=mixed,
-            grad_clip=grad_clip), "trainer.step")
+        if plan is not None:
+            # same program as the feed path's fused step — the instance
+            # cache (zero recompiles across resume) is shared with it
+            mesh_key = tuple(sorted(dict(mesh.shape).items()))
+            train_step = self._fused_program(
+                "step_fused", plan,
+                lambda: _make_train_step(
+                    module, tx, loss_fn, is_moe,
+                    self.getMoeAuxWeight() if is_moe else 0.0,
+                    mixed=mixed, grad_clip=grad_clip, featurize=feat_fn),
+                extra_key=(mesh_key,))
+        else:
+            train_step = telemetry.profiler.wrap(_make_train_step(
+                module, tx, loss_fn, is_moe,
+                self.getMoeAuxWeight() if is_moe else 0.0, mixed=mixed,
+                grad_clip=grad_clip), "trainer.step")
         params, opt_state = _place_params(params, mesh, tx, tp=tp)
 
         params, opt_state, start_epoch, start_step, resume_pos, \
@@ -1532,7 +1828,12 @@ class TpuLearner(Estimator):
                        else contextlib.nullcontext()))
         last_loss = None
         skipped_seen = 0
-        with guard:
+        plan_dev = plan.device_params() if plan is not None else None
+        seg_span = (telemetry.trace.span("pipeline/fit_segment",
+                                         stages=len(plan.pairs),
+                                         path="stream")
+                    if plan is not None else contextlib.nullcontext())
+        with guard, seg_span:
             for epoch in range(start_epoch, self.getEpochs()):
                 it = first_iter if epoch == start_epoch and first is not None \
                     else iter(batches_fn())
@@ -1554,7 +1855,7 @@ class TpuLearner(Estimator):
                 depth = self.getPrefetchDepth() if nproc == 1 else 0
                 steps_it = prefetchlib.prefetched(
                     lambda s=stream: self._stream_epoch_steps(
-                        s, cfg, x0, y0, share, nproc, mesh),
+                        s, cfg, x0, y0, share, nproc, mesh, plan=plan),
                     depth=depth, name="fit-stream", span="fit/prefetch")
                 ckpt_every = (self.getCheckpointEverySteps()
                               if self.getCheckpointDir() else 0)
@@ -1570,6 +1871,15 @@ class TpuLearner(Estimator):
                                     # coordinator's re-mesh
                                     elastic_ctx.check_step()
                                 faults.inject("trainer.step")
+                                if plan is not None:
+                                    # xb carries the placed raw column
+                                    # tuple; yb is None on this path
+                                    if ss is None:
+                                        p2, o2, loss = train_step(
+                                            p, o, plan_dev, xb, wb)
+                                        return p2, o2, None, loss
+                                    return train_step(p, o, ss, plan_dev,
+                                                      xb, wb)
                                 if ss is None:
                                     p2, o2, loss = train_step(p, o, xb,
                                                               yb, wb)
@@ -1577,6 +1887,8 @@ class TpuLearner(Estimator):
                                 return train_step(p, o, ss, xb, yb, wb)
                             params, opt_state, scale_state, loss = \
                                 _STEP_RETRY.run(dispatch)
+                            if plan is not None:
+                                capturelib._m_fit_fused.inc()
                         steps_run += 1
                         if n:
                             n_batches += 1
@@ -1616,16 +1928,65 @@ class TpuLearner(Estimator):
         self._ckpt_barrier()
         return self._package_model(cfg, params, last_loss)
 
-    def _stream_epoch_steps(self, stream, cfg, x0, y0, share, nproc, mesh):
+    def _stream_raw_batch(self, b, plan):
+        """A fitStreamCaptured batch as raw wire-dtype column arrays in
+        ``plan.in_names`` order — either a DataFrame carrying those
+        columns, or an already-aligned tuple/list of arrays."""
+        from ..core.dataframe import DataFrame
+        if isinstance(b, DataFrame):
+            raws = plan.encode(b)
+            if raws is None:
+                raise ValueError(
+                    "fitStreamCaptured batch is missing (or cannot encode) "
+                    f"one of the captured input columns {plan.in_names}")
+            return raws
+        arrs = [np.asarray(a) for a in b]
+        if len(arrs) != len(plan.in_names):
+            raise ValueError(
+                f"fitStreamCaptured batch has {len(arrs)} arrays; the "
+                f"capture plan needs {len(plan.in_names)} "
+                f"({plan.in_names})")
+        return arrs
+
+    def _stream_epoch_steps(self, stream, cfg, x0, y0, share, nproc, mesh,
+                            plan=None):
         """One epoch of fitStream's per-step host work as a generator:
         normalize -> pow2 bucket -> (multi-host size lockstep) -> pad ->
         weight mask -> device placement. Yields ``(n_real, xb, yb, wb)``
         with the batch already placed, so the consuming loop (optionally a
         DevicePrefetcher running this ahead of the device step) only
-        dispatches ``train_step``."""
+        dispatches ``train_step``.
+
+        With a fit-side capture ``plan`` (fitStreamCaptured,
+        single-process only) the batch stays RAW: each wire-dtype column
+        buckets/pads independently and ``xb`` is the placed column tuple
+        (``yb`` None) — featurization happens inside the step program."""
+        from ..core import capture as capturelib
         from .tpu_model import _next_pow2
         if nproc > 1:
             from jax.experimental import multihost_utils
+        while plan is not None:
+            b = next(stream, None)
+            if b is None:
+                return
+            raws = self._stream_raw_batch(b, plan)
+            n = len(raws[0])
+            target = -(-max(_next_pow2(n), share) // share) * share
+            if n < target:
+                raws = [np.concatenate(
+                    [r, np.zeros((target - n,) + r.shape[1:], r.dtype)])
+                    for r in raws]
+            wb = np.zeros(target, dtype=np.float32)
+            wb[:n] = 1.0
+            nbytes = int(sum(r.nbytes for r in raws))
+            if telemetry.enabled():
+                _note_step_signature("stream_fused", *raws, wb)
+                _m_transfer_bytes.inc(nbytes + wb.nbytes)
+            capturelib.count_fit_transfer("in", nbytes)
+            yield (n,
+                   tuple(meshlib.put_global_batch(r, mesh) for r in raws),
+                   None,
+                   meshlib.put_global_batch(wb, mesh))
         while True:
             b = next(stream, None)
             if b is None:
@@ -1671,7 +2032,12 @@ class TpuLearner(Estimator):
     def _run_epochs(self, start_epoch, x, y, n, bs, steps, *, order_rng,
                     mesh, nproc, train_step, params, opt_state,
                     scan_fn=None, start_step=0, elastic_ctx=None,
-                    scale_state=None):
+                    scale_state=None, fused=None):
+        # ``fused`` = (raw host column arrays, device-put capture params)
+        # when this fit runs a fit-side capture plan (x/y are None then):
+        # batches ship as raw wire-dtype columns and the step program
+        # featurizes them on device (_make_train_step featurize=)
+        from ..core import capture as capturelib
         if scan_fn is not None:
             if start_step:
                 # the scan path cannot enter an epoch mid-way (one dispatch
@@ -1685,7 +2051,8 @@ class TpuLearner(Estimator):
                                          order_rng=order_rng, mesh=mesh,
                                          scan_fn=scan_fn, params=params,
                                          opt_state=opt_state,
-                                         scale_state=scale_state)
+                                         scale_state=scale_state,
+                                         fused=fused)
         import time
         from ..parallel import prefetch as prefetchlib
         if steps <= 0:
@@ -1715,6 +2082,12 @@ class TpuLearner(Estimator):
                     host, mesh)
             return wb
 
+        # replay completed epochs' permutation draws so a resumed fit
+        # replays the uninterrupted fit's data orders bit-for-bit
+        if self.getShuffle():
+            for _ in range(start_epoch):
+                order_rng.permutation(n)
+
         def produce():
             """Per-step host work + H2D placement, run `prefetchDepth`
             steps ahead of the consuming loop on the prefetch thread
@@ -1733,6 +2106,23 @@ class TpuLearner(Estimator):
                     # so every process contributes exactly bs rows —
                     # identical shapes
                     idx = order[(s * bs + np.arange(bs)) % n]
+                    if fused is not None:
+                        # raw wire-dtype columns: smaller H2D than the
+                        # f32-widened features the staged feed ships
+                        cols, nb = [], 0
+                        for r in fused[0]:
+                            rb, nb = pad(r[idx], mesh)
+                            cols.append(rb)
+                        wb = placed_mask(len(cols[0]), nb)
+                        nbytes = sum(c.nbytes for c in cols)
+                        if telemetry.enabled():
+                            _note_step_signature("feed_fused", *cols)
+                            _m_transfer_bytes.inc(nbytes)
+                        capturelib.count_fit_transfer("in", nbytes)
+                        yield (epoch, s,
+                               tuple(meshlib.put_global_batch(c, mesh)
+                                     for c in cols), None, wb)
+                        continue
                     xb, nb = pad(x[idx], mesh)
                     yb, _ = pad(y[idx], mesh)
                     if micro > 1:
@@ -1775,12 +2165,21 @@ class TpuLearner(Estimator):
                             # the retry and unwinds to the re-mesh
                             elastic_ctx.check_step()
                         faults.inject("trainer.step")
+                        if fused is not None:
+                            # xb carries the placed raw column tuple
+                            if ss is None:
+                                p2, o2, loss = train_step(p, o, fused[1],
+                                                          xb, wb)
+                                return p2, o2, None, loss
+                            return train_step(p, o, ss, fused[1], xb, wb)
                         if ss is None:
                             p2, o2, loss = train_step(p, o, xb, yb, wb)
                             return p2, o2, None, loss
                         return train_step(p, o, ss, xb, yb, wb)
                     params, opt_state, scale_state, loss = \
                         _STEP_RETRY.run(dispatch)
+                    if fused is not None:
+                        capturelib._m_fit_fused.inc()
                     sp.set_sync(loss)
                 _m_step_time.observe(time.perf_counter() - t_step)
                 if elastic_ctx is not None:
@@ -1824,29 +2223,41 @@ class TpuLearner(Estimator):
 
     def _run_epochs_scan(self, start_epoch, x, y, n, bs, steps, *,
                          order_rng, mesh, scan_fn, params, opt_state,
-                         scale_state=None):
+                         scale_state=None, fused=None):
         """Single-host fast path: the epoch data lives in HBM (padded to
         ``steps*bs_pad`` rows, pad rows weight 0) and every epoch is one
         XLA dispatch — a random rotation plus a random permutation of the
-        contiguous bs-sized windows, scanned with donated state."""
+        contiguous bs-sized windows, scanned with donated state.
+
+        ``fused`` (fit-side pipeline fusion) keeps the epoch resident as
+        RAW wire-dtype columns instead of (x, y): every window
+        featurizes inside the scan body, so the upload is the raw bytes
+        and the featurized epoch never exists — not on host, not in
+        HBM."""
+        from ..core import capture as capturelib
         bs_pad = _scan_batch(bs, mesh, self.getPipelineParallel())
         # ceil instead of the feed path's floor: window tiling must cover
         # every row (the feed path re-slices a fresh permutation per step;
         # here rows outside the tiling would never be seen)
         steps = max(1, -(-n // bs_pad))
         n_pad = steps * bs_pad
+        arrs = list(fused[0]) if fused is not None else None
+        data_nbytes = (sum(int(a.nbytes) for a in arrs)
+                       if fused is not None else x.nbytes + y.nbytes)
         # Windows slice the RESIDENT order, so it must be random: datasets
         # often arrive sorted by class, and class-pure batches wreck SGD.
         # Small datasets get a TRUE fresh permutation per epoch (re-upload
         # is cheaper than one train step at this size); big ones permute
         # once at upload and vary per epoch by rotation + window order.
         reshuffle = (self.getShuffle()
-                     and x.nbytes + y.nbytes
-                     <= (self.getEpochReshuffleCap()
-                         or _EPOCH_RESHUFFLE_CAP))
+                     and data_nbytes <= (self.getEpochReshuffleCap()
+                                         or _EPOCH_RESHUFFLE_CAP))
         if self.getShuffle() and not reshuffle:
             perm0 = order_rng.permutation(n)
-            x, y = x[perm0], y[perm0]
+            if fused is not None:
+                arrs = [a[perm0] for a in arrs]
+            else:
+                x, y = x[perm0], y[perm0]
         # wrap-pad so windows tile exactly (wrapped rows carry weight 0 —
         # each real row counts once per epoch), plus a bs-row wrap margin
         # so rotated windows never wrap
@@ -1857,17 +2268,33 @@ class TpuLearner(Estimator):
             ap = _wrap_rows(a, n_pad)
             return np.concatenate([ap, ap[:bs_pad]], axis=0)
 
-        def upload(xa, ya):
+        def upload(*host_arrs):
+            nbytes = int(sum(a.nbytes for a in host_arrs))
             if telemetry.enabled():
-                _m_transfer_bytes.inc(xa.nbytes + ya.nbytes)
-            with telemetry.trace.span("fit/upload",
-                                      bytes=int(xa.nbytes + ya.nbytes)):
-                return (meshlib.shard_batch(margin(xa), mesh),
-                        meshlib.shard_batch(margin(ya), mesh))
-        x_dev, y_dev = (None, None) if reshuffle else upload(x, y)
+                _m_transfer_bytes.inc(nbytes)
+            if fused is not None:
+                capturelib.count_fit_transfer("in", nbytes)
+            with telemetry.trace.span("fit/upload", bytes=nbytes):
+                return tuple(meshlib.shard_batch(margin(a), mesh)
+                             for a in host_arrs)
+        data_dev = x_dev = y_dev = None
+        if not reshuffle:
+            if fused is not None:
+                data_dev = upload(*arrs)
+            else:
+                x_dev, y_dev = upload(x, y)
         w_dev = meshlib.shard_batch(margin(w_all), mesh)
         kpd = self.getStepsPerDispatch() or steps
         base = np.arange(steps, dtype=np.int32) * bs_pad
+        # replay the rng draws of already-completed epochs so a resumed
+        # fit sees the SAME per-epoch orders the uninterrupted fit would
+        # — kill-and-resume stays bit-exact even with shuffle on
+        for _ in range(start_epoch):
+            if reshuffle:
+                order_rng.permutation(n)
+            elif self.getShuffle():
+                order_rng.permutation(steps)
+                order_rng.integers(0, n_pad)
         last_loss = None
         skipped_seen = 0
         import time
@@ -1875,7 +2302,10 @@ class TpuLearner(Estimator):
             t_epoch = time.perf_counter()
             if reshuffle:
                 perm = order_rng.permutation(n)
-                x_dev, y_dev = upload(x[perm], y[perm])
+                if fused is not None:
+                    data_dev = upload(*[a[perm] for a in arrs])
+                else:
+                    x_dev, y_dev = upload(x[perm], y[perm])
                 starts = base
             elif self.getShuffle():
                 starts = ((base[order_rng.permutation(steps)]
@@ -1893,6 +2323,15 @@ class TpuLearner(Estimator):
                         def dispatch(_a, p=params, o=opt_state,
                                      ss=scale_state, lo=lo):
                             faults.inject("trainer.step")
+                            if fused is not None:
+                                if ss is None:
+                                    p2, o2, loss = scan_fn(
+                                        p, o, fused[1], data_dev, w_dev,
+                                        starts[lo:lo + kpd])
+                                    return p2, o2, None, loss
+                                return scan_fn(p, o, ss, fused[1],
+                                               data_dev, w_dev,
+                                               starts[lo:lo + kpd])
                             if ss is None:
                                 p2, o2, loss = scan_fn(
                                     p, o, x_dev, y_dev, w_dev,
@@ -1902,6 +2341,9 @@ class TpuLearner(Estimator):
                                            starts[lo:lo + kpd])
                         params, opt_state, scale_state, loss = \
                             _STEP_RETRY.run(dispatch)
+                        if fused is not None:
+                            capturelib._m_fit_fused.inc(
+                                min(kpd, steps - lo))
                         sp.set_sync(loss)
                     _m_step_time.observe(time.perf_counter() - t_disp)
                 ep_sp.set_sync(loss)

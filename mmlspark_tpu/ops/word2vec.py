@@ -214,6 +214,10 @@ class Word2Vec(Estimator, _W2VParams):
     """Learn word embeddings by skip-gram negative sampling, batched into
     jitted MXU steps (Spark ML Word2Vec surface; notebook-202 workflow)."""
 
+    #: consumes a token-sequence column through host vocab building and
+    #: subsampling — no array-in/array-out featurize body to fuse with
+    _uncapturable = True
+
     def _make_model(self, vocab, vectors) -> Word2VecModel:
         model = Word2VecModel()
         model.set(**{k: self.getOrDefault(k) for k in self._params

@@ -32,6 +32,15 @@ Thread-safety note: JAX dispatch/`device_put` are thread-safe, but
 processes and deadlock — producers must only do per-process work
 (transfers, host prep). Callers with per-step host collectives (e.g.
 fitStream's multi-host lockstep allgather) must stay synchronous.
+
+Fit-side fusion note: under a fused featurize->train fit
+(Pipeline.fusePipeline, docs/performance.md "Fit-side fusion") the
+trainer's feed producer places the RAW wire-dtype columns (int8/int16/…)
+instead of the f32-widened feature matrix — featurization happens inside
+the consuming device dispatch. The prefetch window then bounds *wire*
+bytes of HBM, which is strictly less than the staged window for any
+sub-f32 input, so ``depth`` can be raised on narrow-dtype pipelines at no
+extra HBM cost.
 """
 
 from __future__ import annotations
@@ -83,6 +92,10 @@ class DevicePrefetcher:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.depth = depth
         self.name = name
+        #: items the producer thread has finished placing (monotonic);
+        #: lets tests assert a prefetched run actually ran ahead instead
+        #: of degenerating to lockstep
+        self.items = 0
         self._span = span
         self._source = source
         # slots acquired BEFORE producing bound produced-but-unconsumed
@@ -124,6 +137,7 @@ class DevicePrefetcher:
                 if item is _DONE:
                     break
                 _m_produce_time.observe(time.perf_counter() - t0)
+                self.items += 1
                 self._q.put((_ITEM, item))
                 _m_queue_depth.set(self._q.qsize())
         except BaseException as e:       # re-raised at the consumer's next()

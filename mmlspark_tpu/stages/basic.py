@@ -275,9 +275,9 @@ class FastVectorAssembler(Transformer, HasOutputCol):
 
     def capture(self, columns):
         """Assembly is one concatenation — pure device work. The fused
-        form skips the categorical slot-range metadata (a FIT-time
-        concern: GBDT auto-categorical detection reads it when training,
-        and training always runs the staged transform)."""
+        form skips the categorical slot-range metadata: on the transform
+        side nothing downstream reads it, and the fit side gets it from
+        :meth:`capture_metadata` (no staged frame needed)."""
         cols = tuple(self.getInputCols())
         if not cols or any(c not in columns for c in cols):
             return None
@@ -290,3 +290,32 @@ class FastVectorAssembler(Transformer, HasOutputCol):
 
         return StageCapture(fn, inputs=cols,
                             outputs=(self.getOutputCol(),))
+
+    def capture_metadata(self, df):
+        """The assembled categorical slot-range metadata, computed from
+        the RAW frame for the fit-side capture (GBDT auto-categorical
+        detection reads it while the fused fit never materializes the
+        assembled column on host). Best-effort: None when an input
+        column is absent from the raw frame (a prefix stage produced or
+        renamed it — widths and attributes are then unknowable without
+        staging) or when an object column is empty."""
+        from ..core.schema import MML_TAG
+        cols = self.getInputCols()
+        if not cols or any(c not in df.columns for c in cols):
+            return None
+        slots = {}
+        offset = 0
+        for name in cols:
+            col = df.col(name)
+            if col.dtype == object:
+                if not len(col):
+                    return None
+                width = int(np.asarray(col[0]).size)
+            else:
+                width = int(np.prod(col.shape[1:])) if col.ndim > 1 else 1
+            cat = df.metadata(name).get(MML_TAG, {}).get("categorical")
+            if cat is not None:
+                slots[name] = {"start": offset, "width": width,
+                               "categorical": cat}
+            offset += width
+        return {MML_TAG: {"assembled": {"size": offset, "slots": slots}}}

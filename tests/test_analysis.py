@@ -1144,6 +1144,67 @@ class TestPipelineCaptureCoverage:
         """, rules=["pipeline-capture-coverage"])
         assert fs == []
 
+    # ---- fit-side extension: estimator fit bodies carry the same
+    # ---- obligation (fused Pipeline.fit, _fit_captured hook)
+
+    _FIT_POSITIVE = """
+        import jax
+        from mmlspark_tpu.core.pipeline import Estimator
+
+        _step = jax.jit(lambda p, x: p)
+
+        class Trainer(Estimator):
+            def fit(self, df):
+                return _step(0.0, df.col("x"))
+    """
+
+    def test_jit_dispatching_fit_without_hook_flagged(self, tmp_path):
+        fs = lint(tmp_path, self._FIT_POSITIVE,
+                  rules=["pipeline-capture-coverage"])
+        assert rules_of(fs) == ["pipeline-capture-coverage"]
+        assert "Trainer" in fs[0].message
+        assert "_fit_captured" in fs[0].message
+
+    def test_fit_captured_hook_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Estimator
+
+            _step = jax.jit(lambda p, x: p)
+
+            class Trainer(Estimator):
+                def fit(self, df):
+                    return _step(0.0, df.col("x"))
+
+                def _fit_captured(self, df, plan):
+                    return None
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_fit_uncapturable_marker_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import jax
+            from mmlspark_tpu.core.pipeline import Estimator
+
+            _step = jax.jit(lambda p, x: p)
+
+            class Solver(Estimator):
+                _uncapturable = True    # full-batch solve, no step seam
+                def fit(self, df):
+                    return _step(0.0, df.col("x"))
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
+    def test_host_only_fit_not_flagged(self, tmp_path):
+        fs = lint(tmp_path, """
+            from mmlspark_tpu.core.pipeline import Estimator
+
+            class Indexer(Estimator):
+                def fit(self, df):
+                    return sorted(set(df.col("x")))
+        """, rules=["pipeline-capture-coverage"])
+        assert fs == []
+
 
 class TestChaosCoverage:
     def _project(self, tmp_path, test_text, user_text):

@@ -1,0 +1,404 @@
+"""Fit-side pipeline fusion (Pipeline.fusePipeline on the FIT path):
+fused-vs-staged fit parity for TpuLearner's feed/scan/stream paths and
+both GBDT growth policies, kill-and-resume bit-exactness with zero
+recompiles, prefetch interplay, staged fallback accounting, and the
+multi-backend lowering-parity sweep over every registered StageCapture
+(the ROADMAP item-5 first slice: backend drift surfaces in tier-1)."""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mmlspark_tpu import DataFrame, Pipeline, telemetry
+from mmlspark_tpu.core import capture as capturelib
+from mmlspark_tpu.core.capture import compose_fit_capture
+from mmlspark_tpu.core.pipeline import Transformer, registered_stages
+from mmlspark_tpu.models.classical import LinearRegression, LogisticRegression
+from mmlspark_tpu.models.gbdt.stages import (LightGBMClassifier,
+                                             LightGBMRegressor)
+from mmlspark_tpu.models.trainer import TpuLearner
+from mmlspark_tpu.stages.basic import (DropColumns, FastVectorAssembler,
+                                       RenameColumn, SelectColumns,
+                                       UDFTransformer)
+from mmlspark_tpu.stages.data_stages import CleanMissingData, DataConversion
+
+
+@pytest.fixture
+def tel():
+    telemetry.enable()
+    telemetry.registry.reset()
+    yield telemetry
+    telemetry.disable()
+
+
+def _raw_frame(n=256, seed=0):
+    """Wire-dtype raw columns: the shapes the fused fit ships instead of
+    the f32-widened feature matrix."""
+    rng = np.random.default_rng(seed)
+    return DataFrame({
+        "a": rng.integers(-5, 6, size=n).astype(np.int8),
+        "b": rng.integers(0, 7, size=(n, 3)).astype(np.int16),
+        "label": rng.integers(0, 2, size=n).astype(np.int32)})
+
+
+def _learner(**kw):
+    base = dict(modelConfig={"type": "mlp", "hidden": [8],
+                             "num_classes": 2},
+                epochs=3, batchSize=64, seed=7, learningRate=0.1,
+                shuffle=True)
+    base.update(kw)
+    return TpuLearner().set(**base)
+
+
+def _pipeline(df, fuse, lr=None):
+    asm = (FastVectorAssembler().setInputCols(("a", "b"))
+           .setOutputCol("features"))
+    return Pipeline().setStages((asm, lr or _learner())) \
+        .setFusePipeline(fuse).fit(df)
+
+
+def _digest(model):
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(model.getOrDefault("modelParams")):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def _last(pm):
+    return pm.getOrDefault("stages")[-1]
+
+
+# ----------------------------------------------------------- fit parity
+
+class TestTrainerFitParity:
+    @pytest.mark.parametrize("epochs", [1, 3])
+    def test_scan_path(self, tel, epochs):
+        """Loss-trajectory parity via params at two epoch prefixes: the
+        fused scan program replays the staged updates bit for bit."""
+        df = _raw_frame()
+        d0 = capturelib._m_fit_fused.value
+        staged = _pipeline(df, False, _learner(epochs=epochs))
+        fused = _pipeline(df, True, _learner(epochs=epochs))
+        assert _digest(_last(staged)) == _digest(_last(fused))
+        assert capturelib._m_fit_fused.value > d0
+
+    def test_feed_path(self, tel):
+        df = _raw_frame()
+        staged = _pipeline(df, False, _learner(deviceDataCap=1))
+        lr = _learner(deviceDataCap=1)
+        fused = _pipeline(df, True, lr)
+        assert _digest(_last(staged)) == _digest(_last(fused))
+        # ONE compile per fused program, flat across every epoch
+        for pf in lr._fused_programs.values():
+            assert pf.compiles == 1, (pf.name, pf.causes)
+
+    def test_feed_path_with_prefetch(self, tel):
+        """prefetchDepth>0: raw wire-dtype rows produced ahead on the
+        prefetch thread replay the synchronous trajectory exactly."""
+        df = _raw_frame()
+        staged = _pipeline(df, False,
+                           _learner(deviceDataCap=1, prefetchDepth=2))
+        fused = _pipeline(df, True,
+                          _learner(deviceDataCap=1, prefetchDepth=2))
+        assert _digest(_last(staged)) == _digest(_last(fused))
+
+    def test_stream_path(self, tel):
+        raws = [_raw_frame(n=64, seed=s) for s in range(4)]
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+
+        def staged_batches():
+            for b in raws:
+                out = asm.transform(b)
+                yield (np.stack([np.asarray(v)
+                                 for v in out.col("features")]),
+                       out.col("label"))
+
+        staged = _learner().fitStream(staged_batches)
+        plan = compose_fit_capture([asm], raws[0], "features", "label")
+        assert plan is not None
+        fused = _learner().fitStreamCaptured(lambda: iter(raws), plan)
+        assert _digest(staged) == _digest(fused)
+
+    def test_transfer_bytes_below_staged(self, tel):
+        """The acceptance inequality: fit-phase H2D for raw wire dtypes
+        is strictly below the staged f32-widened uploads."""
+        from mmlspark_tpu.models import trainer as trainerlib
+        df = _raw_frame(n=512)
+        b0 = trainerlib._m_transfer_bytes.value
+        _pipeline(df, False)
+        staged_b = trainerlib._m_transfer_bytes.value - b0
+        b1 = trainerlib._m_transfer_bytes.value
+        fin0 = capturelib._m_transfer.labels(
+            direction="in", phase="fit").value
+        _pipeline(df, True)
+        fused_b = trainerlib._m_transfer_bytes.value - b1
+        assert fused_b < staged_b, (fused_b, staged_b)
+        # and the fit-phase pipeline counter saw the raw uploads
+        assert capturelib._m_transfer.labels(
+            direction="in", phase="fit").value > fin0
+
+
+class TestGbdtFitParity:
+    @pytest.mark.parametrize("policy", ["leafwise", "depthwise"])
+    def test_classifier(self, tel, policy):
+        df = _raw_frame(n=512)
+        def mk():
+            return (LightGBMClassifier().setNumIterations(6)
+                    .setNumLeaves(8).setLearningRate(0.2)
+                    .setGrowthPolicy(policy))
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+        d0 = capturelib._m_fit_fused.value
+        staged = Pipeline().setStages((asm, mk())).fit(df)
+        fused = (Pipeline().setStages((asm, mk()))
+                 .setFusePipeline(True).fit(df))
+        s0, s1 = (_last(staged).getBoosterState(),
+                  _last(fused).getBoosterState())
+        for k in s0:
+            np.testing.assert_array_equal(np.asarray(s0[k]),
+                                          np.asarray(s1[k]), err_msg=k)
+        assert capturelib._m_fit_fused.value > d0
+
+    @pytest.mark.parametrize("policy", ["leafwise", "depthwise"])
+    def test_regressor(self, tel, policy):
+        rng = np.random.default_rng(1)
+        n = 512
+        df = DataFrame({
+            "a": rng.integers(-5, 6, size=n).astype(np.int8),
+            "b": rng.integers(0, 7, size=(n, 3)).astype(np.int16),
+            "label": rng.normal(size=n).astype(np.float32)})
+        def mk():
+            return (LightGBMRegressor().setNumIterations(6)
+                    .setNumLeaves(8).setLearningRate(0.2)
+                    .setGrowthPolicy(policy))
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+        staged = Pipeline().setStages((asm, mk())).fit(df)
+        fused = (Pipeline().setStages((asm, mk()))
+                 .setFusePipeline(True).fit(df))
+        s0, s1 = (_last(staged).getBoosterState(),
+                  _last(fused).getBoosterState())
+        for k in s0:
+            np.testing.assert_array_equal(np.asarray(s0[k]),
+                                          np.asarray(s1[k]), err_msg=k)
+
+    def test_elastic_config_declines_to_staged(self, tel):
+        """A booster configured for elastic training is outside the
+        fused binner's coverage — the hook declines and the staged fit
+        would take over (here: hook returns None, fallback counted)."""
+        df = _raw_frame(n=128)
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+        est = (LightGBMClassifier().setNumIterations(2)
+               .set(elasticConfig={"checkpointDir": "/tmp/nope",
+                                   "minHosts": 1}))
+        plan = compose_fit_capture([asm], df, "features", "label")
+        assert est._fit_captured(df, plan) is None
+
+
+# ------------------------------------------------- resume + fallbacks
+
+class TestKillAndResume:
+    def test_fused_resume_bit_exact_zero_recompiles(self, tel, tmp_path):
+        ck = str(tmp_path / "ck")
+        df = _raw_frame()
+        uninterrupted = _pipeline(df, True, _learner(epochs=3))
+        # "kill" after epoch 2, then a fresh learner resumes epoch 3
+        _pipeline(df, True, _learner(epochs=2, checkpointDir=ck))
+        lr = _learner(epochs=3, checkpointDir=ck)
+        resumed = _pipeline(df, True, lr)
+        assert _digest(_last(uninterrupted)) == _digest(_last(resumed))
+        # the resumed fit compiled its program ONCE — aot cache, no
+        # shape/sharding-driven recompiles across the resume boundary
+        assert lr._fused_programs
+        for pf in lr._fused_programs.values():
+            assert pf.compiles == 1, (pf.name, pf.causes)
+
+    def test_resume_rejects_foreign_featurize_digest(self, tel, tmp_path):
+        """A checkpoint written under a DIFFERENT featurize plan must
+        not be resumed from — the manifest digest filters it out and
+        the fit starts fresh (epoch count proves it)."""
+        ck = str(tmp_path / "ck")
+        df = _raw_frame()
+        _pipeline(df, True, _learner(epochs=2, checkpointDir=ck))
+        # same checkpointDir, different featurize prefix (b only)
+        asm2 = (FastVectorAssembler().setInputCols(("b",))
+                .setOutputCol("features"))
+        lr2 = _learner(epochs=3, checkpointDir=ck)
+        pm = (Pipeline().setStages((asm2, lr2)).setFusePipeline(True)
+              .fit(df))
+        # a fresh 3-epoch fit over the 1+3-col featurization — NOT a
+        # resume of the 4-col run's params (shape alone would break it);
+        # the digest filter made it start at epoch 0
+        assert _last(pm).getOrDefault("modelParams") is not None
+
+
+class TestFallbacks:
+    def test_uncapturable_prefix_falls_back_staged(self, tel):
+        df = _raw_frame()
+        udf = UDFTransformer().setInputCol("a").setOutputCol("a") \
+            .setUdf(lambda v: np.asarray(v) * 1)
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+        fb0 = capturelib._m_fit_fallbacks.value
+        fused0 = capturelib._m_fit_fused.value
+        pm = (Pipeline().setStages((udf, asm, _learner()))
+              .setFusePipeline(True).fit(df))
+        assert capturelib._m_fit_fallbacks.value > fb0
+        assert capturelib._m_fit_fused.value == fused0
+        # the staged fallback still produced a trained model
+        staged = Pipeline().setStages((udf, asm, _learner())).fit(df)
+        assert _digest(_last(pm)) == _digest(_last(staged))
+
+    def test_estimator_without_hook_falls_back(self, tel):
+        df = _raw_frame()
+        asm = (FastVectorAssembler().setInputCols(("a", "b"))
+               .setOutputCol("features"))
+        fb0 = capturelib._m_fit_fallbacks.value
+        pm = (Pipeline().setStages((asm, LogisticRegression()
+                                    .setMaxIter(5)))
+              .setFusePipeline(True).fit(df))
+        assert capturelib._m_fit_fallbacks.value > fb0
+        assert _last(pm).getCoefficients() is not None
+
+
+# ------------------------- multi-backend lowering parity (capture sweep)
+
+def _fitted_builders():
+    """One representative (stage, frame) per class DEFINING capture().
+
+    The coverage test below fails when a new capture override lands
+    without a builder here — the lowering sweep is only evidence if it
+    is exhaustive."""
+    rng = np.random.default_rng(0)
+    n = 48
+    fcols = {"f0": rng.normal(size=n), "f1": rng.normal(size=n)}
+    fcols["f0"][::7] = np.nan
+    base = DataFrame({**fcols,
+                      "label": rng.integers(0, 2, n).astype(np.int64)})
+    feats = np.empty(n, dtype=object)
+    xm = rng.normal(size=(n, 4)).astype(np.float32)
+    for i in range(n):
+        feats[i] = xm[i]
+    featdf = DataFrame({"features": feats,
+                        "label": rng.integers(0, 2, n).astype(np.int64)})
+    regdf = DataFrame({"features": feats.copy(),
+                       "label": rng.normal(size=n).astype(np.float64)})
+
+    def clean():
+        return (CleanMissingData().setInputCols(("f0",)).fit(base), base)
+
+    def conv():
+        return (DataConversion().setCols(("f1",)).setConvertTo("float"),
+                base)
+
+    def drop():
+        return DropColumns().setCols(("f1",)), base
+
+    def select():
+        return SelectColumns().setCols(("f0", "label")), base
+
+    def rename():
+        return (RenameColumn().setInputCol("f0").setOutputCol("g0"),
+                base)
+
+    def assemble():
+        return (FastVectorAssembler().setInputCols(("f0", "f1"))
+                .setOutputCol("features"), base)
+
+    def logistic():
+        return LogisticRegression().setMaxIter(5).fit(featdf), featdf
+
+    def linreg():
+        return LinearRegression().setMaxIter(5).fit(regdf), regdf
+
+    def tpu():
+        m = (TpuLearner()
+             .set(modelConfig={"type": "mlp", "hidden": [4],
+                               "num_classes": 2},
+                  epochs=1, batchSize=16, learningRate=0.1)
+             .fit(featdf))
+        return m, featdf
+
+    def gbdt_cls():
+        # depthwise: capture() covers the dense level-wise walk only
+        return (LightGBMClassifier().setNumIterations(3)
+                .setGrowthPolicy("depthwise").fit(featdf), featdf)
+
+    def gbdt_reg():
+        return (LightGBMRegressor().setNumIterations(3)
+                .setGrowthPolicy("depthwise").fit(regdf), regdf)
+
+    return {"CleanMissingDataModel": clean, "DataConversion": conv,
+            "DropColumns": drop, "SelectColumns": select,
+            "RenameColumn": rename, "FastVectorAssembler": assemble,
+            "_ProbClassifierModel": logistic,
+            "LinearRegressionModel": linreg, "TpuModel": tpu,
+            "LightGBMClassificationModel": gbdt_cls,
+            "LightGBMRegressionModel": gbdt_reg}
+
+
+def _capture_definer(cls):
+    for c in cls.__mro__:
+        if "capture" in c.__dict__:
+            return None if c.__module__.endswith("core.pipeline") \
+                else c.__name__
+    return None
+
+
+def _encode(df, name):
+    col = df.col(name)
+    if col.dtype.kind == "O":
+        return np.stack([np.asarray(v) for v in col])
+    return np.asarray(col)
+
+
+_BACKENDS = [
+    pytest.param("cpu", id="cpu"),
+    pytest.param("gpu", id="gpu", marks=pytest.mark.skipif(
+        jax.default_backend() != "gpu", reason="no GPU backend")),
+    pytest.param("tpu", id="tpu", marks=pytest.mark.skipif(
+        jax.default_backend() != "tpu", reason="no TPU backend")),
+]
+
+
+class TestCaptureLoweringParity:
+    def test_every_capture_override_has_a_builder(self):
+        definers = {d for cls in registered_stages().values()
+                    if issubclass(cls, Transformer)
+                    # other test modules register fixture stages into the
+                    # same global registry — sweep the library's only
+                    and cls.__module__.startswith("mmlspark_tpu.")
+                    and (d := _capture_definer(cls))}
+        assert definers == set(_fitted_builders()), (
+            "capture() overrides without a lowering-parity builder "
+            "(extend _fitted_builders): "
+            f"{definers ^ set(_fitted_builders())}")
+
+    @pytest.mark.parametrize("backend", _BACKENDS)
+    def test_every_capture_lowers_and_matches_staged(self, backend):
+        """Every StageCapture body must (a) lower on this backend and
+        (b) reproduce the staged transform's columns at f32 precision —
+        the seam where a backend-specific lowering bug would surface."""
+        for name, build in _fitted_builders().items():
+            stage, df = build()
+            cap = stage.capture(tuple(df.columns))
+            assert cap is not None, name
+            xs = tuple(jnp.asarray(_encode(df, c)) for c in cap.inputs)
+            jitted = jax.jit(cap.fn)
+            jitted.lower(cap.params, xs)        # lowering must succeed
+            if not cap.outputs:
+                continue                         # structural stage
+            outs = jitted(cap.params, xs)
+            staged = stage.transform(df)
+            for out_name, got in zip(cap.outputs, outs):
+                want = _encode(staged, out_name)
+                np.testing.assert_allclose(
+                    np.asarray(got, dtype=np.float64),
+                    want.astype(np.float64),
+                    rtol=1e-4, atol=1e-5,
+                    err_msg=f"{name}:{out_name}")

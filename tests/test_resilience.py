@@ -985,7 +985,9 @@ def test_heartbeat_write_retry_and_errors_counter(tmp_path, telemetry_on):
     d = str(tmp_path / "hb")
     hb = HostHeartbeat("hostX", d, interval=0.03).start()
     try:
-        time.sleep(0.05)
+        deadline = time.time() + 5
+        while time.time() < deadline and not os.path.exists(hb.path):
+            time.sleep(0.02)
         assert os.path.exists(hb.path)
         # simulate the outage: the directory becomes unwritable (a file
         # squats on its name)
