@@ -874,6 +874,58 @@ def loader_scenario():
     return out
 
 
+def tune_fleet_scenario():
+    """Fleet hyperparameter search throughput: the ASHA trial scheduler
+    (automl/trials.py) running in-process workers over breast_cancer x
+    LogisticRegression. Reports settled trials/hour alongside the
+    winner's cross-validated accuracy — the quality floor that makes the
+    throughput number comparable across rounds (a faster schedule that
+    ships a worse model is a regression, not a win)."""
+    from sklearn.datasets import load_breast_cancer
+
+    from mmlspark_tpu import DataFrame, telemetry
+    from mmlspark_tpu.automl import TuneHyperparameters
+    from mmlspark_tpu.models import LogisticRegression
+
+    x, y = load_breast_cancer(return_X_y=True)
+    feats = np.empty(len(x), dtype=object)
+    for i in range(len(x)):
+        feats[i] = x[i, :10].astype(np.float32)
+    df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+
+    num_runs, workers, rungs = 8, 4, [2, 4, 8]
+    telemetry.enable()
+    tuner = (TuneHyperparameters()
+             .setModels((LogisticRegression().setMaxIter(10),))
+             .setEvaluationMetric("accuracy")
+             .setNumFolds(3).setNumRuns(num_runs).setSeed(3)
+             .setBackend("fleet").setNumWorkers(workers)
+             .setAsha({"eta": 2, "rungs": rungs, "max_seconds": 600}))
+    t0 = time.perf_counter()
+    model = tuner.fit(df)
+    dt = time.perf_counter() - t0
+
+    quality = float(model.getBestMetric())
+    floor = 0.80
+    assert quality >= floor, (
+        f"fleet tune quality {quality:.4f} fell below the {floor} floor "
+        f"— the trials/hour number is meaningless at this accuracy")
+    cfg = (f"{num_runs} trials x LogisticRegression, {workers} workers, "
+           f"eta 2, rungs {rungs}, quality floor {floor}")
+    out = [_with_baseline({
+               "metric": "tune_trials_per_hour",
+               "value": round(num_runs / dt * 3600.0, 1),
+               "unit": "trials/hour", "vs_baseline": None,
+               "config": cfg}),
+           _with_baseline({
+               "metric": "tune_fleet_best_accuracy",
+               "value": round(quality, 4), "unit": "accuracy",
+               "vs_baseline": None, "config": cfg})]
+    for r in out:
+        print(json.dumps(r))
+    return out
+
+
 def suite(profile: bool = False):
     """``--all``: every scenario, one versioned schema document (the
     last printed line; the perf gate's input). A scenario whose optional
@@ -889,6 +941,7 @@ def suite(profile: bool = False):
                  ("pipeline_fused", pipeline_fused_scenario),
                  ("pipeline_fit_fused", pipeline_fit_fused_scenario),
                  ("serving", serving_scenario),
+                 ("tune_fleet", tune_fleet_scenario),
                  ("loader", loader_scenario))
     scen_out: dict = {}
     metrics: list = []
@@ -926,7 +979,8 @@ if __name__ == "__main__":
     ap.add_argument("--all", action="store_true",
                     help="multi-scenario suite (train, train_bf16 mixed-"
                          "precision, GBDT fit/predict, quantized predict, "
-                         "serving closed-loop, loader); the last line is "
+                         "serving closed-loop, tune_fleet ASHA trial "
+                         "scheduling, loader); the last line is "
                          "one mmlspark-bench/v1 JSON document the perf "
                          "gate (python -m mmlspark_tpu.perf) checks "
                          "against the BENCH_r*.json history")

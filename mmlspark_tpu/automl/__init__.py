@@ -4,6 +4,8 @@ from .model_statistics import (ComputeModelStatistics,
                                ComputePerInstanceStatistics)
 from .train_classifier import (TrainClassifier, TrainRegressor,
                                TrainedClassifierModel, TrainedRegressorModel)
+from .scheduler import TrialScheduler
+from .trials import TrialFleet, TrialWorker, fit_fleet
 from .tune import (BestModel, DefaultHyperparams, DiscreteHyperParam,
                    FindBestModel, GridSpace, HyperparamBuilder,
                    RandomSpace, RangeHyperParam, TuneHyperparameters,

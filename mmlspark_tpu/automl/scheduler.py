@@ -1,0 +1,225 @@
+"""Asynchronous successive-halving (ASHA) trial scheduling.
+
+:class:`TrialScheduler` is the pure decision core of the fleet tuner
+(`automl/trials.py`): it holds no sockets, no threads and no clocks, so
+unit tests drive it deterministically and the driver loop stays a thin
+transport around it.
+
+Rung math. With ``n`` candidates, reduction factor ``eta`` and rung
+budgets ``rungs = [b0 < b1 < ...]``, the expected population at rung
+``r`` is ``n_r = max(1, floor(n / eta**r))``. A trial that reported at
+rung ``r`` PROMOTES to rung ``r+1`` once it has beaten at least
+``n_r - n_{r+1}`` of the values reported at ``r`` — i.e. as soon as it
+provably belongs to rung ``r``'s top ``n_{r+1}`` no matter what the
+still-missing reports turn out to be. Symmetrically it is STOPPED once
+``n_{r+1}`` reported values beat it (it can never make the cut). Both
+verdicts are functions of the SET of reported values, never their
+arrival order — which is what makes the fleet tuner's final best
+setting reproducible under worker kills, respawns and permuted metric
+arrival (the chaos e2e's acceptance bar). Early leaders still promote
+long before a rung completes, so the schedule remains asynchronous:
+nothing ever waits for a rung barrier.
+
+Ties break by trial id (lower id wins), so equal metrics cannot make
+two replays disagree.
+
+The promotion verdict passes the ``automl.promote`` chaos site: an
+injected fault skips this decision round (counted), and the next
+harvest re-decides from the same reported set — delaying, never
+corrupting, the schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import telemetry
+from ..resilience import faults
+
+PENDING = "pending"      # sampled, never started
+RUNNING = "running"      # assigned to a worker at .rung
+PAUSED = "paused"        # reported at .rung, awaiting a verdict
+STOPPED = "stopped"      # halved away — never runs again
+DONE = "done"            # reported at the final rung
+
+_m_promotions = telemetry.registry.counter(
+    "mmlspark_tune_promotions_total",
+    "trials promoted to the next rung by the ASHA verdict")
+_m_stops = telemetry.registry.counter(
+    "mmlspark_tune_stops_total",
+    "trials early-stopped by the ASHA verdict")
+_m_promote_faults = telemetry.registry.counter(
+    "mmlspark_tune_promote_faults_total",
+    "promotion rounds skipped by an injected automl.promote fault "
+    "(the next harvest re-decides)")
+
+
+class _Trial:
+    __slots__ = ("id", "payload", "status", "rung", "values")
+
+    def __init__(self, tid: int, payload):
+        self.id = tid
+        self.payload = payload
+        self.status = PENDING
+        self.rung = -1              # deepest rung assigned so far
+        self.values: dict[int, float] = {}   # rung -> reported metric
+
+
+class TrialScheduler:
+    """Order-independent ASHA over a FIXED candidate list.
+
+    ``payloads`` carries one opaque item per candidate (the fleet driver
+    stores ``(estimator_index, setting)``); the scheduler only ever
+    hands back trial ids. ``maximize`` orients the metric; ``rungs``
+    are the cumulative budgets handed to workers (epochs / boosting
+    iterations), strictly increasing.
+    """
+
+    def __init__(self, payloads, rungs, eta: int = 3,
+                 maximize: bool = True):
+        rungs = [int(b) for b in rungs]
+        if not rungs or any(b <= 0 for b in rungs):
+            raise ValueError(f"rungs must be positive budgets, got {rungs}")
+        if any(a >= b for a, b in zip(rungs, rungs[1:])):
+            raise ValueError(f"rungs must be strictly increasing: {rungs}")
+        if eta < 2:
+            raise ValueError(f"eta must be >= 2, got {eta}")
+        self.rungs = rungs
+        self.eta = int(eta)
+        self.maximize = bool(maximize)
+        self.trials = [_Trial(i, p) for i, p in enumerate(payloads)]
+        if not self.trials:
+            raise ValueError("no candidates to schedule")
+        self.promote_skips = 0
+
+    # ------------------------------------------------------------ rung math
+    def population(self, rung: int) -> int:
+        """Expected population ``n_r`` at ``rung`` (never below 1)."""
+        return max(1, len(self.trials) // (self.eta ** rung))
+
+    def _reported(self, rung: int) -> list:
+        return [t for t in self.trials if rung in t.values]
+
+    def _beats(self, a: "_Trial", b: "_Trial", rung: int) -> bool:
+        """Strict order at ``rung``: better metric, ties to lower id."""
+        va, vb = a.values[rung], b.values[rung]
+        if va == vb:
+            return a.id < b.id
+        return va > vb if self.maximize else va < vb
+
+    def _verdict(self, t: "_Trial") -> Optional[str]:
+        """``"promote"`` / ``"stop"`` / None (undecidable yet) for a
+        PAUSED trial — a pure function of the reported set at its rung."""
+        r = t.rung
+        n_r, n_next = self.population(r), self.population(r + 1)
+        peers = self._reported(r)
+        beaten = sum(1 for p in peers if p is not t and self._beats(t, p, r))
+        if beaten >= n_r - n_next:
+            return "promote"
+        beaten_by = sum(1 for p in peers
+                        if p is not t and self._beats(p, t, r))
+        if beaten_by >= n_next:
+            return "stop"
+        return None
+
+    # ------------------------------------------------------------- reports
+    def report(self, trial_id: int, rung: int, value: float):
+        """A worker finished ``trial_id``'s chunk at ``rung`` with
+        validation metric ``value``. Idempotent per (trial, rung) — a
+        respawned worker re-reporting a rung it already published
+        changes nothing."""
+        t = self.trials[trial_id]
+        if rung in t.values:
+            return
+        t.values[rung] = float(value)
+        t.rung = max(t.rung, rung)
+        t.status = DONE if rung == len(self.rungs) - 1 else PAUSED
+
+    # ---------------------------------------------------------- scheduling
+    def next_work(self) -> Optional[dict]:
+        """The next assignment, or None when nothing is assignable now:
+        deepest promotable PAUSED trial first (ASHA always advances
+        survivors before widening the search), then a fresh PENDING
+        candidate at rung 0. Marks the returned trial RUNNING."""
+        self._settle()
+        try:
+            faults.inject("automl.promote")
+            promotable = [t for t in self.trials if t.status == PAUSED
+                          and self._verdict(t) == "promote"]
+        except faults.InjectedFault:
+            self.promote_skips += 1
+            _m_promote_faults.inc()
+            promotable = []
+        if promotable:
+            t = max(promotable,
+                    key=lambda t: (t.rung, -self._rank(t), -t.id))
+            t.status = RUNNING
+            t.rung = t.rung + 1
+            _m_promotions.inc()
+            telemetry.trace.instant("tune/rung", trial=t.id, rung=t.rung,
+                                    verdict="promote")
+            return {"trial": t.id, "rung": t.rung,
+                    "budget": self.rungs[t.rung]}
+        for t in self.trials:
+            if t.status == PENDING:
+                t.status = RUNNING
+                t.rung = 0
+                return {"trial": t.id, "rung": 0, "budget": self.rungs[0]}
+        return None
+
+    def _rank(self, t: "_Trial") -> int:
+        """Position of ``t`` among reports at its rung (0 = best)."""
+        peers = self._reported(t.rung)
+        return sum(1 for p in peers if p is not t and self._beats(p, t,
+                                                                  t.rung))
+
+    def _settle(self):
+        """Stop every PAUSED trial whose verdict is already ``stop``."""
+        for t in self.trials:
+            if t.status == PAUSED and self._verdict(t) == "stop":
+                t.status = STOPPED
+                _m_stops.inc()
+                telemetry.trace.instant("tune/rung", trial=t.id,
+                                        rung=t.rung, verdict="stop")
+
+    def running(self) -> list:
+        return [t.id for t in self.trials if t.status == RUNNING]
+
+    def assignment(self, trial_id: int) -> dict:
+        """Re-issue the CURRENT assignment of a RUNNING trial (what a
+        respawned worker must be handed so the lineage resumes)."""
+        t = self.trials[trial_id]
+        if t.status != RUNNING:
+            raise ValueError(f"trial {trial_id} is {t.status}, not running")
+        return {"trial": t.id, "rung": t.rung, "budget": self.rungs[t.rung]}
+
+    # ------------------------------------------------------------- terminal
+    def finished(self) -> bool:
+        """Every trial settled (DONE or STOPPED) — nothing running,
+        nothing pending, nothing undecided. The promotion rule's
+        ``n_{r+1} >= 1`` floor guarantees at least one DONE trial."""
+        self._settle()
+        if any(t.status in (RUNNING, PENDING) for t in self.trials):
+            return False
+        paused = [t for t in self.trials if t.status == PAUSED]
+        return not paused
+
+    def counts(self) -> dict:
+        out: dict[str, int] = {}
+        for t in self.trials:
+            out[t.status] = out.get(t.status, 0) + 1
+        return out
+
+    def best(self) -> tuple:
+        """``(trial_id, rung, value)`` of the best report at the deepest
+        reported rung (the final-rung winner once :meth:`finished`)."""
+        deepest = max((r for t in self.trials for r in t.values),
+                      default=None)
+        if deepest is None:
+            raise ValueError("no trial has reported yet")
+        pool = self._reported(deepest)
+        win = pool[0]
+        for t in pool[1:]:
+            if self._beats(t, win, deepest):
+                win = t
+        return win.id, deepest, win.values[deepest]

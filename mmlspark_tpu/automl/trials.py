@@ -1,0 +1,700 @@
+"""Fleet trial execution: supervised hyperparameter trials with ASHA.
+
+The distributed half of :class:`~mmlspark_tpu.automl.tune
+.TuneHyperparameters` (``backend="fleet"``). The local backend runs
+every (candidate, fold) to its full budget on a thread pool; this one
+runs candidates as **trials** on a fleet of supervised workers and
+spends budget where the metrics say it matters:
+
+* each :class:`TrialWorker` is a slot — an in-process object (tests,
+  bench) or a real OS process (``python -m mmlspark_tpu.automl.trials``,
+  the chaos target) — with the same control surface the serving fleet's
+  workers expose: ``GET /healthz`` for the supervisor's probes,
+  ``GET /timeseries`` for the driver's :class:`FleetScraper`, and a
+  ``POST /assign`` door the driver hands work through;
+* a trial chunk is a CHECKPOINTED fit: estimators with a checkpoint
+  surface (TpuLearner's ``checkpointDir``/``checkpointEverySteps``)
+  train each rung inside a per-trial **lineage directory**
+  (``workdir/trials/t<id>``), so rung ``r+1`` resumes rung ``r``'s
+  weights instead of refitting, and a worker killed mid-chunk resumes
+  from its ``(epoch, step)`` checkpoint when the supervisor respawns
+  the slot — replays only, never from scratch. Estimators without one
+  (classical ``maxIter`` models) refit per rung, which their budgets
+  make cheap;
+* results travel as METRICS, not RPCs: a finished chunk publishes
+  ``mmlspark_tune_rung_metric{trial=,rung=}`` and bumps
+  ``mmlspark_tune_trial_rung{trial=}`` in the worker's own registry;
+  the driver's scraper federates every worker's ``/timeseries`` and
+  the harvest loop reads completions out of the merged rings. A
+  worker's death loses nothing already scraped — the federated rings
+  keep the trial's metric history while the slot respawns;
+* the driver feeds an order-independent ASHA
+  :class:`~mmlspark_tpu.automl.scheduler.TrialScheduler`: survivors
+  promote into deeper rungs, the halved-away majority stops early, and
+  freed slots pick up the next pending candidate;
+* per-unit fit wall time feeds the scraper's rolling-MAD skew detector
+  (``skew_hist="mmlspark_tune_unit_seconds"``); a worker flagged for
+  ``evict_after`` consecutive harvest rounds is evicted at its next
+  rung boundary — killed, respawned clean, and its trial reassigned
+  into the same lineage — so one slow host cannot stall a rung.
+
+Chaos sites: ``automl.trial`` (assignment RPC + the fit chunk itself),
+``automl.report`` (the worker's metric publish), ``automl.promote``
+(the scheduler's promotion verdict). All three recover through the
+shared RetryPolicy / next-harvest re-decision, so a configured fault
+delays the search without changing its outcome.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import queue
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..core.utils import get_logger
+from ..io.http.server import bind_with_probing
+from ..resilience import faults
+from ..resilience.policy import RetryPolicy
+from ..resilience.supervisor import FleetSupervisor
+from ..telemetry.federation import FederatedSampler, FleetScraper
+from ..telemetry.registry import MetricsRegistry
+from ..telemetry.timeseries import TimeSeriesSampler
+from . import metrics as M
+from .scheduler import PAUSED, PENDING, TrialScheduler
+from .tune import (TuneHyperparametersModel, _kfold_indices, _metric_for,
+                   _sample_candidates)
+
+log = get_logger("automl.trials")
+
+_m_active = telemetry.registry.gauge(
+    "mmlspark_tune_active_trials",
+    "trials currently assigned to fleet workers (driver-side)")
+_m_evictions = telemetry.registry.counter(
+    "mmlspark_tune_evictions_total",
+    "straggling trial workers evicted at a rung boundary")
+
+
+def _worker_metrics(registry: MetricsRegistry) -> dict:
+    """The tune instrument set, registered in ONE worker's registry (each
+    slot samples and serves its own rings — the driver's federation is
+    the only place they meet)."""
+    return {
+        "rung_metric": registry.gauge(
+            "mmlspark_tune_rung_metric",
+            "validation metric reported at a completed rung",
+            labels=("trial", "rung")),
+        "trial_rung": registry.gauge(
+            "mmlspark_tune_trial_rung",
+            "1 + the highest rung this trial has completed (0 = none); "
+            "the driver's harvest loop reads completions off this",
+            labels=("trial",)),
+        "progress": registry.gauge(
+            "mmlspark_tune_trial_progress",
+            "fraction of the final rung's budget this trial has trained",
+            labels=("trial",)),
+        "reports": registry.counter(
+            "mmlspark_tune_reports_total",
+            "rung results published by this worker"),
+        "resumes": registry.counter(
+            "mmlspark_tune_resumes_total",
+            "trial chunks that resumed an existing checkpoint lineage "
+            "instead of fitting from scratch"),
+        "failures": registry.counter(
+            "mmlspark_tune_trial_failures_total",
+            "trial chunk attempts that raised (retried by policy)"),
+        "unit_seconds": registry.histogram(
+            "mmlspark_tune_unit_seconds",
+            "fit wall seconds per budget unit (epoch/iteration) — the "
+            "fleet scraper's straggler-attribution input"),
+    }
+
+
+def _budget_param(est) -> Optional[str]:
+    """The estimator's budget knob, by convention: ``epochs``
+    (TpuLearner), ``numIterations`` (boosted trees), ``maxIter``
+    (classical solvers)."""
+    for name in ("epochs", "numIterations", "maxIter"):
+        if est.hasParam(name):
+            return name
+    return None
+
+
+def _lineage_dir(workdir: str, trial: int) -> str:
+    return os.path.join(workdir, "trials", f"t{trial:04d}")
+
+
+def _with_scored_labels(df, metric: str):
+    """TpuLearner's transform emits per-class ``scores`` without a
+    predicted-label column; classification metrics need one, so derive
+    it as the per-row argmax."""
+    if metric in M.CLASSIFICATION_METRICS \
+            and "scored_labels" not in df.columns \
+            and "prediction" not in df.columns \
+            and "scores" in df.columns:
+        preds = np.array([int(np.argmax(np.asarray(s)))
+                          for s in df.col("scores")], dtype=np.int64)
+        return df.withColumn("scored_labels", preds)
+    return df
+
+
+class TrialWorker:
+    """One trial slot: a fit loop behind the fleet control surface.
+
+    ``spec`` carries the shared tuning context: ``estimators`` (list),
+    ``train`` / ``val`` (DataFrames), ``label``, ``metric``,
+    ``workdir`` (checkpoint lineages live under it), ``ckpt_every``
+    (step-checkpoint interval for checkpointing estimators) and
+    ``max_budget`` (the final rung's budget, for the progress gauge).
+    ``unit_delay`` is a test hook: seconds of synthetic slowness per
+    budget unit, how straggler tests manufacture a slow host.
+    """
+
+    def __init__(self, spec: dict, slot: int, host: str = "127.0.0.1",
+                 control_port: int = 0, interval: float = 0.05,
+                 unit_delay: float = 0.0):
+        self.spec = spec
+        self.slot = int(slot)
+        self.unit_delay = float(unit_delay)
+        self.closed = False
+        self._busy: Optional[int] = None
+        self._done = 0
+        self._lock = threading.Lock()
+        self._inbox: "queue.Queue" = queue.Queue()
+        self.registry = MetricsRegistry()
+        self.metrics = _worker_metrics(self.registry)
+        self.sampler = TimeSeriesSampler(registry=self.registry,
+                                         interval=float(interval))
+        self.sampler.start(interval=float(interval))
+        self._retry = RetryPolicy(name="automl.trial", max_attempts=3,
+                                  base_delay=0.05, max_delay=0.5)
+        worker = self
+
+        class Control(BaseHTTPRequestHandler):
+            def _json(self, code: int, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                # the fleet's shared debug-plane chaos site: supervisor
+                # probes and scrapes must survive a flapping control GET
+                try:
+                    faults.inject("http.debug")
+                except Exception:
+                    self.send_error(503, "injected debug-plane fault")
+                    return
+                if self.path in ("/health", "/healthz"):
+                    with worker._lock:
+                        busy, done = worker._busy, worker._done
+                    self._json(200, {"ok": True, "slot": worker.slot,
+                                     "busy": busy, "done": done})
+                elif self.path == "/timeseries":
+                    self._json(200, worker.sampler.snapshot())
+                elif self.path == "/metrics":
+                    body = worker.registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/assign":
+                    with worker._lock:
+                        if worker._busy is not None \
+                                and worker._busy != req.get("trial"):
+                            self._json(409, {"ok": False,
+                                             "busy": worker._busy})
+                            return
+                        worker._busy = int(req["trial"])
+                    worker._inbox.put(req)
+                    self._json(200, {"ok": True})
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):
+                pass
+
+        self.control = bind_with_probing(host, control_port, Control)
+        self.control_port = self.control.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self.control.serve_forever, daemon=True,
+            name=f"trial-control-{slot}")
+        self._http_thread.start()
+        self._fit_thread = threading.Thread(
+            target=self._run, daemon=True, name=f"trial-fit-{slot}")
+        self._fit_thread.start()
+
+    # --------------------------------------------------------------- loop
+    def _run(self):
+        while not self.closed:
+            try:
+                a = self._inbox.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self._execute(a)
+            except Exception as e:
+                log.error("slot %d: trial %s rung %s failed terminally: "
+                          "%s", self.slot, a.get("trial"), a.get("rung"),
+                          e)
+            finally:
+                with self._lock:
+                    self._busy = None
+                    self._done += 1
+
+    def _execute(self, a: dict):
+        trial, rung = int(a["trial"]), int(a["rung"])
+        budget = int(a["budget"])
+        units = max(1, int(a.get("units", budget)))
+        est = self.spec["estimators"][int(a["est"])]
+        setting = dict(a["setting"])
+        label, metric = self.spec["label"], self.spec["metric"]
+        t0 = time.monotonic()
+        with telemetry.trace.span("tune/trial", trial=trial, rung=rung,
+                                  budget=budget, slot=self.slot):
+            def chunk(_attempt):
+                faults.inject("automl.trial")
+                e = est.copy(dict(setting, labelCol=label))
+                bp = _budget_param(e)
+                if bp is not None:
+                    e = e.copy({bp: budget})
+                if e.hasParam("checkpointDir") \
+                        and e.hasParam("checkpointEverySteps"):
+                    lineage = _lineage_dir(self.spec["workdir"], trial)
+                    os.makedirs(lineage, exist_ok=True)
+                    e.setCheckpointDir(lineage)
+                    e.setCheckpointEverySteps(
+                        int(self.spec.get("ckpt_every", 2)))
+                    if e._latest_checkpoint() is not None:
+                        self.metrics["resumes"].inc()
+                if self.unit_delay:
+                    time.sleep(self.unit_delay * units)
+                return e.fit(self.spec["train"])
+
+            def attempt(i):
+                try:
+                    return chunk(i)
+                except Exception:
+                    self.metrics["failures"].inc()
+                    raise
+
+            model = self._retry.run(attempt)
+            scored = _with_scored_labels(
+                model.transform(self.spec["val"]), metric)
+            value = _metric_for(scored, label, metric)
+            per_unit = (time.monotonic() - t0) / units
+            for _ in range(units):
+                self.metrics["unit_seconds"].observe(per_unit)
+            self._retry.run(
+                lambda _i: self._publish(trial, rung, value, budget))
+        log.info("slot %d: trial %d rung %d -> %s=%.5f", self.slot,
+                 trial, rung, metric, value)
+
+    def _publish(self, trial: int, rung: int, value: float, budget: int):
+        """Expose one rung result through the worker's own registry —
+        the scrape loop carries it to the driver. Chaos site
+        ``automl.report``: an injected fault here retries; the report is
+        either fully published or re-published (idempotent sets)."""
+        faults.inject("automl.report")
+        m = self.metrics
+        m["rung_metric"].labels(trial=str(trial),
+                                rung=str(rung)).set(float(value))
+        m["trial_rung"].labels(trial=str(trial)).set(float(rung + 1))
+        denom = float(self.spec.get("max_budget") or budget)
+        m["progress"].labels(trial=str(trial)).set(float(budget) / denom)
+        m["reports"].inc()
+        self.sampler.tick()      # publish is visible on the NEXT scrape
+
+    # ---------------------------------------------------------- lifecycle
+    def close(self):
+        self.closed = True
+        self.sampler.stop()
+        try:
+            self.control.shutdown()
+            self.control.server_close()
+        except Exception:
+            pass
+
+
+class TrialHandle:
+    """One slot's supervisor-facing handle (the ``source.workers``
+    contract): in-process (``worker``) or subprocess (``proc``)."""
+
+    def __init__(self, slot: int, host: str, control: int,
+                 proc=None, worker: Optional[TrialWorker] = None):
+        self.slot = int(slot)
+        self.host = host
+        self.control = int(control)
+        self.port = int(control)     # no public data port on a trial slot
+        self.proc = proc
+        self.worker = worker
+        self.alive = True
+        self.retired = False
+        self.draining = False
+        self.extra_argv = ()
+
+    def probably_dead(self) -> bool:
+        if self.proc is not None:
+            return self.proc.poll() is not None
+        return self.worker is None or self.worker.closed
+
+    def kill(self):
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+                self.proc.wait(timeout=5)
+            except Exception:
+                pass
+        elif self.worker is not None:
+            self.worker.close()
+
+
+class TrialFleet:
+    """The trial slots as a FleetSupervisor-able source.
+
+    ``spawn=True`` runs each slot as ``python -m
+    mmlspark_tpu.automl.trials`` (the spec pickles into ``workdir`` for
+    the subprocesses to load); the default keeps slots in-process.
+    ``unit_delays`` maps slot -> synthetic seconds-per-unit slowness for
+    the FIRST incarnation only — an evicted straggler's replacement
+    comes up clean, the way a replacement host would.
+    """
+
+    def __init__(self, spec: dict, n: int, spawn: bool = False,
+                 interval: float = 0.05, host: str = "127.0.0.1",
+                 unit_delays: Optional[dict] = None):
+        self.spec = spec
+        self.spawn_mode = bool(spawn)
+        self.interval = float(interval)
+        self.host = host
+        self.unit_delays = {int(k): float(v)
+                            for k, v in (unit_delays or {}).items()}
+        self._retry = RetryPolicy(name="automl.assign", max_attempts=3,
+                                  base_delay=0.05, max_delay=0.3)
+        if self.spawn_mode:
+            os.makedirs(spec["workdir"], exist_ok=True)
+            with open(os.path.join(spec["workdir"], "spec.pkl"),
+                      "wb") as f:
+                pickle.dump(spec, f)
+        self.incarnations = [0] * int(n)
+        self.workers = [self._spawn_slot(i) for i in range(int(n))]
+
+    # ----------------------------------------------------------- spawning
+    def _spawn_slot(self, slot: int, old: Optional[TrialHandle] = None
+                    ) -> TrialHandle:
+        delay = (self.unit_delays.get(slot, 0.0) if old is None else 0.0)
+        if not self.spawn_mode:
+            w = TrialWorker(self.spec, slot, host=self.host,
+                            control_port=(old.control if old else 0),
+                            interval=self.interval, unit_delay=delay)
+            return TrialHandle(slot, self.host, w.control_port, worker=w)
+        cmd = [sys.executable, "-m", "mmlspark_tpu.automl.trials",
+               "--workdir", self.spec["workdir"], "--slot", str(slot),
+               "--host", self.host,
+               "--control-port", str(old.control if old else 0),
+               "--interval", str(self.interval)]
+        if delay:
+            cmd += ["--unit-delay", str(delay)]
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(f"trial worker {slot} printed no ports "
+                               f"(exit {proc.poll()})")
+        info = json.loads(line)
+        return TrialHandle(slot, self.host, info["control"], proc=proc)
+
+    def respawn(self, wi: int, old) -> TrialHandle:
+        """FleetSupervisor's respawn hook: same slot, same control port,
+        same checkpoint lineage — the fresh incarnation resumes whatever
+        the dead one was mid-way through."""
+        try:
+            old.kill()
+        except Exception:
+            pass
+        return self._spawn_slot(wi, old)
+
+    # --------------------------------------------------- source contract
+    def markWorkerDead(self, i: int, reason: str = ""):
+        self.workers[i].alive = False
+        telemetry.flight.note("tune/worker_dead", slot=i, reason=reason)
+        log.warning("trial slot %d marked dead (%s)", i, reason)
+
+    def restoreWorker(self, i: int, worker=None,
+                      resurrected: bool = False):
+        if worker is not None:
+            self.workers[i] = worker
+        self.workers[i].alive = True
+        if not resurrected:
+            self.incarnations[i] += 1
+
+    def flush(self):
+        pass
+
+    # ------------------------------------------------------------ driving
+    def scrape_targets(self) -> list:
+        return [(str(i), f"http://{w.host}:{w.control}/timeseries")
+                for i, w in enumerate(self.workers) if w.alive]
+
+    def assign(self, slot: int, payload: dict) -> dict:
+        """Hand one trial chunk to a slot (chaos site ``automl.trial``
+        on the RPC; transient refusals retry through the policy)."""
+        w = self.workers[slot]
+        url = f"http://{w.host}:{w.control}/assign"
+        body = json.dumps(payload).encode()
+
+        def post(_attempt):
+            faults.inject("automl.trial")
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10.0) as r:
+                return json.loads(r.read() or b"{}")
+
+        return self._retry.run(post)
+
+    def evict(self, slot: int):
+        """Straggler eviction: kill the slot and let the supervisor
+        respawn it clean. The trial it held is re-assigned into the same
+        lineage by the driver's next round."""
+        w = self.workers[slot]
+        w.kill()
+        self.markWorkerDead(slot, reason="straggler eviction")
+        _m_evictions.inc()
+        telemetry.trace.instant("tune/rung", slot=slot, verdict="evict")
+
+    def close(self):
+        for w in self.workers:
+            try:
+                w.kill()
+            except Exception:
+                pass
+
+
+# ------------------------------------------------------------ driver loop
+
+def fit_fleet(tuner, df) -> TuneHyperparametersModel:
+    """``TuneHyperparameters.fit`` with ``backend="fleet"``.
+
+    Samples candidates exactly like the local backend (same rng
+    consumption, same duplicate-resample rule), splits off a holdout
+    validation fold, runs the ASHA schedule over ``numWorkers``
+    supervised slots, then refits the winning setting on the full frame
+    — returning the same :class:`TuneHyperparametersModel` the local
+    path does."""
+    asha = dict(tuner.getAsha() or {})
+    eta = int(asha.get("eta", 3))
+    rungs = [int(b) for b in asha.get("rungs", (1, 3, 9))]
+    spawn = bool(asha.get("spawn", False))
+    interval = float(asha.get("interval", 0.25 if spawn else 0.05))
+    evict_after = int(asha.get("evict_after", 0))   # 0 = never evict
+    max_seconds = float(asha.get("max_seconds", 600.0))
+    workdir = asha.get("workdir") or tempfile.mkdtemp(
+        prefix="mmlspark-tune-")
+    metric = tuner.getEvaluationMetric()
+    maximize = M.METRIC_MAXIMIZE[metric]
+    label = tuner.getLabelCol()
+    rng = np.random.default_rng(tuner.getSeed())
+    ests = list(tuner.getModels())
+    candidates = _sample_candidates(ests, tuner.getNumRuns(), rng)
+    index_of = {id(e): i for i, e in enumerate(ests)}
+    payloads = [(index_of[id(e)], s) for e, s in candidates]
+
+    folds = _kfold_indices(df.count(), tuner.getNumFolds(),
+                           tuner.getSeed())
+    val_mask = np.zeros(df.count(), dtype=bool)
+    val_mask[folds[0]] = True
+    spec = {"estimators": ests, "train": df.filter(~val_mask),
+            "val": df.filter(val_mask), "label": label, "metric": metric,
+            "workdir": workdir, "ckpt_every": int(asha.get("ckpt_every",
+                                                           2)),
+            "max_budget": rungs[-1]}
+
+    sched = TrialScheduler(payloads, rungs, eta=eta, maximize=maximize)
+    fleet = TrialFleet(spec, tuner.getNumWorkers(), spawn=spawn,
+                       interval=interval,
+                       unit_delays=asha.get("unit_delays"))
+    sup = FleetSupervisor(fleet, probe_interval=interval,
+                          probe_timeout=max(1.0, 4 * interval),
+                          restart_backoff=interval,
+                          respawn=fleet.respawn)
+    sampler = FederatedSampler(interval=interval,
+                               staleness=40.0 * interval, local=None)
+    scraper = FleetScraper(targets=fleet.scrape_targets,
+                           interval=interval,
+                           timeout=max(1.0, 4 * interval),
+                           sampler=sampler,
+                           skew_hist="mmlspark_tune_unit_seconds",
+                           skew_window=20.0 * interval)
+    assigned: dict[int, dict] = {}    # slot -> {trial, rung, inc}
+    skew_rounds: dict[int, int] = {}
+    deadline = time.monotonic() + max_seconds
+    # chaos/test hook: called once per driver round with the loop state
+    # (how the kill -9 e2e aims at the leading trial mid-rung)
+    on_round = asha.get("_on_round")
+    units_of = [rungs[0]] + [b - a for a, b in zip(rungs, rungs[1:])]
+
+    def payload_for(work: dict) -> dict:
+        ei, setting = payloads[work["trial"]]
+        return dict(work, est=ei, setting=setting,
+                    units=units_of[work["rung"]])
+
+    try:
+        while not sched.finished():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"fleet tuning exceeded max_seconds={max_seconds}: "
+                    f"{sched.counts()}")
+            sup.tick()
+            now = time.time()
+            scraper.scrape_once(now=now)
+
+            # harvest: completions surface as the merged trial_rung gauge
+            # crossing the assigned rung (gauge policy `max`, so any
+            # fresh worker that saw the report is enough)
+            for slot, a in sorted(assigned.items()):
+                key = f'mmlspark_tune_trial_rung{{trial="{a["trial"]}"}}'
+                done = sampler.value_at(key, now)
+                if done is None or done < a["rung"] + 1:
+                    continue
+                mkey = (f'mmlspark_tune_rung_metric'
+                        f'{{trial="{a["trial"]}",rung="{a["rung"]}"}}')
+                value = sampler.value_at(mkey, now)
+                if value is None:
+                    continue     # metric gauge lags a scrape behind
+                sched.report(a["trial"], a["rung"], value)
+                assigned.pop(slot)
+
+            # straggler eviction at rung boundaries: a slot flagged by
+            # the rolling-MAD detector for `evict_after` consecutive
+            # rounds is killed once idle; the supervisor respawns it
+            # clean and its next chunk resumes the lineage
+            if evict_after:
+                flagged = {int(wid) for wid in scraper._skewed}
+                for slot in range(len(fleet.workers)):
+                    if slot in flagged:
+                        skew_rounds[slot] = skew_rounds.get(slot, 0) + 1
+                    else:
+                        skew_rounds[slot] = 0
+                    if (skew_rounds[slot] >= evict_after
+                            and fleet.workers[slot].alive
+                            and slot not in assigned):
+                        fleet.evict(slot)
+                        skew_rounds[slot] = 0
+                        scraper.skew.forget(str(slot))
+
+            # a respawned slot comes up idle: re-hand it the running
+            # trial it died with (same trial, same rung, same lineage —
+            # the fit resumes from the consensus checkpoint)
+            for slot, a in sorted(assigned.items()):
+                w = fleet.workers[slot]
+                if fleet.incarnations[slot] != a["inc"] and w.alive:
+                    try:
+                        fleet.assign(slot, payload_for(
+                            sched.assignment(a["trial"])))
+                        a["inc"] = fleet.incarnations[slot]
+                    except Exception as e:
+                        log.warning("re-assign trial %d to slot %d "
+                                    "failed (retried next round): %s",
+                                    a["trial"], slot, e)
+
+            # fill free slots
+            for slot in range(len(fleet.workers)):
+                if slot in assigned or not fleet.workers[slot].alive:
+                    continue
+                work = sched.next_work()
+                if work is None:
+                    break
+                try:
+                    fleet.assign(slot, payload_for(work))
+                    assigned[slot] = dict(
+                        work, inc=fleet.incarnations[slot])
+                except Exception as e:
+                    log.warning("assign trial %d to slot %d failed "
+                                "(rescheduled): %s", work["trial"], slot,
+                                e)
+                    # hand the assignment back: mark paused/pending again
+                    t = sched.trials[work["trial"]]
+                    if work["rung"] == 0 and not t.values:
+                        t.status, t.rung = PENDING, -1
+                    else:
+                        t.status, t.rung = PAUSED, work["rung"] - 1
+            _m_active.set(len(assigned))
+            if on_round is not None:
+                on_round({"fleet": fleet, "sched": sched,
+                          "assigned": assigned, "sampler": sampler,
+                          "scraper": scraper})
+            time.sleep(interval)
+
+        best_tid, best_rung, best_value = sched.best()
+        ei, best_setting = payloads[best_tid]
+        bp = _budget_param(ests[ei])
+        final = dict(best_setting, labelCol=label)
+        if bp is not None:
+            final[bp] = rungs[-1]
+        best_model = ests[ei].copy(final).fit(df)
+        log.info("fleet tuning done: trial %d (rung %d) wins with "
+                 "%s=%.5f; %s", best_tid, best_rung, metric, best_value,
+                 sched.counts())
+        return (TuneHyperparametersModel()
+                .setBestModel(best_model)
+                .setBestMetric(float(best_value))
+                .setBestSetting(dict(best_setting)))
+    finally:
+        fleet.close()
+
+
+# --------------------------------------------------------- process entry
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workdir", required=True,
+                    help="tuning workdir holding spec.pkl + lineages")
+    ap.add_argument("--slot", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--control-port", type=int, default=0)
+    ap.add_argument("--interval", type=float, default=0.25,
+                    help="this worker's time-series sampling interval")
+    ap.add_argument("--unit-delay", type=float, default=0.0,
+                    help="synthetic straggler seconds per budget unit "
+                         "(chaos tests)")
+    args = ap.parse_args(argv)
+    with open(os.path.join(args.workdir, "spec.pkl"), "rb") as f:
+        spec = pickle.load(f)
+    w = TrialWorker(spec, args.slot, host=args.host,
+                    control_port=args.control_port,
+                    interval=args.interval, unit_delay=args.unit_delay)
+    print(json.dumps({"control": w.control_port}), flush=True)
+    try:
+        threading.Event().wait()     # serve until killed
+    except KeyboardInterrupt:
+        pass
+    w.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,8 @@ from typing import Sequence
 import numpy as np
 
 from ..core.dataframe import DataFrame
-from ..core.params import (ComplexParam, HasLabelCol, IntParam, StringParam)
+from ..core.params import (ComplexParam, DictParam, HasLabelCol, IntParam,
+                           StringParam)
 from ..core.pipeline import Estimator, Model, Transformer
 from ..core.schema import SchemaConstants, SparkSchema
 from . import metrics as M
@@ -100,6 +101,9 @@ class DefaultHyperparams:
         if "Perceptron" in name or "MLP" in name:
             return [("stepSize", RangeHyperParam(0.005, 0.1, log=True)),
                     ("maxIter", DiscreteHyperParam([20, 40]))]
+        if "TpuLearner" in name:
+            return [("learningRate", RangeHyperParam(0.005, 0.2, log=True)),
+                    ("batchSize", DiscreteHyperParam([8, 16, 32]))]
         return []
 
 
@@ -123,6 +127,41 @@ def _kfold_indices(n: int, k: int, seed: int):
     return np.array_split(perm, k)
 
 
+def _sample_candidates(models, num_runs: int, rng) -> list:
+    """Sample `num_runs` distinct settings per estimator.
+
+    A duplicate draw is resampled (not dropped) under a bounded retry
+    budget; small discrete spaces that genuinely hold fewer than
+    `num_runs` distinct settings warn once and yield what exists.
+    """
+    import logging
+
+    from .. import telemetry
+
+    candidates = []  # (estimator, setting)
+    for est in models:
+        dists = DefaultHyperparams.for_estimator(est)
+        space = RandomSpace(dists)
+        seen = set()
+        budget = 20 * num_runs
+        while len(seen) < num_runs and budget > 0:
+            budget -= 1
+            setting = space.sample(rng) if dists else {}
+            key = tuple(sorted(setting.items()))
+            if key in seen:
+                continue
+            seen.add(key)
+            candidates.append((est, setting))
+        if len(seen) < num_runs:
+            telemetry.warn_once(
+                logging.getLogger("mmlspark_tpu.automl"),
+                f"tune-space-exhausted:{type(est).__name__}",
+                "param space for %s yielded only %d distinct settings "
+                "(numRuns=%d); continuing with what exists",
+                type(est).__name__, len(seen), num_runs)
+    return candidates
+
+
 class TuneHyperparametersModel(Model):
     bestModel = ComplexParam("refit best model", default=None)
     bestMetric = ComplexParam("cv metric of the winner", default=None)
@@ -141,35 +180,40 @@ class TuneHyperparameters(Estimator, HasLabelCol):
     numRuns = IntParam("random settings sampled per estimator", default=8, min=1)
     parallelism = IntParam("thread-pool width", default=4, min=1)
     seed = IntParam("seed", default=0)
+    backend = StringParam("where trials run: 'local' thread pool or the "
+                          "supervised 'fleet' ASHA scheduler",
+                          default="local", choices=("local", "fleet"))
+    numWorkers = IntParam("fleet backend: concurrent trial workers",
+                          default=4, min=1)
+    asha = DictParam("fleet backend: successive-halving config "
+                     "({'eta':.., 'rungs':[..], 'spawn':bool})", default=None)
 
     def fit(self, df: DataFrame) -> TuneHyperparametersModel:
+        if self.getBackend() == "fleet":
+            from .trials import fit_fleet
+            return fit_fleet(self, df)
         metric = self.getEvaluationMetric()
         maximize = M.METRIC_MAXIMIZE[metric]
         rng = np.random.default_rng(self.getSeed())
         folds = _kfold_indices(df.count(), self.getNumFolds(), self.getSeed())
         label = self.getLabelCol()
 
-        candidates = []  # (estimator, setting)
-        for est in self.getModels():
-            dists = DefaultHyperparams.for_estimator(est)
-            space = RandomSpace(dists)
-            seen = set()
-            for _ in range(self.getNumRuns()):
-                setting = space.sample(rng) if dists else {}
-                key = tuple(sorted(setting.items()))
-                if key in seen:
-                    continue
-                seen.add(key)
-                candidates.append((est, setting))
+        candidates = _sample_candidates(self.getModels(), self.getNumRuns(),
+                                        rng)
 
-        mask_cache = {}
+        # fold masks are precomputed: eval_fold runs on a thread pool, and
+        # a dict populated from inside the workers would race
+        def _fold_masks(n):
+            masks = {}
+            for fi, val_idx in enumerate(folds):
+                m = np.zeros(n, dtype=bool)
+                m[val_idx] = True
+                masks[fi] = m
+            return masks
+
+        mask_cache = _fold_masks(df.count())
 
         def eval_fold(est, setting, fold_i):
-            val_idx = folds[fold_i]
-            if fold_i not in mask_cache:
-                m = np.zeros(df.count(), dtype=bool)
-                m[val_idx] = True
-                mask_cache[fold_i] = m
             val_mask = mask_cache[fold_i]
             train = df.filter(~val_mask)
             val = df.filter(val_mask)
@@ -199,7 +243,7 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                 folds = _kfold_indices(gathered.count(), self.getNumFolds(),
                                        self.getSeed())
                 df = gathered
-                mask_cache.clear()
+                mask_cache = _fold_masks(df.count())
             else:
                 # a PLAIN frame on a fleet is ambiguous: the SPMD
                 # convention reads it as this-process's shard, but local
@@ -218,7 +262,7 @@ class TuneHyperparameters(Estimator, HasLabelCol):
                                            self.getNumFolds(),
                                            self.getSeed())
                     df = gathered
-                    mask_cache.clear()
+                    mask_cache = _fold_masks(df.count())
             mine = [j for j in range(len(jobs))
                     if j % nproc == jax.process_index()]
             with meshlib.local_fit_mode(), ThreadPoolExecutor(width) as pool:

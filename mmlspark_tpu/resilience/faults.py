@@ -38,7 +38,8 @@ Registered sites (see docs/reliability.md): ``fleet.poll``,
 ``elastic.remesh``, ``elastic.evict``, ``autoscale.verdict``,
 ``distributed.rendezvous``, ``distributed.lease``, ``ckpt.write``,
 ``ckpt.rename``, ``ckpt.shard``, ``downloader.fetch``,
-``codegen.write``, ``federation.scrape``, ``federation.merge``.
+``codegen.write``, ``federation.scrape``, ``federation.merge``,
+``automl.trial``, ``automl.promote``, ``automl.report``.
 """
 
 from __future__ import annotations
@@ -75,7 +76,8 @@ SITES = ("fleet.poll", "fleet.respond", "fleet.transform",
          "elastic.evict", "autoscale.verdict",
          "distributed.rendezvous", "distributed.lease", "ckpt.write",
          "ckpt.rename", "ckpt.shard", "downloader.fetch",
-         "codegen.write", "federation.scrape", "federation.merge")
+         "codegen.write", "federation.scrape", "federation.merge",
+         "automl.trial", "automl.promote", "automl.report")
 
 
 class InjectedFault(ConnectionError):

@@ -99,6 +99,10 @@ GAUGE_POLICIES = {
     "mmlspark_profiler_bytes_per_call": "max",
     "mmlspark_profiler_achieved_flops": "max",
     "mmlspark_profiler_roofline_utilization": "max",
+    "mmlspark_tune_rung_metric": "last",
+    "mmlspark_tune_trial_rung": "max",
+    "mmlspark_tune_trial_progress": "max",
+    "mmlspark_tune_active_trials": "last",
 }
 
 _m_scrapes = REGISTRY.counter(

@@ -462,3 +462,114 @@ class TestNaiveBayesParity:
         a = np.stack(list(fitted.transform(df).col("probability")))
         b = np.stack(list(legacy.transform(df).col("probability")))
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestSearchSpaceDeterminism:
+    """Satellite pins: grid enumeration order, argmax tie-breaking,
+    metric orientation, the RandomSpace duplicate-resample fix, and the
+    precomputed-fold-mask thread-safety fix."""
+
+    def test_grid_enumeration_matches_product_order(self):
+        import itertools
+
+        from mmlspark_tpu.automl import DiscreteHyperParam, GridSpace
+        grid = GridSpace([("a", DiscreteHyperParam([1, 2])),
+                          ("b", DiscreteHyperParam(["x", "y", "z"]))])
+        got = list(grid.settings())
+        want = [{"a": a, "b": b}
+                for a, b in itertools.product([1, 2], ["x", "y", "z"])]
+        assert got == want          # first-declared param varies slowest
+        assert got == list(grid.settings())   # re-enumeration identical
+
+    def test_random_space_resamples_duplicates(self, monkeypatch):
+        """A duplicate draw is RESAMPLED, not silently collapsed: a space
+        with enough distinct settings must yield exactly numRuns of them."""
+        from mmlspark_tpu.automl import DiscreteHyperParam
+        from mmlspark_tpu.automl.tune import (DefaultHyperparams,
+                                              _sample_candidates)
+        monkeypatch.setattr(
+            DefaultHyperparams, "for_estimator",
+            staticmethod(lambda est: [("k", DiscreteHyperParam(
+                [0, 1, 2, 3]))]))
+        rng = np.random.default_rng(0)
+        got = _sample_candidates([LogisticRegression()], 4, rng)
+        assert sorted(s["k"] for _, s in got) == [0, 1, 2, 3]
+
+    def test_random_space_exhaustion_yields_what_exists(self, monkeypatch):
+        from mmlspark_tpu.automl import DiscreteHyperParam
+        from mmlspark_tpu.automl.tune import (DefaultHyperparams,
+                                              _sample_candidates)
+        monkeypatch.setattr(
+            DefaultHyperparams, "for_estimator",
+            staticmethod(lambda est: [("k", DiscreteHyperParam([0, 1]))]))
+        rng = np.random.default_rng(0)
+        got = _sample_candidates([LogisticRegression()], 5, rng)
+        assert sorted(s["k"] for _, s in got) == [0, 1]   # no duplicates
+
+    def test_find_best_model_tie_breaks_first(self):
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+        m = LogisticRegression().setMaxIter(40).fit(df)
+        best = (FindBestModel().setModels((m, m))
+                .setEvaluationMetric("accuracy").fit(df))
+        assert best.getBestModel() is m
+        names = [n for n, _ in best.getAllModelMetrics()]
+        assert names == ["LogisticRegressionModel"] * 2
+
+    def test_find_best_model_minimizes_regression_metrics(self):
+        rng = np.random.default_rng(5)
+        x = rng.normal(size=(120, 4))
+        y = x @ np.array([1.0, -2.0, 0.5, 0.0]) + rng.normal(
+            scale=0.05, size=120)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y})
+        good = LinearRegression().fit(df)
+        bad = DecisionTreeRegressor().setMaxDepth(1).fit(df)
+        best = (FindBestModel().setModels((bad, good))
+                .setEvaluationMetric("rmse").fit(df))
+        assert best.getBestModel() is good     # LOWER rmse wins
+        metrics = dict(best.getAllModelMetrics())
+        bad_name = type(bad).__name__
+        assert metrics["LinearRegressionModel"] < metrics[bad_name]
+
+    def test_tuned_model_transform_round_trip(self):
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+        tuned = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(20),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(2).setSeed(1).fit(df))
+        via_tuned = tuned.transform(df)
+        via_best = tuned.getBestModel().transform(df)
+        assert via_tuned.columns == via_best.columns
+        np.testing.assert_array_equal(via_tuned.col("prediction"),
+                                      via_best.col("prediction"))
+
+    def test_parallel_tune_matches_serial(self):
+        """Fold masks are precomputed before the pool fans out; thread
+        scheduling must not change the search result."""
+        x, y = load_breast_cancer(return_X_y=True)
+        feats = np.empty(len(x), dtype=object)
+        for i in range(len(x)):
+            feats[i] = x[i, :10].astype(np.float32)
+        df = DataFrame({"features": feats, "label": y.astype(np.int64)})
+
+        def run(width):
+            t = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(20),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(4).setSeed(7)
+                 .setParallelism(width).fit(df))
+            return t.getBestMetric(), t.getBestSetting()
+
+        serial, wide = run(1), run(4)
+        assert serial[0] == wide[0]
+        assert serial[1] == wide[1]

@@ -1,0 +1,340 @@
+"""Fleet trial scheduling: the order-independent ASHA core
+(TrialScheduler), the three automl chaos sites, the in-process fleet
+tuner e2e, rolling-MAD straggler eviction, and the subprocess kill -9
+determinism e2e — a leading trial killed mid-rung respawns into the
+SAME checkpoint lineage, resumes from the consensus (epoch, step), and
+the final best setting is identical to an undisturbed run."""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_breast_cancer
+
+from mmlspark_tpu import DataFrame, telemetry
+from mmlspark_tpu.automl import TuneHyperparameters
+from mmlspark_tpu.automl.scheduler import (DONE, PAUSED, PENDING, RUNNING,
+                                           STOPPED, TrialScheduler)
+from mmlspark_tpu.models import LogisticRegression
+from mmlspark_tpu.models.trainer import TpuLearner
+from mmlspark_tpu.resilience import faults
+
+
+@pytest.fixture
+def tel():
+    telemetry.enable()
+    telemetry.registry.reset()
+    yield telemetry
+    telemetry.disable()
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faults.clear()
+
+
+def _counter_total(name):
+    snap = telemetry.snapshot()
+    return sum(s["value"] for s in snap.get(name, {}).get("series", []))
+
+
+def _cancer_df():
+    x, y = load_breast_cancer(return_X_y=True)
+    feats = np.empty(len(x), dtype=object)
+    for i in range(len(x)):
+        feats[i] = x[i, :10].astype(np.float32)
+    return DataFrame({"features": feats, "label": y.astype(np.int64)})
+
+
+def _toy_df(n=64):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 4)).astype(np.float32)
+    y = (x[:, 0] > 0).astype(np.int64)
+    feats = np.empty(n, dtype=object)
+    for i in range(n):
+        feats[i] = x[i]
+    return DataFrame({"features": feats, "label": y})
+
+
+# --------------------------------------------------- the ASHA decision core
+
+def _drain(sched):
+    """Assign-and-report until the schedule settles; values are a fixed
+    function of (trial, rung) so every drain of the same scheduler config
+    is comparable. Returns {trial: deepest_rung_reported}."""
+    depth = {}
+    while not sched.finished():
+        work = sched.next_work()
+        if work is None:
+            break
+        t, r = work["trial"], work["rung"]
+        sched.report(t, r, 10.0 * t + r)
+        depth[t] = max(depth.get(t, -1), r)
+    return depth
+
+
+class TestTrialScheduler:
+    def test_rejects_bad_config(self):
+        with pytest.raises(ValueError):
+            TrialScheduler([1], rungs=[])
+        with pytest.raises(ValueError):
+            TrialScheduler([1], rungs=[4, 2])
+        with pytest.raises(ValueError):
+            TrialScheduler([1], rungs=[1, 2], eta=1)
+        with pytest.raises(ValueError):
+            TrialScheduler([], rungs=[1, 2])
+
+    def test_population_never_below_one(self):
+        s = TrialScheduler(list(range(5)), rungs=[1, 2, 4], eta=3)
+        assert [s.population(r) for r in range(4)] == [5, 1, 1, 1]
+
+    def test_promotes_exactly_top_eta_fraction(self):
+        n, eta = 9, 3
+        s = TrialScheduler(list(range(n)), rungs=[1, 2], eta=eta)
+        for t in range(n):
+            s.report(t, 0, float(t))           # trial 8 is best
+        promoted = []
+        while True:
+            w = s.next_work()
+            if w is None or w["rung"] == 0:
+                break
+            promoted.append(w["trial"])
+            s.report(w["trial"], 1, float(w["trial"]))
+        assert sorted(promoted) == [6, 7, 8]   # n/eta survivors, the top 3
+        stopped = [t.id for t in s.trials if t.status == STOPPED]
+        assert sorted(stopped) == [0, 1, 2, 3, 4, 5]
+
+    def test_verdict_is_order_independent(self):
+        """The chaos-determinism keystone: any permutation of report
+        arrival yields the same final best and the same settle counts."""
+        import random
+        outcomes = set()
+        for seed in range(12):
+            s = TrialScheduler(list(range(9)), rungs=[1, 2, 4], eta=3)
+            rng = random.Random(seed)
+            pending = [(t, 0) for t in range(9)]
+            while pending:
+                rng.shuffle(pending)
+                t, r = pending.pop()
+                # fixed metric per (trial, rung): arrival order is the
+                # only thing that varies across seeds
+                s.report(t, r, 10.0 * t + r)
+                while True:
+                    w = s.next_work()
+                    if w is None:
+                        break
+                    pending.append((w["trial"], w["rung"]))
+            assert s.finished()
+            outcomes.add((s.best(), tuple(sorted(s.counts().items()))))
+        assert len(outcomes) == 1, f"schedule depended on order: {outcomes}"
+
+    def test_ties_break_by_lower_id(self):
+        s = TrialScheduler(list(range(4)), rungs=[1, 2], eta=2)
+        for t in range(4):
+            s.report(t, 0, 1.0)                # all equal
+        winners = set()
+        for _ in range(2):                     # n_1 = 2 promote
+            w = s.next_work()
+            winners.add(w["trial"])
+        assert winners == {0, 1}
+
+    def test_minimize_metric(self):
+        s = TrialScheduler(list(range(4)), rungs=[1, 2], eta=2,
+                           maximize=False)
+        for t in range(4):
+            s.report(t, 0, float(t))           # lower is better
+        winners = {s.next_work()["trial"] for _ in range(2)}
+        assert winners == {0, 1}
+
+    def test_report_is_idempotent(self):
+        s = TrialScheduler(list(range(2)), rungs=[1, 2], eta=2)
+        s.report(0, 0, 5.0)
+        s.report(0, 0, 99.0)                   # a respawn re-reporting
+        assert s.trials[0].values[0] == 5.0
+
+    def test_early_leader_promotes_before_rung_completes(self):
+        """A trial that provably belongs to the top n/eta promotes while
+        peers are still running — ASHA stays asynchronous."""
+        s = TrialScheduler(list(range(9)), rungs=[1, 2], eta=3)
+        for t in range(7):                     # 2 reports still missing
+            s.report(t, 0, float(t))
+        w = s.next_work()
+        # trial 6 beat 6 peers >= n_0 - n_1 = 6: promotable regardless
+        # of what trials 7 and 8 eventually report
+        assert w == {"trial": 6, "rung": 1, "budget": 2}
+
+    def test_assignment_reissues_running_trial(self):
+        s = TrialScheduler(list(range(2)), rungs=[3, 9], eta=2)
+        w = s.next_work()
+        assert s.assignment(w["trial"]) == w
+        with pytest.raises(ValueError):
+            s.assignment(1)                    # still pending, not running
+
+    def test_single_candidate_runs_every_rung(self):
+        s = TrialScheduler([0], rungs=[1, 2, 4], eta=3)
+        depth = _drain(s)
+        assert s.finished()
+        assert depth == {0: 2}
+        assert s.counts() == {DONE: 1}
+        assert s.best() == (0, 2, 2.0)
+
+    def test_drain_settles_every_trial(self):
+        s = TrialScheduler(list(range(10)), rungs=[2, 4, 8], eta=3)
+        _drain(s)
+        assert s.finished()
+        c = s.counts()
+        assert c.get(RUNNING, 0) == 0 and c.get(PENDING, 0) == 0
+        assert c.get(PAUSED, 0) == 0
+        assert c[DONE] >= 1
+
+
+# ------------------------------------------------------- automl chaos sites
+
+class TestAutomlChaosSites:
+    def test_promote_fault_one_shot_skips_decision_round(self, tel):
+        faults.configure("automl.promote:error:1.0:0:1")
+        s = TrialScheduler(list(range(4)), rungs=[1, 2], eta=2)
+        for t in range(4):
+            s.report(t, 0, float(t))
+        # the faulted round skips the promotion scan (counted), leaving
+        # the reported set intact; the next round re-decides correctly
+        assert s.next_work() is None
+        assert s.promote_skips == 1
+        assert _counter_total("mmlspark_tune_promote_faults_total") == 1
+        assert s.next_work()["trial"] == 3
+
+    def test_trial_fault_one_shot_absorbed_by_retry(self, tel):
+        faults.configure("automl.trial:error:1.0:0:1")
+        model = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(5),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(2).setSeed(0)
+                 .setBackend("fleet").setNumWorkers(2)
+                 .setAsha({"eta": 2, "rungs": [2, 4], "max_seconds": 120})
+                 .fit(_cancer_df()))
+        # tiny maxIter budgets: the point is the schedule SURVIVED the
+        # injected fault (retried in place), not model quality
+        assert "regParam" in model.getBestSetting()
+        snap = faults.snapshot()["automl.trial"][0]
+        assert snap["injected"] == 1
+
+    def test_report_fault_one_shot_retried_idempotently(self, tel):
+        faults.configure("automl.report:error:1.0:0:1")
+        model = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(5),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(2).setSeed(0)
+                 .setBackend("fleet").setNumWorkers(2)
+                 .setAsha({"eta": 2, "rungs": [2, 4], "max_seconds": 120})
+                 .fit(_cancer_df()))
+        assert "regParam" in model.getBestSetting()
+        snap = faults.snapshot()["automl.report"][0]
+        assert snap["injected"] == 1
+
+
+# -------------------------------------------------- in-process fleet tuning
+
+class TestFleetTuneInProcess:
+    def test_fleet_backend_returns_tuned_model(self, tel):
+        df = _cancer_df()
+        model = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(10),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(6).setSeed(3)
+                 .setBackend("fleet").setNumWorkers(3)
+                 .setAsha({"eta": 2, "rungs": [2, 4, 8],
+                           "max_seconds": 180})
+                 .fit(df))
+        assert model.getBestMetric() > 0.8
+        assert "regParam" in model.getBestSetting()
+        out = model.transform(df)
+        assert "prediction" in out.columns
+        # the schedule actually halved: some trials were early-stopped
+        assert _counter_total("mmlspark_tune_stops_total") >= 1
+        assert _counter_total("mmlspark_tune_promotions_total") >= 1
+
+    def test_straggler_evicted_at_rung_boundary(self, tel):
+        """Slot 0 runs every budget unit 2s slower than the fleet; the
+        rolling-MAD detector flags it, the driver evicts it once idle,
+        the supervisor respawns the slot clean, and the search still
+        converges."""
+        evicted_while_assigned = []
+
+        def on_round(ctx):
+            for slot, a in ctx["assigned"].items():
+                if not ctx["fleet"].workers[slot].alive:
+                    evicted_while_assigned.append((slot, a["trial"]))
+
+        model = (TuneHyperparameters()
+                 .setModels((LogisticRegression().setMaxIter(10),))
+                 .setEvaluationMetric("accuracy")
+                 .setNumFolds(3).setNumRuns(10).setSeed(3)
+                 .setBackend("fleet").setNumWorkers(3)
+                 .setAsha({"eta": 2, "rungs": [1, 2], "max_seconds": 180,
+                           "unit_delays": {0: 2.0, 1: 0.4, 2: 0.4},
+                           "evict_after": 2, "_on_round": on_round})
+                 .fit(_cancer_df()))
+        assert model.getBestMetric() > 0.8
+        assert _counter_total("mmlspark_tune_evictions_total") >= 1
+        # eviction only ever fires on an IDLE slot — no running trial is
+        # torn down mid-chunk by the straggler policy
+        assert not evicted_while_assigned
+
+
+# ------------------------------------- subprocess kill -9 determinism e2e
+
+def _fleet_tpu_tuner(workdir, on_round=None):
+    asha = {"eta": 2, "rungs": [1, 2], "spawn": True, "workdir": workdir,
+            "max_seconds": 300}
+    if on_round is not None:
+        asha["_on_round"] = on_round
+    learner = (TpuLearner()
+               .setModelConfig({"type": "mlp", "hidden": [4],
+                                "num_classes": 2})
+               .setBatchSize(8).setLearningRate(0.05).setDeviceDataCap(1))
+    return (TuneHyperparameters().setModels((learner,))
+            .setEvaluationMetric("accuracy").setNumFolds(4).setNumRuns(2)
+            .setSeed(0).setBackend("fleet").setNumWorkers(2).setAsha(asha))
+
+
+class TestFleetKillDeterminism:
+    def test_kill9_mid_rung_resumes_lineage_same_best(self, tel, tmp_path):
+        """The acceptance chaos e2e: kill -9 the worker running a
+        promoted (leading) trial mid-rung; the supervisor respawns the
+        slot, the driver re-hands it the SAME assignment, the fit
+        resumes from the lineage's consensus (epoch, step) checkpoint,
+        and the final best setting/metric equal an undisturbed run."""
+        df = _toy_df()
+        base = _fleet_tpu_tuner(str(tmp_path / "base")).fit(df)
+
+        state = {"killed": None, "resumes": None}
+
+        def on_round(ctx):
+            if state["killed"] is None:
+                for slot, a in ctx["assigned"].items():
+                    if a["rung"] >= 1:       # a promoted trial, mid-rung
+                        w = ctx["fleet"].workers[slot]
+                        if w.proc is not None and w.proc.poll() is None:
+                            os.kill(w.proc.pid, signal.SIGKILL)
+                            state["killed"] = (slot, a["trial"])
+                        return
+            state["resumes"] = ctx["sampler"].value_at(
+                "mmlspark_tune_resumes_total", time.time())
+
+        chaos = _fleet_tpu_tuner(str(tmp_path / "chaos"),
+                                 on_round=on_round).fit(df)
+
+        assert state["killed"] is not None, "no promoted trial was killed"
+        # the respawned slot resumed an existing checkpoint lineage
+        # (replays only) rather than fitting from scratch
+        assert state["resumes"] is not None and state["resumes"] >= 1
+        slot, trial = state["killed"]
+        lineage = tmp_path / "chaos" / "trials" / f"t{trial:04d}"
+        assert lineage.is_dir()
+        # determinism: the disturbed schedule converges to the identical
+        # winner with the identical cross-validated metric
+        assert chaos.getBestSetting() == base.getBestSetting()
+        assert chaos.getBestMetric() == base.getBestMetric()
