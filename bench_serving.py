@@ -370,30 +370,49 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
                           "rate": rate, **results["polling"]}))
 
     if "continuous" in engines:
+        import urllib.request
         from mmlspark_tpu import telemetry
         from mmlspark_tpu.telemetry.federation import (FederatedSampler,
                                                        FleetScraper)
+        from mmlspark_tpu.telemetry.timeseries import \
+            percentile_from_buckets
         step = FusedServingStep(cfg, params,
                                 policy=BucketPolicy(max_batch=max_batch),
                                 row_shape=(32, 32, 3),
                                 in_dtype=np.uint8, output="argmax")
-        # fleet-view vs driver-view: sample the server's own request
-        # histogram and scrape it back over HTTP, exactly the way fleet
-        # federation sees a worker — the divergence between the merged
-        # (server-side) percentiles and the client-observed ones is the
-        # part of latency the server never sees (connect + queueing in
-        # the kernel + bucket-grid quantization)
-        telemetry.timeseries.start(interval=0.25)
         source, loop = serve_continuous(step, max_wait=max_wait,
                                         max_queue_depth=max_queue_depth)
-        scraper = FleetScraper(
-            targets=[("serving", f"{source.url}timeseries")],
-            interval=0.25, sampler=FederatedSampler(interval=0.25))
         try:
+            for _ in range(4):      # compile + settle before either run
+                try:
+                    urllib.request.urlopen(
+                        urllib.request.Request(source.url, data=payload),
+                        timeout=30).read()
+                except Exception:
+                    pass
+            # attribution-off baseline: telemetry dark, tail sampling
+            # disarmed. Ledger stamping itself is always on, so the p50
+            # delta against the instrumented run below prices span
+            # emission + the phase histogram + tail sampling — the
+            # attribution overhead docs/observability.md budgets at
+            # <= 2% on p50.
+            base = run_open_loop(source.url, payload, schedule, deadline,
+                                 pool)
+            # fleet-view vs driver-view: sample the server's own request
+            # histogram and scrape it back over HTTP, exactly the way
+            # fleet federation sees a worker — the divergence between the
+            # merged (server-side) percentiles and the client-observed
+            # ones is the part of latency the server never sees (connect
+            # + queueing in the kernel + bucket-grid quantization)
+            telemetry.timeseries.start(interval=0.25)
+            telemetry.trace.enable_tail_sampling(quantile=0.95,
+                                                 max_retained=128)
+            scraper = FleetScraper(
+                targets=[("serving", f"{source.url}timeseries")],
+                interval=0.25, sampler=FederatedSampler(interval=0.25))
             scraper.scrape_once()   # seed round: baselines, zero deltas
-            results["continuous"] = run_open_loop(source.url, payload,
-                                                  schedule, deadline,
-                                                  pool)
+            cont = results["continuous"] = run_open_loop(
+                source.url, payload, schedule, deadline, pool)
             time.sleep(0.6)         # let the sampler tick the last rows
             scraper.scrape_once()
             for q, label in ((0.50, "p50"), (0.99, "p99")):
@@ -401,9 +420,55 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
                     "serving", "mmlspark_http_request_seconds", q,
                     window=duration + 120.0)
                 if p is not None:
-                    results["continuous"][f"fleet_{label}_ms"] = round(
-                        p * 1e3, 1)
+                    cont[f"fleet_{label}_ms"] = round(p * 1e3, 1)
+            if base["p50_ms"] > 0:
+                cont["attribution_overhead_pct"] = round(
+                    (cont["p50_ms"] - base["p50_ms"])
+                    / base["p50_ms"] * 100.0, 2)
+            # per-phase breakdown from the ledger-fed histogram: the
+            # instrumented run is the only traffic since telemetry came
+            # up, so cumulative bucket counts ARE the run's deltas
+            snap = telemetry.registry.snapshot()
+            fam = snap.get("mmlspark_serving_phase_seconds", {})
+            for s in fam.get("series", []):
+                phase = s.get("labels", {}).get("phase")
+                if phase not in ("queue", "pad", "device", "readback"):
+                    continue
+                for q, label in ((0.50, "p50"), (0.99, "p99")):
+                    p = percentile_from_buckets(s["buckets"], q)
+                    if p is not None:
+                        cont[f"phase_{phase}_{label}_ms"] = round(
+                            p * 1e3, 2)
+            # the ledger phases partition each request, so their _sum
+            # totals reconcile with the server-observed request-latency
+            # _sum (ratio < 1: the slice after "reply" — the reply-write
+            # syscall — is the only part the ledger never sees)
+            phase_sum = sum(s.get("sum", 0.0)
+                            for s in fam.get("series", []))
+            req_sum = sum(
+                s.get("sum", 0.0)
+                for s in snap.get("mmlspark_http_request_seconds",
+                                  {}).get("series", []))
+            if req_sum > 0:
+                cont["phase_sum_ratio"] = round(phase_sum / req_sum, 3)
+            cont["exemplar_linked"] = int(
+                ' # {trace_id="' in scraper.sampler.prometheus_text())
+            fetched = 0
+            for tid in reversed(telemetry.trace.retained_ids()):
+                try:
+                    with urllib.request.urlopen(
+                            f"{source.url}debug/trace/{tid}",
+                            timeout=5) as r:
+                        fetched = int(r.status == 200
+                                      and bool(json.loads(r.read())
+                                               .get("events")))
+                    break
+                except Exception:
+                    continue
+            cont["trace_fetch_ok"] = fetched
         finally:
+            telemetry.trace.disable_tail_sampling()
+            telemetry.timeseries.stop()
             loop.stop()
             source.close()
         print(json.dumps({"engine": "continuous", "arrival": arrival,
@@ -435,6 +500,31 @@ def open_loop_main(rate: float, duration: float, arrival: str = "poisson",
                  "value": round(cont[f"{q}_ms"] - cont[f"fleet_{q}_ms"],
                                 1),
                  "unit": "ms", "arrival": arrival, "rate": rate})
+        for phase in ("queue", "pad", "device", "readback"):
+            for q in ("p50", "p99"):
+                key = f"phase_{phase}_{q}_ms"
+                if key in cont:
+                    metrics.append(
+                        {"metric": f"serving_open_loop_{key}",
+                         "value": cont[key], "unit": "ms",
+                         "arrival": arrival, "rate": rate})
+        if "phase_sum_ratio" in cont:
+            metrics.append({"metric": "serving_open_loop_phase_sum_ratio",
+                            "value": cont["phase_sum_ratio"],
+                            "unit": "ratio", "arrival": arrival,
+                            "rate": rate})
+        if "attribution_overhead_pct" in cont:
+            ov = cont["attribution_overhead_pct"]
+            metrics.append(
+                {"metric": "serving_open_loop_attribution_overhead_pct",
+                 "value": ov, "unit": "%", "budget_pct": 2.0,
+                 "ok": bool(ov <= 2.0), "arrival": arrival,
+                 "rate": rate})
+        for key in ("exemplar_linked", "trace_fetch_ok"):
+            if key in cont:
+                metrics.append({"metric": f"serving_open_loop_{key}",
+                                "value": cont[key], "unit": "bool",
+                                "arrival": arrival, "rate": rate})
     if poll:
         metrics.append({"metric": "serving_open_loop_polling_goodput_rps",
                         "value": poll["goodput_rps"], "unit": "req/s",

@@ -16,6 +16,11 @@
   ``telemetry/federation.py`` in BOTH directions — the merge the
   federated sampler performs and the merge the docs promise must be the
   same merge.
+* ``exemplar-coverage`` — a histogram the catalogue marks
+  exemplar-bearing (Type cell ``histogram (exemplars)``) must pass an
+  ``exemplar=`` at every ``observe`` site: a latency histogram that
+  promises trace links but observes without one has buckets that can
+  never name the request that filled them.
 * ``fault-site`` — every ``faults.inject("<site>")`` call site must name
   a site registered in ``resilience/faults.py``'s ``SITES`` tuple, and
   every registered site must have at least one injection call — a chaos
@@ -320,16 +325,21 @@ def check_metric_aggregation(project: Project) -> Iterable[Finding]:
                      "histograms `histogram`, gauges `sum`/`max`/`last`",
                 context="<doc>", code=first)
             continue
-        expected = {"counter": "sum", "histogram": "histogram"}.get(typ)
+        # the Type cell may carry qualifiers after the kind — e.g.
+        # `histogram (exemplars)` for exemplar-bearing latency histograms
+        # (the exemplar-coverage rule keys off that marker); only the
+        # leading word is the metric kind
+        kind = typ.split()[0] if typ.split() else ""
+        expected = {"counter": "sum", "histogram": "histogram"}.get(kind)
         if expected is not None and agg != expected:
             yield Finding(
                 rule="metric-aggregation", path=rel_doc, line=ln,
                 message=f"catalogue row for `{first}` ({typ}) declares "
-                        f"Aggregation `{agg}` but every {typ} merges as "
+                        f"Aggregation `{agg}` but every {kind} merges as "
                         f"`{expected}` across the fleet",
                 hint=f"set the cell to `{expected}`",
                 context="<doc>", code=first)
-        if typ == "gauge":
+        if kind == "gauge":
             for n in names:
                 base = n[:-6] if n.endswith("_total") else n
                 documented_gauges[base] = agg
@@ -354,6 +364,79 @@ def check_metric_aggregation(project: Project) -> Iterable[Finding]:
                 f"documents it — stale policy entry or renamed metric",
                 hint="drop the entry or fix the catalogue row",
                 context="GAUGE_POLICIES")
+            if f:
+                yield f
+
+
+@rule("exemplar-coverage", "consistency",
+      "histograms the catalogue marks exemplar-bearing (`histogram "
+      "(exemplars)` Type cell) must pass an exemplar at every observe "
+      "site", scope="project")
+def check_exemplar_coverage(project: Project) -> Iterable[Finding]:
+    doc = _doc_path(project)
+    if doc is None:
+        return
+    with open(doc, "r", encoding="utf-8") as fh:
+        doc_text = fh.read()
+    marked: set[str] = set()
+    for names, typ, _agg, _ln in _doc_metric_rows(doc_text):
+        if "exemplar" in typ.lower():
+            marked |= names
+    if not marked:
+        return
+    # handle name -> metric name, project-wide: the registration handle
+    # (`_m_req_latency = telemetry.registry.histogram("...")`) is how
+    # observe sites name the metric, including across module imports
+    handles: dict[str, str] = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            if not (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "histogram"):
+                continue
+            recv = dotted(call.func.value)
+            if recv is None or recv.rsplit(".", 1)[-1] \
+                    not in _REG_RECEIVERS:
+                continue
+            if not (call.args and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, str)
+                    and call.args[0].value in marked):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    handles[t.id] = call.args[0].value
+                elif isinstance(t, ast.Attribute):
+                    handles[t.attr] = call.args[0].value
+    if not handles:
+        return
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "observe"):
+                continue
+            recv = dotted(node.func.value)
+            term = recv.rsplit(".", 1)[-1] if recv else None
+            if term not in handles:
+                continue
+            has_exemplar = len(node.args) >= 2 or any(
+                kw.arg == "exemplar" for kw in node.keywords)
+            if has_exemplar:
+                continue
+            f = sf.finding(
+                "exemplar-coverage", node,
+                f"histogram `{handles[term]}` is catalogued as "
+                f"exemplar-bearing but this observe() passes no exemplar "
+                f"— observations through this site can never link their "
+                f"bucket to a trace",
+                hint="pass exemplar=<trace_id or None> at every observe "
+                     "site of an exemplar-marked histogram (None when "
+                     "tail sampling retained nothing)",
+                context=term)
             if f:
                 yield f
 

@@ -69,6 +69,11 @@ _m_workers_added = telemetry.registry.counter(
 _m_workers_retired = telemetry.registry.counter(
     "mmlspark_fleet_workers_retired",
     "workers retired after a graceful drain (zero parked rows/replies)")
+_m_trace_collect_failures = telemetry.registry.counter(
+    "mmlspark_fleet_trace_collect_failures",
+    "worker trace fetches that failed during cross-process collection "
+    "(GET /trace over the control plane) — the merged trace is missing "
+    "that worker's spans")
 
 
 class _Worker:
@@ -569,6 +574,19 @@ class ProcessHTTPSource:
             # reply buffered, a child of the worker's ingress span
             telemetry.trace.complete("fleet/request", tr[1], parent=tr[0],
                                      code=int(code), worker=wi)
+            # driver-side tail verdict: the driver's own spans for this
+            # request retain when it erred or its worker is skew-flagged
+            # by the federation scraper (the worker's verdict is its own;
+            # both halves must survive for the merged /debug/trace tree)
+            tid = telemetry.context.trace_id_of(tr[0])
+            if tid:
+                fed = self.federation
+                flagged = bool(fed is not None
+                               and wi in getattr(fed, "_skewed", ()))
+                latency = (time.perf_counter_ns() - tr[1]) / 1e9
+                telemetry.trace.tail_complete(
+                    tid, latency_s=latency, error=int(code) >= 500,
+                    flagged=flagged)
 
     def flush(self) -> None:
         with self._lock:
@@ -607,18 +625,20 @@ class ProcessHTTPSource:
                                 "healthy; %d replies re-buffered for the "
                                 "next flush): %s", wi, len(replies), e)
 
-    def collect_traces(self, out_dir: str) -> list[str]:
+    def collect_traces(self, out_dir: str, unpin: bool = True) -> list[str]:
         """Write one Chrome-trace file per fleet process — this driver's
         span buffer plus every live worker's, fetched over the control
         channel (``GET /trace``; workers die by SIGKILL, so collection
         can't wait for a clean exit) — and return the paths. Feed them to
         :func:`mmlspark_tpu.telemetry.merge_traces` for the single
-        per-request tree."""
+        per-request tree. ``unpin=False`` keeps the driver's tail-retained
+        traces pinned (the read-only :meth:`debug_trace` path — its files
+        go to a scratch dir, so export must not count as delivery)."""
         import os
         os.makedirs(out_dir, exist_ok=True)
         paths = []
         driver = os.path.join(out_dir, f"trace_driver_{os.getpid()}.jsonl")
-        telemetry.trace.export_chrome_trace(driver)
+        telemetry.trace.export_chrome_trace(driver, unpin=unpin)
         paths.append(driver)
         for wi, w in enumerate(self.workers):
             if not w.alive:
@@ -633,6 +653,7 @@ class ProcessHTTPSource:
                         timeout=5.0) as r:
                     doc = json.loads(r.read())
             except Exception as e:
+                _m_trace_collect_failures.inc()
                 log.warning("worker %d trace collection failed: %s", wi, e)
                 continue
             path = os.path.join(
@@ -642,6 +663,21 @@ class ProcessHTTPSource:
                     f.write(json.dumps(ev) + "\n")
             paths.append(path)
         return paths
+
+    def debug_trace(self, trace_id: str):
+        """One request's merged cross-worker span tree, by trace id — the
+        fleet driver's ``GET /debug/trace/<id>`` backend. Collects every
+        live process's trace file into a scratch dir (read-only: retained
+        traces stay pinned), merges with
+        :func:`~mmlspark_tpu.telemetry.merge_traces` filtered to the id,
+        and returns the event list — ``None`` when no process knows the
+        trace (the endpoint's 404)."""
+        import tempfile
+        with tempfile.TemporaryDirectory(prefix="mmlspark-trace-") as d:
+            paths = self.collect_traces(d, unpin=False)
+            merged = telemetry.merge_traces(paths, trace_id=trace_id)
+        events = [e for e in merged if e.get("ph") != "M"]
+        return merged if events else None
 
     def killWorker(self, i: int) -> None:
         """Hard-kill one worker process (failure-injection hook; the
@@ -914,6 +950,9 @@ def serve_autoscaled(slo, transformer=None, bundle_dir: str = None,
                             name="fleet-driver", slo=slo)
         health.fleet_state = lambda: fleet_doc(source, autoscaler,
                                                reconciler, scraper)
+        # GET /debug/trace/<id> on the driver door: fan out to every live
+        # worker's tracer and merge that request's cross-process tree
+        health.fleet_trace = source.debug_trace
         if scraper is not None:
             health.fleet_metrics = scraper.sampler.prometheus_text
             health.fleet_timeseries = scraper.sampler.snapshot

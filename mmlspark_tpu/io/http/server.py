@@ -31,6 +31,7 @@ import numpy as np
 from ...core.dataframe import DataFrame
 from ...core.utils import get_logger, object_column
 from ... import telemetry
+from ...telemetry import ledger as ledgerlib
 from ...resilience import faults
 from ...resilience.policy import CircuitBreaker, RetryPolicy
 
@@ -55,6 +56,11 @@ _m_shed = telemetry.registry.counter(
     "mmlspark_http_shed_requests",
     "requests rejected with 503 + Retry-After by queue-depth load "
     "shedding (max_queue_depth exceeded)")
+_m_phase = telemetry.registry.histogram(
+    "mmlspark_serving_phase_seconds",
+    "per-request latency attribution: seconds spent in each phase-ledger "
+    "stage (queue/form/decode/dispatch/pad/device/readback/reply)",
+    labels=("phase",))
 
 
 class _BurstyHTTPServer(ThreadingHTTPServer):
@@ -86,7 +92,7 @@ class _Exchange:
     """One in-flight request awaiting a reply (the HttpExchange analog)."""
 
     __slots__ = ("id", "value", "event", "code", "body", "picked",
-                 "trace", "t0_ns")
+                 "trace", "t0_ns", "ledger")
 
     def __init__(self, value: str):
         self.id = uuid.uuid4().hex
@@ -97,6 +103,11 @@ class _Exchange:
         self.picked = False    # drained by getBatch (queue-depth bookkeeping)
         self.trace = None      # ingress-span traceparent (telemetry on only)
         self.t0_ns = time.perf_counter_ns()
+        # always-on phase ledger: every serving stage stamps the envelope
+        # as the request leaves it (admission is t0); the stamps become
+        # serve/phase spans + mmlspark_serving_phase_seconds observations
+        # at reply time, and sum to the client-observed request latency
+        self.ledger = ledgerlib.PhaseLedger(self.t0_ns)
 
 
 class HTTPSource:
@@ -138,6 +149,11 @@ class HTTPSource:
         # GET /timeseries?scope=fleet. Both stay None on workers.
         self.fleet_metrics = None
         self.fleet_timeseries = None
+        # driver-only cross-worker trace fetch: ``fleet_trace`` (trace_id
+        # -> merged event list or None) answers GET /debug/trace/<id> by
+        # collecting every live worker's spans; workers and single-process
+        # engines leave it None and serve their local tracer instead
+        self.fleet_trace = None
         # fleet-burn shed hint pushed by the driver's FleetScraper
         # (control POST /shed): while set, this door sheds with the
         # driver-computed burn-derived Retry-After — the engine runs on
@@ -192,6 +208,11 @@ class HTTPSource:
                             "http/shed", depth=source.max_queue_depth,
                             retry_after=retry_after,
                             draining=source._draining)
+                    if ctx is not None:
+                        # shed requests are tail-retention candidates by
+                        # definition: the verdict lands now, at completion
+                        telemetry.trace.tail_complete(ctx.trace_id,
+                                                      shed=True)
                     payload = (b'{"error": "draining, retry another '
                                b'replica"}' if source._draining else
                                b'{"error": "overloaded, retry later"}')
@@ -228,13 +249,27 @@ class HTTPSource:
                                 source._n_pending -= 1
                             _m_queue_depth.set(source._n_pending)
                         _m_replies.labels(code="504").inc()
+                        # a timed-out request is exactly the evidence the
+                        # tail sampler exists to keep
+                        telemetry.trace.tail_complete(
+                            telemetry.context.trace_id_of(ex.trace),
+                            latency_s=source.reply_timeout, error=True)
                         return
                     self.send_response(ex.code)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(ex.body)))
                     self.end_headers()
                     self.wfile.write(ex.body)
-                    _m_req_latency.observe(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    # request completion: the tail-retention verdict lands
+                    # here (slow >= quantile / errored => retained), and a
+                    # retained trace id rides the latency observation as
+                    # its bucket's OpenMetrics exemplar
+                    tid = telemetry.context.trace_id_of(ex.trace)
+                    retained = telemetry.trace.tail_complete(
+                        tid, latency_s=dt, error=ex.code >= 500)
+                    _m_req_latency.observe(
+                        dt, exemplar=tid if retained else None)
                     _m_replies.labels(code=str(ex.code)).inc()
 
             def do_GET(self):
@@ -278,6 +313,29 @@ class HTTPSource:
                     self.send_header(
                         "Content-Type",
                         "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif path.startswith("/debug/trace/"):
+                    # one request's span tree by trace id. On the fleet
+                    # driver (fleet_trace wired) the spans are collected
+                    # and merged across every live worker; elsewhere the
+                    # local tracer (ring + tail-retained store) answers.
+                    tid = path.rsplit("/", 1)[-1]
+                    if source.fleet_trace is not None:
+                        events = source.fleet_trace(tid)
+                    else:
+                        events = [
+                            e for e in telemetry.trace.events()
+                            if (e.get("args") or {}).get("trace_id") == tid]
+                    if not events:
+                        self.send_error(404, f"unknown trace {tid}")
+                        return
+                    payload = json.dumps(
+                        {"trace_id": tid,
+                         "events": events}).encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))
                     self.end_headers()
                     self.wfile.write(payload)
@@ -432,6 +490,7 @@ class HTTPSource:
                         ex.picked = True
                         self._n_pending -= 1
                 if alive:
+                    ex.ledger.mark("queue")   # queue-wait phase ends here
                     rows.append(ex)
         except queue.Empty:
             pass
@@ -463,12 +522,18 @@ class HTTPSource:
         if ex is None:
             log.warning("respond: unknown or timed-out exchange %s", ex_id)
             return
+        ex.ledger.mark("reply")   # reply computed; waiter released below
         if ex.trace is not None:
             # per-request processing hop: arrival -> reply computed, a
             # child of the ingress span (begin/end are on different
             # threads, so this is an explicit-duration event)
-            telemetry.trace.complete("serve/request", ex.t0_ns,
-                                     parent=ex.trace, code=int(code))
+            ctx = telemetry.trace.complete("serve/request", ex.t0_ns,
+                                           parent=ex.trace, code=int(code))
+            # the ledger becomes serve/phase child spans (their durations
+            # sum to the request latency) and phase-histogram points
+            ledgerlib.emit_phase_spans(telemetry.trace, ex.ledger,
+                                       ctx if ctx is not None else ex.trace)
+            ledgerlib.observe_phases(_m_phase, ex.ledger)
         ex.code = code
         ex.body = body.encode("utf-8") if isinstance(body, str) else body
         ex.event.set()

@@ -132,6 +132,20 @@ class WorkerServer:
                     self._json(200, {"events": telemetry.trace.events(),
                                      "dropped": telemetry.trace.dropped(),
                                      "pid": worker_pid})
+                elif self.path.startswith("/debug/trace/"):
+                    # one trace's spans from THIS worker's tracer (ring +
+                    # tail-retained store) — the driver's cross-worker
+                    # /debug/trace/<id> fans out to these and merges
+                    from ... import telemetry
+                    tid = self.path.rsplit("/", 1)[-1]
+                    events = [
+                        e for e in telemetry.trace.events()
+                        if (e.get("args") or {}).get("trace_id") == tid]
+                    if not events:
+                        self.send_error(404, f"unknown trace {tid}")
+                        return
+                    self._json(200, {"trace_id": tid, "events": events,
+                                     "pid": worker_pid})
                 elif self.path == "/timeseries":
                     # the worker's sampler rings: per-process metric
                     # history over the control plane (same payload as the

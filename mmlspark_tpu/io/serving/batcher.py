@@ -139,10 +139,21 @@ class ContinuousBatcher:
                 buf.extend(more)
         bucket = self.policy.bucket_for(len(buf))
         now_ns = time.perf_counter_ns()
+        # the batch is formed and its pad bucket chosen: stamp every
+        # member's phase ledger (deferred rows drained into a LATER batch
+        # get their form stamp then — their queue/form phases stay honest
+        # because the ledger clock is the arrival t0, never reset)
+        for ex in buf:
+            ex.ledger.mark("form", now_ns)
         _m_bucket_rows.observe(bucket)
         _m_occupancy.observe(len(buf) / bucket)
         _m_pad_waste.set((bucket - len(buf)) / bucket)
         if bucket > len(buf):
             _m_padded_rows.inc(bucket - len(buf))
-        _m_form_wait.observe(max(0.0, (now_ns - buf[0].t0_ns) / 1e9))
+        # batch_wait is a phase VIEW of the oldest member's ledger:
+        # admission -> form stamp, the same number the pre-ledger timer
+        # measured, now derived from the shared stamps
+        wait_s = buf[0].ledger.elapsed_s("form")
+        _m_form_wait.observe(max(0.0, wait_s if wait_s is not None
+                                 else (now_ns - buf[0].t0_ns) / 1e9))
         return buf, bucket

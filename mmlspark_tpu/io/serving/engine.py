@@ -93,14 +93,28 @@ class ContinuousServingLoop:
                         ex.id, 400, json.dumps({"error": f"bad payload: "
                                                          f"{e}"}))
             if keep:
+                now_ns = time.perf_counter_ns()
+                for ex in keep:
+                    ex.ledger.mark("decode", now_ns)
                 yield keep, np.stack(rows), bucket
 
     def _dispatch(self, exchanges, rows, bucket: int):
+        # dispatch-wait phase ends here: decode -> the consumer picked
+        # this bucket off the prefetch handoff and starts device work
+        now_ns = time.perf_counter_ns()
+        for ex in exchanges:
+            ex.ledger.mark("dispatch", now_ns)
+        ledgers = [ex.ledger for ex in exchanges]
+
         def attempt(_a):
             with telemetry.trace.span("serve/bucket",
                                       rows=len(exchanges), bucket=bucket):
                 faults.inject("serving.batch")
-                out = self.step.score_rows(rows, bucket)
+                if getattr(self.step, "accepts_ledgers", False):
+                    out = self.step.score_rows(rows, bucket,
+                                               ledgers=ledgers)
+                else:   # step doubles with the bare signature
+                    out = self.step.score_rows(rows, bucket)
                 for ex, y in zip(exchanges, out):
                     self.source.respond(ex.id, 200, self.step.encode(y))
         t0 = time.perf_counter()
@@ -109,7 +123,24 @@ class ContinuousServingLoop:
         except Exception as e:   # reply 500s, never hang clients
             self._fail(exchanges, e)
         finally:
-            _m_dispatch.observe(time.perf_counter() - t0)
+            # the dispatch timer is a phase VIEW of the ledger: pad start
+            # (device attempt began) -> reply encoded, read off the first
+            # exchange's stamps; wall clock only when the step double
+            # never stamped
+            led = exchanges[0].ledger.span_s("pad", "reply")
+            # exemplar: the first already-retained trace in this bucket
+            # (the retention verdict lands on the handler thread at reply
+            # write, so this is best-effort and absent for healthy traffic)
+            tid = None
+            if telemetry.enabled():
+                for ex in exchanges:
+                    t = telemetry.context.trace_id_of(ex.trace)
+                    if t and telemetry.trace.is_retained(t):
+                        tid = t
+                        break
+            _m_dispatch.observe(
+                led if led is not None else time.perf_counter() - t0,
+                exemplar=tid)
 
     def _run(self):
         from ...parallel import prefetch as prefetchlib

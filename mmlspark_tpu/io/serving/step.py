@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import base64
 import json
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -208,19 +209,44 @@ class FusedServingStep:
         return self._pf.compiles
 
     # ---- the hot path ----
-    def score_rows(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+    #: the engine may pass per-request phase ledgers (ledgers=) — step
+    #: doubles without this attribute get the bare two-arg call
+    accepts_ledgers = True
+
+    def score_rows(self, rows: np.ndarray, bucket: int,
+                   ledgers=None) -> np.ndarray:
         """(n, *row_shape) wire rows -> (n, ...) outputs via ONE padded
-        bucket dispatch."""
+        bucket dispatch. ``ledgers`` (one per row, from the serving
+        engine) get pad / device / readback phase stamps — the
+        ``block_until_ready`` between the device and readback stamps
+        splits device execution from the D2H copy but adds no wall time:
+        ``np.asarray`` would have blocked on the same dispatch anyway."""
         n = len(rows)
         xb = np.zeros((bucket,) + self.row_shape, self.in_dtype)
         xb[:n] = rows
+        if ledgers:
+            t = time.perf_counter_ns()
+            for led in ledgers:
+                led.mark("pad", t)
         if self._pf.is_cached(self._params_dev, xb):
             _m_cache_hits.inc()
         else:
             _m_cache_misses.inc()
             log.warning("serving bucket %d cold-compiled on live traffic "
                         "(warmup/bundle did not cover it)", bucket)
-        return np.asarray(self._pf(self._params_dev, xb))[:n]
+        y = self._pf(self._params_dev, xb)
+        if ledgers:
+            import jax
+            jax.block_until_ready(y)
+            t = time.perf_counter_ns()
+            for led in ledgers:
+                led.mark("device", t)
+        out = np.asarray(y)[:n]
+        if ledgers:
+            t = time.perf_counter_ns()
+            for led in ledgers:
+                led.mark("readback", t)
+        return out
 
     def __call__(self, values: list, bucket: Optional[int] = None) -> list:
         """Payload strings -> reply strings (decode -> pad -> one

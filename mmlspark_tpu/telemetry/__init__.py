@@ -31,6 +31,7 @@ from .registry import (DEFAULT_TIME_BUCKETS, REGISTRY, Counter, Gauge,
                        Histogram, MetricsRegistry, pow2_buckets, _state)
 from .tracer import TRACER, Tracer, merge_traces
 from . import context
+from . import ledger
 from . import profiler
 from . import slo
 from .flight import FLIGHT
@@ -44,7 +45,7 @@ timeseries = SAMPLER
 
 __all__ = ["registry", "trace", "enabled", "enable", "disable",
            "snapshot", "prometheus_text", "warn_once", "merge_traces",
-           "context", "profiler", "flight", "timeseries", "slo",
+           "context", "ledger", "profiler", "flight", "timeseries", "slo",
            "federation",
            "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
            "TimeSeriesSampler",

@@ -92,6 +92,19 @@ def parse_traceparent(header: Optional[str]) -> Optional[SpanContext]:
     return SpanContext(trace_id, span_id)
 
 
+def trace_id_of(ctx) -> Optional[str]:
+    """The 32-hex trace id of a :class:`SpanContext` or raw
+    ``traceparent`` string (None on anything malformed). The tail
+    sampler and exemplar observe sites key on the trace id alone — a
+    request's hops share it while span ids differ."""
+    if isinstance(ctx, SpanContext):
+        return ctx.trace_id
+    if isinstance(ctx, str):
+        parsed = parse_traceparent(ctx)
+        return parsed.trace_id if parsed is not None else None
+    return None
+
+
 def from_headers(headers) -> Optional[SpanContext]:
     """Extract a context from an HTTP headers mapping (case-insensitive
     ``get`` — http.server's Message and requests' dicts both work)."""

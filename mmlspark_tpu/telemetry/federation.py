@@ -176,6 +176,10 @@ class FederatedSampler(TimeSeriesSampler):
         self._values: dict[str, dict[str, float]] = {}  # guarded-by: _lock
         self._last_seen: dict[str, float] = {}          # guarded-by: _lock
         self._first_merge = True                        # guarded-by: _lock
+        # per-worker exemplar side channel from ingested snapshots
+        # (bucket series key -> {"trace_id", "value"}); merge folds them
+        # into the inherited _exemplars map with worker identity intact
+        self._worker_exemplars: dict[str, dict] = {}    # guarded-by: _lock
 
     def tick(self, now: Optional[float] = None) -> int:
         raise NotImplementedError(
@@ -211,6 +215,10 @@ class FederatedSampler(TimeSeriesSampler):
             # frozen instead of stepping the merged sum down
             self._values.setdefault(worker, {}).update(values)
             self._last_seen[worker] = t
+            exemplars = snapshot.get("exemplars")
+            if exemplars:
+                self._worker_exemplars.setdefault(worker, {}).update(
+                    {k: dict(ex) for k, ex in exemplars.items()})
         if resets:
             _m_resets.inc(resets)
             from . import flight, trace
@@ -250,6 +258,7 @@ class FederatedSampler(TimeSeriesSampler):
                 self._workers.pop(worker, None)
                 self._values.pop(worker, None)
                 self._last_seen.pop(worker, None)
+            self._worker_exemplars.pop(worker, None)
 
     # -------------------------------------------------------------- merge
     def _merged_values(self, now: float) -> dict[str, float]:
@@ -330,6 +339,16 @@ class FederatedSampler(TimeSeriesSampler):
                     continue    # carry-forward: unchanged values add no point
                 ring.append((t, v))
                 appended += 1
+            # fold worker exemplars into the merged side channel: each
+            # worker-child bucket series keeps its own exemplar, and the
+            # fleet aggregate carries the exemplar WITH its worker
+            # identity (sorted fold — last worker wins deterministically)
+            for w in sorted(self._worker_exemplars):
+                for key, ex in self._worker_exemplars[w].items():
+                    self._exemplars[_with_worker(key, w)] = dict(ex)
+                    agg = dict(ex)
+                    agg.setdefault("worker", w)
+                    self._exemplars[key] = agg
         _m_fresh.set(len(self.fresh_workers(t)))
         _m_stale.set(len(self.stale_workers(t)))
         return appended
@@ -346,7 +365,18 @@ class FederatedSampler(TimeSeriesSampler):
                 ring = self._rings[key]
                 if ring:
                     v = ring[-1][1]
-                    lines.append(f"{key} {v:g}")
+                    line = f"{key} {v:g}"
+                    ex = self._exemplars.get(key)
+                    if ex is not None and ex.get("trace_id"):
+                        # OpenMetrics exemplar: the tail-retained trace
+                        # behind this bucket, with the worker that
+                        # observed it (fetch via GET /debug/trace/<id>)
+                        labs = [f'trace_id="{ex["trace_id"]}"']
+                        if ex.get("worker"):
+                            labs.append(f'worker="{ex["worker"]}"')
+                        line += (" # {" + ",".join(labs) + "} "
+                                 + f'{float(ex.get("value", v)):g}')
+                    lines.append(line)
         return "\n".join(lines) + "\n"
 
     def worker_percentile(self, worker: str, hist: str, q: float,

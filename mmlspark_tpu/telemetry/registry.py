@@ -236,8 +236,12 @@ class Histogram(_Metric):
         self._counts = [0] * (len(self._bounds) + 1)  # guarded-by: _lock
         self._sum = 0.0   # guarded-by: _lock
         self._n = 0       # guarded-by: _lock
+        # OpenMetrics exemplars: bucket index -> (trace_id, observed
+        # value); last-writer-wins per bucket, only attached when the
+        # observe site passes a retained trace id
+        self._exemplars: dict[int, tuple[str, float]] = {}  # guarded-by: _lock
 
-    def observe(self, value: float):
+    def observe(self, value: float, exemplar: Optional[str] = None):
         if not _state.enabled:
             return
         # bisect_LEFT: a value equal to a bucket bound lands in the bucket
@@ -249,6 +253,8 @@ class Histogram(_Metric):
             self._sum += value
             self._n += 1
             self._rev += 1
+            if exemplar:
+                self._exemplars[i] = (str(exemplar), float(value))
 
     def time(self):
         """Context manager observing the body's wall seconds."""
@@ -272,17 +278,34 @@ class Histogram(_Metric):
 
     def _expose(self, out: list, names):
         for vals, m in self._series():
-            for b, cum in m.bucket_counts().items():
+            with m._lock:
+                exemplars = dict(m._exemplars)
+            for i, (b, cum) in enumerate(m.bucket_counts().items()):
                 lab = _label_str(names + ("le",), vals + (_fmt(b),))
-                out.append(f"{self.name}_bucket{lab} {cum}")
+                line = f"{self.name}_bucket{lab} {cum}"
+                ex = exemplars.get(i)
+                if ex is not None:
+                    # OpenMetrics exemplar: the retained trace that
+                    # landed in this bucket, fetchable via /debug/trace
+                    line += f' # {{trace_id="{_escape_label(ex[0])}"}} ' \
+                            f"{_fmt(ex[1])}"
+                out.append(line)
             lab = _label_str(names, vals)
             out.append(f"{self.name}_sum{lab} {_fmt(m._sum)}")
             out.append(f"{self.name}_count{lab} {m._n}")
 
     def _snap(self, vals, m):
-        return {"count": m._n, "sum": m._sum,
-                "buckets": {_fmt(b): c
-                            for b, c in m.bucket_counts().items()}}
+        out = {"count": m._n, "sum": m._sum,
+               "buckets": {_fmt(b): c
+                           for b, c in m.bucket_counts().items()}}
+        with m._lock:
+            exemplars = dict(m._exemplars)
+        if exemplars:
+            bounds = m._bounds + (math.inf,)
+            out["exemplars"] = {
+                _fmt(bounds[i]): {"trace_id": tid, "value": v}
+                for i, (tid, v) in sorted(exemplars.items())}
+        return out
 
 
 class _HistTimer:

@@ -93,6 +93,24 @@ def flatten_family(name: str, fam: dict):
                                                                   0.0))
 
 
+def family_exemplars(name: str, fam: dict):
+    """One registry snapshot family -> ``(bucket_series_key, exemplar)``
+    pairs, keyed like the matching ``_bucket`` series from
+    :func:`flatten_family`. Exemplars ride the snapshot as a side channel
+    — ring points stay plain floats."""
+    if fam.get("type") != "histogram":
+        return
+    for s in fam["series"]:
+        exemplars = s.get("exemplars")
+        if not exemplars:
+            continue
+        labels = s.get("labels") or {}
+        names, vals = tuple(labels.keys()), tuple(labels.values())
+        for b, ex in exemplars.items():
+            blab = _label_str(names + ("le",), vals + (str(b),))
+            yield f"{name}_bucket{blab}", dict(ex)
+
+
 class TimeSeriesSampler:
     """Periodic snapshot-delta sampler with one bounded ring per series.
 
@@ -114,6 +132,10 @@ class TimeSeriesSampler:
         # a cumulative series' value before its first point is 0.
         self._seeded: set = set()                       # guarded-by: _lock
         self._token: Optional[dict] = None              # guarded-by: _lock
+        # latest OpenMetrics exemplar per bucket-series key (side channel
+        # on the snapshot; FederatedSampler.merge populates it from
+        # ingested worker snapshots)
+        self._exemplars: dict[str, dict] = {}           # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -130,9 +152,12 @@ class TimeSeriesSampler:
         changed, token = self.registry.snapshot_delta(self._token)
         points = [(key, v) for name, fam in changed.items()
                   for key, v in flatten_family(name, fam)]
+        exemplars = [(key, ex) for name, fam in changed.items()
+                     for key, ex in family_exemplars(name, fam)]
         resets = 0
         with self._lock:
             self._token = token
+            self._exemplars.update(exemplars)
             for key, v in points:
                 ring = self._rings.get(key)
                 if ring is None:
@@ -228,8 +253,15 @@ class TimeSeriesSampler:
         with self._lock:
             series = {k: [[round(t, 3), v] for t, v in ring]
                       for k, ring in sorted(self._rings.items())}
-        return {"schema": SCHEMA, "interval": self.interval,
-                "capacity": self.capacity, "series": series}
+            exemplars = {k: dict(ex)
+                         for k, ex in sorted(self._exemplars.items())}
+        doc = {"schema": SCHEMA, "interval": self.interval,
+               "capacity": self.capacity, "series": series}
+        if exemplars:
+            # additive field: absent entirely when no histogram ever
+            # carried an exemplar, so v1 consumers are unaffected
+            doc["exemplars"] = exemplars
+        return doc
 
     def export_jsonl(self, path: str) -> int:
         """One header line + one line per series; returns series count."""
@@ -247,6 +279,7 @@ class TimeSeriesSampler:
             self._rings.clear()
             self._seeded.clear()
             self._token = None
+            self._exemplars.clear()
 
     # ------------------------------------------------------------ lifecycle
     def start(self, interval: Optional[float] = None) -> "TimeSeriesSampler":

@@ -496,6 +496,53 @@ class TestConsistency:
         assert "serve/batch" not in msgs
         assert len(fs) == 2
 
+    _EXEMPLAR_DOC = """
+# obs
+
+## Metric catalogue
+
+| Metric (exposition name) | Type | Where | Meaning |
+|---|---|---|---|
+| `myapp_lat_seconds` | histogram (exemplars) | here | request latency |
+| `myapp_plain_seconds` | histogram | here | no exemplars promised |
+"""
+
+    def test_exemplar_coverage_positive(self, tmp_path):
+        doc = tmp_path / "obs.md"
+        doc.write_text(self._EXEMPLAR_DOC)
+        fs = lint(tmp_path, """
+            from mmlspark_tpu import telemetry
+
+            _m_lat = telemetry.registry.histogram(
+                "myapp_lat_seconds", "latency")
+            _m_plain = telemetry.registry.histogram(
+                "myapp_plain_seconds", "latency")
+
+            def serve(dt, tid):
+                _m_lat.observe(dt, exemplar=tid)   # linked: fine
+                _m_lat.observe(dt)                 # finding: no exemplar
+                _m_plain.observe(dt)               # unmarked: fine
+        """, rules=["exemplar-coverage"],
+            options={"observability_doc": str(doc)})
+        assert rules_of(fs) == ["exemplar-coverage"]
+        assert len(fs) == 1
+        assert "myapp_lat_seconds" in fs[0].message
+
+    def test_exemplar_coverage_clean(self, tmp_path):
+        doc = tmp_path / "obs.md"
+        doc.write_text(self._EXEMPLAR_DOC)
+        fs = lint(tmp_path, """
+            from mmlspark_tpu import telemetry
+
+            _m_lat = telemetry.registry.histogram(
+                "myapp_lat_seconds", "latency")
+
+            def serve(dt, tid):
+                _m_lat.observe(dt, exemplar=tid if tid else None)
+        """, rules=["exemplar-coverage"], name="clean.py",
+            options={"observability_doc": str(doc)})
+        assert fs == []
+
     def test_fault_site_both_directions(self, tmp_path):
         (tmp_path / "faults.py").write_text(textwrap.dedent("""
             SITES = ("fleet.poll", "never.injected")
