@@ -1,6 +1,6 @@
 """graftlint: framework-aware static analysis for mmlspark_tpu.
 
-Five rule families encode the invariants the test suite cannot see
+Six rule families encode the invariants the test suite cannot see
 (they only bite at TPU scale, under production concurrency, or when the
 power goes out mid-commit):
 
@@ -22,6 +22,15 @@ power goes out mid-commit):
   (cycles, same-lock reacquire), blocking calls made while holding a
   lock, and ``# guarded-by:`` field annotations checked at every
   mutation site;
+* **races** — whole-program cross-thread race detection: thread-root
+  discovery (Thread/Timer targets, executor submits, HTTP handler
+  classes, signal/atexit hooks), escape analysis of which fields and
+  globals are reachable from ≥2 roots, and access classification
+  (unguarded writes, compound read-modify-write, started-before-init,
+  majority-lock inference suggesting the ``# guarded-by:`` annotation
+  to add); the runtime twin is
+  :mod:`mmlspark_tpu.analysis.sanitize_races`
+  (``MMLSPARK_TPU_SANITIZE=races``);
 * **consistency** — metric/span names vs the ``docs/observability.md``
   catalogues, ``faults.inject`` sites vs the ``SITES`` registry,
   chaos coverage (every site exercised by a test, every retry policy

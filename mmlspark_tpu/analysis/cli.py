@@ -64,6 +64,11 @@ def main(argv=None) -> int:
     ap.add_argument("--cache", default=None,
                     help="cache file for --changed-only (default: "
                          "<root>/.graftlint/cache.json)")
+    ap.add_argument("--jobs", type=int, metavar="N",
+                    default=int(os.environ.get("GRAFTLINT_JOBS", "1")),
+                    help="run file-scoped rules over N worker processes "
+                         "(default: $GRAFTLINT_JOBS or 1; the graftlint "
+                         "wrapper exports min(8, cpus))")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -93,7 +98,8 @@ def main(argv=None) -> int:
             options=options, cache_path=args.cache)
     else:
         findings = run_analysis(paths, root=root, baseline=baseline,
-                                rules=rules, options=options)
+                                rules=rules, options=options,
+                                jobs=args.jobs)
     new = [f for f in findings if not f.baselined]
     _observe_findings(findings)
 

@@ -188,7 +188,7 @@ def rule(name: str, family: str, doc: str, scope: str = "file"):
 def all_rules() -> list[Rule]:
     # importing the families registers their rules
     from . import (jit_safety, concurrency, consistency,  # noqa: F401
-                   donation, protocol)  # noqa: F401
+                   donation, protocol, races)  # noqa: F401
     return list(_RULES)
 
 
@@ -274,13 +274,46 @@ class Baseline:
 
 # ------------------------------------------------------------------ entrypoint
 
+def _run_file_rules_chunk(file_paths: list[str], root: str,
+                          rule_names: list[str],
+                          options: Optional[dict]) -> list[Finding]:
+    """Worker-process body for ``--jobs``: run the named FILE-scoped
+    rules over one chunk of files. File scope is the contract that makes
+    this sound — each finding depends only on its own module's source,
+    so a sub-project per chunk sees everything those rules need."""
+    project = load_project(file_paths, root=root, options=options)
+    wanted = set(rule_names)
+    out: list[Finding] = []
+    for r in all_rules():
+        if r.name in wanted:
+            out.extend(f for f in r.run(project) if f is not None)
+    return out
+
+
+def _chunk_by_size(files: list[SourceFile], n: int) -> list[list[str]]:
+    """Split into ``n`` chunks balanced by source size (greedy LPT), so
+    one chunk of 2k-line modules doesn't serialize the whole pool."""
+    chunks: list[list[str]] = [[] for _ in range(n)]
+    weights = [0] * n
+    for sf in sorted(files, key=lambda s: -len(s.text)):
+        i = weights.index(min(weights))
+        chunks[i].append(sf.path)
+        weights[i] += len(sf.text) + 1
+    return [c for c in chunks if c]
+
+
 def run_analysis(paths: list[str], root: Optional[str] = None,
                  baseline: Optional[str] = None,
                  rules: Optional[Iterable[str]] = None,
-                 options: Optional[dict] = None) -> list[Finding]:
+                 options: Optional[dict] = None,
+                 jobs: int = 1) -> list[Finding]:
     """Run every (selected) rule over ``paths``; returns all findings with
     ``baselined`` marked. Callers decide what a failure is (the CLI and
-    the tier-1 shim fail on any non-baselined finding)."""
+    the tier-1 shim fail on any non-baselined finding). ``jobs > 1``
+    fans the file-scoped rules out over worker processes (chunked by
+    source size); project-scoped rules always run in this process —
+    their cross-file state (lock graphs, docs catalogues, the thread-
+    root index) doesn't partition."""
     project = load_project(paths, root=root, options=options)
     selected = all_rules()
     if rules is not None:
@@ -288,7 +321,29 @@ def run_analysis(paths: list[str], root: Optional[str] = None,
         selected = [r for r in selected
                     if r.name in wanted or r.family in wanted]
     findings: list[Finding] = []
-    for r in selected:
+    jobs = max(1, int(jobs or 1))
+    serial = list(selected)
+    if jobs > 1 and len(project.files) > 1:
+        file_rules = [r for r in selected if r.scope == "file"]
+        if file_rules:
+            names = [r.name for r in file_rules]
+            chunks = _chunk_by_size(project.files,
+                                    min(jobs, len(project.files)))
+            try:
+                import concurrent.futures as cf
+                with cf.ProcessPoolExecutor(max_workers=len(chunks)) as ex:
+                    futs = [ex.submit(_run_file_rules_chunk, c,
+                                      project.root, names, options)
+                            for c in chunks]
+                    for fut in futs:
+                        findings.extend(fut.result())
+            except Exception:
+                # a broken pool (pickling, fork limits, sandboxing) must
+                # degrade to the serial path, never to missed findings
+                findings = []
+            else:
+                serial = [r for r in selected if r.scope != "file"]
+    for r in serial:
         findings.extend(f for f in r.run(project) if f is not None)
     base = Baseline.load(baseline) if baseline else Baseline([])
     for f in findings:

@@ -5,8 +5,8 @@ CI, wasteful in an edit loop where one file changed. The cache
 (``<root>/.graftlint/cache.json``) stores, per analyzed file, the
 sha256 of its text plus the file-scoped findings it produced, and one
 project-level entry (digest over EVERY file hash + the observability
-doc + the tests/ index + the selected rule set) holding the
-project-scoped findings (lock-order graph, catalogue/chaos coverage,
+doc + the tests/ index + the thread-root index + the selected rule
+set) holding the project-scoped findings (lock-order graph, catalogue/chaos coverage,
 codegen sync — anything whose result can change when OTHER files do).
 
 On a run:
@@ -66,6 +66,14 @@ def _env_digest(project: Project, rule_names: list) -> str:
     tests = _tests_dir(project)
     if tests:
         h.update(_sha(_tests_index(tests)).encode())
+    if any(n.startswith("race-") for n in rule_names):
+        # the race family's whole-program view pivots on the thread-root
+        # index (which entry points exist, spawned where); folding its
+        # digest in makes the cache key concurrency-aware — a new spawn
+        # site anywhere re-runs the family even if the individually
+        # hashed files somehow collide
+        from .races import thread_root_digest
+        h.update(thread_root_digest(project).encode())
     return h.hexdigest()
 
 

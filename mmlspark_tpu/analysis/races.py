@@ -1,0 +1,974 @@
+"""Cross-thread race rules: thread roots, escaped state, unlocked access.
+
+The concurrency family checks *annotated* locks (``# guarded-by:`` /
+``# requires-lock:``); an unguarded shared field added by a new PR is
+invisible to it until the field corrupts a fleet run. This family closes
+that gap with a whole-program pass in three stages:
+
+1. **Thread-root discovery** — every concurrent entry point:
+   ``threading.Thread(target=...)`` / ``threading.Timer`` spawns
+   (including lambda and nested-def closure targets),
+   ``ThreadPoolExecutor.submit``/``.map``, ``BaseHTTPRequestHandler``
+   subclasses (each request runs on its own thread under the threading
+   server, so every handler method is a MANY-instance root), and
+   ``signal.signal`` / ``atexit.register`` hooks. A spawn inside a
+   ``for``/``while`` body is many-instance too.
+2. **Escape analysis** — which ``self._field`` attributes (and module
+   globals) are reachable from two or more roots. ``self`` captured in
+   a target/closure counts (``source = self`` + a nested handler class
+   touching ``source._field`` attributes the accesses to the outer
+   class), and a method transitively called from a root (BFS over
+   ``self.m()`` edges) inherits that root. Methods not reachable from
+   any thread root belong to the ``<caller>`` root — the constructing /
+   driver thread that invokes the public API.
+3. **Access classification** — the same lexically-held-lock walk the
+   concurrency family uses labels every shared access read / write /
+   compound (``+=``, ``self.x = self.x + ...``, check-then-act ``if k
+   not in d: d[k] = ...``) and records the lock set held there.
+
+Rules (all skip fields that carry a ``# guarded-by:`` annotation — the
+concurrency family owns those — and all exempt ``__init__``, whose
+writes happen before any thread this class spawns exists; the spawn
+ordering itself is checked by ``race-thread-started-before-init``):
+
+* ``race-unguarded-write`` — a shared field written from ≥2 roots with
+  no lock held at any of its access sites.
+* ``race-compound-rmw`` — a read-modify-write on a shared field outside
+  any lock. GIL-atomic-looking ones count: ``d[k] += 1`` is a read, an
+  add and a store, and another thread's store lands between them.
+* ``race-guarded-by-missing`` — a shared field where a *majority lock*
+  exists (most accesses hold the same lock) but some write site doesn't
+  hold it. The finding suggests the inferred ``# guarded-by:``
+  annotation, so the fix is either locking the stray site or declaring
+  the discipline and letting the guarded-by rule enforce it forever.
+* ``race-thread-started-before-init`` — ``__init__`` starts a thread
+  (or registers a handler server) before assigning a field the thread's
+  target (transitively) reads: the new thread can observe the
+  half-constructed object.
+
+Fields whose declared value is an internally-synchronized type
+(``queue.Queue``, ``threading.Event``/locks, ``collections.deque``,
+executors) are exempt from method-call mutation events — calling
+``.put()`` on a shared Queue is the point of a Queue — but *rebinding*
+such a field is still a write.
+
+The runtime twin is :mod:`mmlspark_tpu.analysis.sanitize_races`
+(``MMLSPARK_TPU_SANITIZE=races``): instrumented classes record
+(thread-id, held-lock set) per field access and trap a conflicting
+unlocked write at the moment it happens.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+import weakref
+from typing import Iterable, Optional
+
+from .core import Finding, Project, SourceFile, dotted, qualname_of, rule
+from .concurrency import (_GUARDED_RE, _MUTATORS, _collect_classes,
+                          _module_locks, _terminal, _ClassInfo)
+
+#: the implicit root: public API invoked by whoever constructed the
+#: object (the driver / test / caller thread)
+CALLER = "<caller>"
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "StreamRequestHandler",
+                  "BaseRequestHandler", "SimpleHTTPRequestHandler"}
+_POOLISH = re.compile(r"(^|_)(pool|executor|workers)$")
+
+#: value constructors whose instances are internally synchronized —
+#: method calls on such fields are not racy accesses (rebinding is)
+_SYNC_CTORS = {
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+    "queue.SimpleQueue", "Queue",
+    "threading.Event", "threading.Lock", "threading.RLock",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.local", "Event",
+    "collections.deque", "deque",
+    "concurrent.futures.ThreadPoolExecutor", "ThreadPoolExecutor",
+    "futures.ThreadPoolExecutor",
+}
+
+
+class _Access:
+    """One read/write/compound touch of a class field or module global."""
+
+    __slots__ = ("field", "kind", "roots", "locks", "node", "sf", "qual")
+
+    def __init__(self, field: str, kind: str, roots: frozenset,
+                 locks: tuple, node: ast.AST, sf: SourceFile, qual: str):
+        self.field = field
+        self.kind = kind          # "read" | "write" | "compound"
+        self.roots = roots        # root ids; handler/pool roots end in "*"
+        self.locks = locks
+        self.node = node
+        self.sf = sf
+        self.qual = qual
+
+
+class _Spawn:
+    """One thread-spawn site (for the start-before-init rule and the
+    thread-root index)."""
+
+    __slots__ = ("kind", "target", "multi", "line", "sf", "cls", "qual")
+
+    def __init__(self, kind: str, target: str, multi: bool, line: int,
+                 sf: SourceFile, cls: str, qual: str):
+        self.kind = kind          # thread|timer|executor|handler|signal|atexit
+        self.target = target      # root id, or dotted external target
+        self.multi = multi
+        self.line = line
+        self.sf = sf
+        self.cls = cls
+        self.qual = qual
+
+
+class _ClassModel:
+    """Everything the race rules need to know about one class."""
+
+    def __init__(self, name: str, info: _ClassInfo, sf: SourceFile):
+        self.name = name
+        self.info = info
+        self.sf = sf
+        self.roots: dict[str, bool] = {}       # root id -> many-instance
+        self.spawns: list[_Spawn] = []
+        self.accesses: list[_Access] = []
+        self.call_edges: dict[str, set] = {}   # method -> self-methods called
+        self.fields: set[str] = set()          # attrs assigned via self.*
+        self.sync_fields: set[str] = set()     # internally-synchronized values
+        self.reads_by_method: dict[str, set] = {}  # method -> fields read
+        self.init_thread_targets: list = []    # (start_line, root, node)
+        self.init_assign_lines: dict[str, int] = {}  # field -> first line
+
+
+def _base_of(node: ast.AST) -> Optional[str]:
+    """Root name of a dotted chain (``a`` for ``a.b.c``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class _Walker:
+    """Walk one function/handler body: held locks, accesses, spawns.
+
+    ``owners`` maps base names (``self``, ``cls``, closure aliases like
+    ``source``) to the :class:`_ClassModel` whose fields they denote.
+    """
+
+    def __init__(self, model: _ClassModel, owners: dict, qual: str,
+                 roots: frozenset, method: Optional[str],
+                 module: str, mod_locks: set, mod_accesses: list,
+                 loop_depth: int = 0):
+        self.model = model
+        self.owners = owners
+        self.qual = qual
+        self.roots = roots
+        self.method = method          # edge-collection key, None for nested
+        self.module = module
+        self.mod_locks = mod_locks
+        self.mod_accesses = mod_accesses
+        self.loop_depth = loop_depth
+        self.spawned_fns: list = []   # nested defs used as thread targets
+        self._module_globals: set = set()
+
+    # ------------------------------------------------------------ lock keys
+    def _lock_key(self, expr: ast.AST) -> Optional[str]:
+        name = dotted(expr)
+        if name is None:
+            return None
+        term = _terminal(name)
+        lockish = ("lock" in term or "mutex" in term or term == "guard"
+                   or term.endswith("_cv") or term == "cond")
+        base = name.split(".", 1)[0]
+        owner = self.owners.get(base)
+        if owner is not None and "." in name:
+            attr = name.split(".", 1)[1]
+            if attr in owner.info.locks or lockish:
+                return f"{owner.name}.{attr}"
+            return None
+        if "." not in name and name in self.mod_locks:
+            return f"{self.module}.{name}"
+        if lockish:
+            return f"*.{term}" if "." in name else f"{self.module}.{name}"
+        return None
+
+    # ------------------------------------------------------------- accesses
+    def _field_of(self, node: ast.AST):
+        """(model, field) when ``node`` is ``<owner>.<field>``, else None."""
+        if not isinstance(node, ast.Attribute):
+            return None
+        base = node.value
+        if not isinstance(base, ast.Name):
+            return None
+        owner = self.owners.get(base.id)
+        if owner is None:
+            return None
+        attr = node.attr
+        if attr in owner.info.methods or attr in owner.info.locks:
+            return None
+        return owner, attr
+
+    def _record(self, owner: _ClassModel, field: str, kind: str,
+                node: ast.AST, held: tuple):
+        owner.accesses.append(_Access(
+            f"{owner.name}.{field}", kind, self.roots, held, node,
+            self.sf_for(owner), self.qual))
+        if self.method is not None and owner is self.model \
+                and kind == "read":
+            self.model.reads_by_method.setdefault(self.method,
+                                                  set()).add(field)
+
+    def sf_for(self, owner: _ClassModel) -> SourceFile:
+        return owner.sf
+
+    def _record_global(self, name: str, kind: str, node: ast.AST,
+                       held: tuple, sf: SourceFile):
+        self.mod_accesses.append(_Access(
+            f"{self.module}.{name}", kind, self.roots, held, node, sf,
+            self.qual))
+
+    # --------------------------------------------------------------- spawns
+    def _root_of_target(self, target_node: ast.AST, held: tuple):
+        """Resolve a spawn target expression to (root_id or None, multi,
+        display). Lambdas/nested defs are walked in place as root
+        contexts."""
+        multi = self.loop_depth > 0
+        if isinstance(target_node, ast.Lambda):
+            rid = f"{self.qual}.<lambda>"
+            sub = _Walker(self.model, self.owners, rid,
+                          frozenset([rid + ("*" if multi else "")]),
+                          None, self.module, self.mod_locks,
+                          self.mod_accesses)
+            sub._module_globals = self._module_globals
+            sub.expr(target_node.body, ())
+            # self.m() calls inside the lambda make m a root too
+            for call in ast.walk(target_node.body):
+                if isinstance(call, ast.Call):
+                    dn = dotted(call.func)
+                    if dn:
+                        base = dn.split(".", 1)[0]
+                        owner = self.owners.get(base)
+                        if owner is not None and dn.count(".") == 1:
+                            m = dn.split(".", 1)[1]
+                            if m in owner.info.methods:
+                                owner.roots[m + ("*" if multi else "")] = \
+                                    multi
+            return rid, multi, rid
+        name = dotted(target_node)
+        if name is None:
+            return None, multi, "<expr>"
+        base = name.split(".", 1)[0]
+        owner = self.owners.get(base)
+        if owner is not None and name.count(".") == 1:
+            m = name.split(".", 1)[1]
+            if m in owner.info.methods:
+                rid = m + ("*" if multi else "")
+                owner.roots[rid] = multi
+                return rid, multi, f"{owner.name}.{m}"
+        if "." not in name:
+            # nested def in the enclosing scope, or module-level function
+            self.spawned_fns.append((name, multi))
+            return name, multi, f"{self.module}.{name}"
+        return None, multi, name       # foreign object (self.server.x)
+
+    def _check_spawn(self, call: ast.Call, held: tuple):
+        dn = dotted(call.func)
+        target_node = None
+        kind = None
+        multi_force = False
+        if dn in _THREAD_CTORS or dn in _TIMER_CTORS:
+            kind = "timer" if dn in _TIMER_CTORS else "thread"
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    target_node = kw.value
+            if target_node is None and dn in _TIMER_CTORS \
+                    and len(call.args) >= 2:
+                target_node = call.args[1]
+        elif dn == "signal.signal" and len(call.args) >= 2:
+            kind, target_node = "signal", call.args[1]
+        elif dn == "atexit.register" and call.args:
+            kind, target_node = "atexit", call.args[0]
+        elif isinstance(call.func, ast.Attribute) \
+                and call.func.attr in ("submit", "map"):
+            recv = _terminal(dotted(call.func.value) or "")
+            fo = self._field_of(call.func.value)
+            poolish = bool(_POOLISH.search(recv)) or (
+                fo is not None and fo[1] in fo[0].sync_fields)
+            if poolish and call.args:
+                kind, target_node, multi_force = ("executor",
+                                                  call.args[0], True)
+        if kind is None or target_node is None:
+            return
+        rid, multi, display = self._root_of_target(target_node, held)
+        multi = multi or multi_force
+        if rid is not None and multi and not rid.endswith("*") \
+                and kind == "executor":
+            # pool-submitted self-methods run many at once
+            for owner in set(self.owners.values()):
+                if rid in owner.roots:
+                    owner.roots.pop(rid)
+                    owner.roots[rid + "*"] = True
+        self.model.spawns.append(_Spawn(
+            kind, display, multi or multi_force, call.lineno,
+            self.model.sf, self.model.name, self.qual))
+        if self.method == "__init__" and rid is not None:
+            self.model.init_thread_targets.append((call.lineno, rid, call))
+
+    # ------------------------------------------------------------- the walk
+    def walk(self, stmts, held: tuple, cta: frozenset = frozenset()):
+        for st in stmts:
+            self.stmt(st, held, cta)
+
+    def stmt(self, st, held: tuple, cta: frozenset = frozenset()):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return          # nested defs are walked when spawned
+        if isinstance(st, ast.ClassDef):
+            return          # nested handler classes handled by the model
+        if isinstance(st, ast.With):
+            new_held = held
+            for item in st.items:
+                key = self._lock_key(item.context_expr)
+                if key is not None:
+                    new_held = new_held + (key,)
+                else:
+                    self.expr(item.context_expr, held)
+            # entering a lock resets check-then-act suspicion: the test
+            # outside the lock no longer pairs with the write inside it
+            self.walk(st.body, new_held, frozenset() if new_held != held
+                      else cta)
+            return
+        if isinstance(st, ast.If):
+            self.expr(st.test, held)
+            tested = self._fields_in(st.test)
+            self.walk(st.body, held, cta | tested if not held else cta)
+            self.walk(st.orelse or [], held, cta)
+            return
+        if isinstance(st, (ast.For, ast.While)):
+            if isinstance(st, ast.While):
+                self.expr(st.test, held)
+            else:
+                self.expr(st.iter, held)
+            self.loop_depth += 1
+            self.walk(st.body, held, cta)
+            self.loop_depth -= 1
+            self.walk(st.orelse or [], held, cta)
+            return
+        if isinstance(st, ast.Try):
+            self.walk(st.body, held, cta)
+            for h in st.handlers:
+                self.walk(h.body, held, cta)
+            self.walk(st.orelse or [], held, cta)
+            self.walk(st.finalbody or [], held, cta)
+            return
+        if isinstance(st, ast.AugAssign):
+            self._write(st.target, st, held, compound=True)
+            self.expr(st.value, held)
+            return
+        if isinstance(st, (ast.Assign, ast.AnnAssign)):
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            value_fields = self._fields_in(getattr(st, "value", None)) \
+                if getattr(st, "value", None) is not None else frozenset()
+            for t in targets:
+                self._write(t, st, held,
+                            compound_if=(value_fields | cta))
+            if getattr(st, "value", None) is not None:
+                self.expr(st.value, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._write(t, st, held)
+            return
+        if isinstance(st, ast.Return) and st.value is not None:
+            self.expr(st.value, held)
+            return
+        if isinstance(st, ast.Expr):
+            self.expr(st.value, held, cta)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self.expr(child, held)
+
+    def _fields_in(self, node) -> frozenset:
+        """Field keys (``Class.attr`` / ``module.global``) read in an
+        expression — the check-then-act / RMW pairing set."""
+        out = set()
+        if node is None:
+            return frozenset()
+        for sub in ast.walk(node):
+            fo = self._field_of(sub)
+            if fo is not None:
+                out.add(f"{fo[0].name}.{fo[1]}")
+            elif isinstance(sub, ast.Name) \
+                    and sub.id in self._module_globals:
+                out.add(f"{self.module}.{sub.id}")
+        return frozenset(out)
+
+    def _write(self, target, st, held: tuple,
+               compound: bool = False, compound_if: frozenset = frozenset()):
+        node = target
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for e in node.elts:
+                self._write(e, st, held, compound, compound_if)
+            return
+        subscripted = False
+        if isinstance(node, ast.Subscript):
+            node = node.value
+            subscripted = True
+        fo = self._field_of(node)
+        if fo is not None:
+            owner, field = fo
+            key = f"{owner.name}.{field}"
+            kind = "compound" if (compound or key in compound_if) \
+                else "write"
+            if self.method is not None and owner is self.model:
+                owner.fields.add(field)
+            self._record(owner, field, kind, st, held)
+            return
+        if isinstance(node, ast.Name) and node.id in self._module_globals:
+            kind = "compound" if (compound
+                                  or f"{self.module}.{node.id}"
+                                  in compound_if) else "write"
+            self._record_global(node.id, kind, st, held, self.model.sf)
+
+    def expr(self, node, held: tuple, cta: frozenset = frozenset()):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._check_spawn(sub, held)
+                # edge collection for reachability
+                dn = dotted(sub.func)
+                if dn and self.method is not None:
+                    base = dn.split(".", 1)[0]
+                    owner = self.owners.get(base)
+                    if owner is self.model and dn.count(".") == 1 \
+                            and dn.split(".", 1)[1] in owner.info.methods:
+                        self.model.call_edges.setdefault(
+                            self.method, set()).add(dn.split(".", 1)[1])
+                # mutator calls on fields
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _MUTATORS:
+                    fo = self._field_of(sub.func.value)
+                    if fo is not None:
+                        owner, field = fo
+                        if field not in owner.sync_fields:
+                            kind = ("compound"
+                                    if f"{owner.name}.{field}" in cta
+                                    else "write")
+                            self._record(owner, field, kind, sub, held)
+                        continue
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.ctx, ast.Load):
+                fo = self._field_of(sub)
+                if fo is not None:
+                    owner, field = fo
+                    if field not in owner.sync_fields:
+                        self._record(owner, field, "read", sub, held)
+
+
+def _collect_sync_fields(cls_node: ast.ClassDef, ci: _ClassInfo) -> set:
+    """Fields whose __init__ value is an internally-synchronized ctor."""
+    out = set()
+    for sub in ast.walk(cls_node):
+        if not isinstance(sub, ast.Assign) \
+                or not isinstance(sub.value, ast.Call):
+            continue
+        ctor = dotted(sub.value.func)
+        if ctor not in _SYNC_CTORS and ctor not in _THREAD_CTORS:
+            continue
+        for t in sub.targets:
+            tn = dotted(t)
+            if tn and tn.startswith(("self.", "cls.")) \
+                    and tn.count(".") == 1:
+                out.add(tn.split(".", 1)[1])
+    return out
+
+
+def _handler_classes(cls_node: ast.ClassDef):
+    """Nested (or top-level) BaseHTTPRequestHandler-ish subclasses and
+    the enclosing-scope alias map (``source = self``) in effect."""
+    for sub in ast.walk(cls_node):
+        if isinstance(sub, ast.ClassDef) and sub is not cls_node:
+            bases = {_terminal(dotted(b) or "") for b in sub.bases}
+            if bases & _HANDLER_BASES:
+                yield sub
+
+
+def _self_aliases(fn_node) -> set:
+    """Names bound to ``self`` in a method body (``source = self``)."""
+    out = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign) \
+                and isinstance(sub.value, ast.Name) \
+                and sub.value.id == "self":
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+class _FileModel:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.classes: dict[str, _ClassModel] = {}
+        self.mod_accesses: list[_Access] = []
+        self.mod_locks: set = set()
+        self.mod_globals: set = set()
+
+
+_MODEL_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _module_mutable_globals(sf: SourceFile) -> set:
+    """Module-level names bound to mutable literals/ctors (the registry-
+    singleton shape) — candidates for cross-root global access."""
+    out = set()
+    for node in sf.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if isinstance(value, (ast.Dict, ast.List, ast.Set)):
+            pass
+        elif isinstance(value, ast.Call) and dotted(value.func) in (
+                "dict", "list", "set", "defaultdict",
+                "collections.defaultdict", "OrderedDict",
+                "collections.OrderedDict"):
+            pass
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and not t.id.isupper():
+                out.add(t.id)
+    return out
+
+
+def _analyze_file(sf: SourceFile) -> _FileModel:
+    fm = _FileModel(sf)
+    module = sf.rel.rsplit("/", 1)[-1].removesuffix(".py")
+    infos = _collect_classes(sf)
+    fm.mod_locks = _module_locks(sf)
+    fm.mod_globals = _module_mutable_globals(sf)
+
+    class_nodes = {n.name: n for n in ast.walk(sf.tree)
+                   if isinstance(n, ast.ClassDef)}
+    handler_nodes = set()
+    for node in class_nodes.values():
+        for h in _handler_classes(node):
+            handler_nodes.add(h.name)
+
+    for name, info in infos.items():
+        if name in handler_nodes:
+            continue       # handler methods walk under their outer class
+        node = class_nodes[name]
+        cm = _ClassModel(name, info, sf)
+        cm.sync_fields = _collect_sync_fields(node, info)
+        # every self.<attr> assignment anywhere in the class declares a
+        # field (reads of undeclared attrs are someone else's state)
+        for sub in ast.walk(node):
+            targets = []
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                targets = [sub.target]
+            for t in targets:
+                tn = dotted(t.value if isinstance(t, ast.Subscript) else t)
+                if tn and tn.startswith("self.") and tn.count(".") == 1:
+                    cm.fields.add(tn.split(".", 1)[1])
+        fm.classes[name] = cm
+
+    # ---- pass 1: walk every method of every (non-handler) class
+    for name, cm in fm.classes.items():
+        node = class_nodes[name]
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual = f"{name}.{item.name}"
+            owners = {"self": cm, "cls": cm}
+            w = _Walker(cm, owners, qual, frozenset(), item.name,
+                        module, fm.mod_locks, fm.mod_accesses)
+            w._module_globals = fm.mod_globals
+            held: tuple = ()
+            req = cm.info.method_requires.get(item.name, set())
+            if req:
+                held = tuple(f"{name}.{r}" for r in req)
+            w.walk(item.body, held)
+            if item.name == "__init__":
+                # record top-level assignment order for the
+                # started-before-init rule
+                for st in item.body:
+                    if isinstance(st, ast.Assign):
+                        for t in st.targets:
+                            tn = dotted(t)
+                            if tn and tn.startswith("self.") \
+                                    and tn.count(".") == 1:
+                                cm.init_assign_lines.setdefault(
+                                    tn.split(".", 1)[1], st.lineno)
+            # nested defs spawned as threads: walk them as root contexts
+            nested = {d.name: d for d in ast.walk(item)
+                      if isinstance(d, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                      and d is not item}
+            for fn_name, multi in w.spawned_fns:
+                d = nested.get(fn_name)
+                if d is None:
+                    continue
+                rid = f"{qual}.{fn_name}" + ("*" if multi else "")
+                cm.roots[rid] = multi
+                aliases = {"self": cm, "cls": cm}
+                sub = _Walker(cm, aliases, f"{qual}.{fn_name}",
+                              frozenset([rid]), None, module,
+                              fm.mod_locks, fm.mod_accesses)
+                sub._module_globals = fm.mod_globals
+                sub.walk(d.body, ())
+            # nested handler classes: each method is a many-instance root
+            aliases = _self_aliases(item)
+            for h in _handler_classes(item):
+                for hm in h.body:
+                    if not isinstance(hm, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                        continue
+                    rid = f"{h.name}.{hm.name}*"
+                    cm.roots[rid] = True
+                    owners_h = {a: cm for a in aliases}
+                    sub = _Walker(cm, owners_h, f"{qual}.{h.name}.{hm.name}",
+                                  frozenset([rid]), None, module,
+                                  fm.mod_locks, fm.mod_accesses)
+                    sub._module_globals = fm.mod_globals
+                    sub.walk(hm.body, ())
+                cm.spawns.append(_Spawn(
+                    "handler", f"{name}.{h.name}", True, h.lineno, sf,
+                    name, qual))
+
+    # ---- module-level functions as thread roots
+    mod_fn = {n.name: n for n in sf.tree.body
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    # find spawns at module level / in module functions referencing them
+    dummy = _ClassModel("<module>", _ClassInfo("<module>"), sf)
+    for fn in mod_fn.values():
+        w = _Walker(dummy, {}, fn.name, frozenset(), None, module,
+                    fm.mod_locks, fm.mod_accesses)
+        w._module_globals = fm.mod_globals
+        w.walk(fn.body, ())
+        for fn_name, multi in w.spawned_fns:
+            target = mod_fn.get(fn_name)
+            if target is None:
+                continue
+            rid = f"{module}.{fn_name}" + ("*" if multi else "")
+            sub = _Walker(dummy, {}, fn_name, frozenset([rid]), None,
+                          module, fm.mod_locks, fm.mod_accesses)
+            sub._module_globals = fm.mod_globals
+            sub.walk(target.body, ())
+    fm.classes.pop("<module>", None)
+
+    # ---- pass 2: root reachability — accesses recorded with method
+    # names get their final root sets (BFS over self-call edges)
+    for cm in fm.classes.values():
+        reach: dict[str, set] = {}      # method -> roots reaching it
+        for rid in cm.roots:
+            entry = rid.rstrip("*")
+            if entry not in cm.info.methods:
+                continue
+            seen = {entry}
+            stack = [entry]
+            while stack:
+                m = stack.pop()
+                reach.setdefault(m, set()).add(rid)
+                for nxt in cm.call_edges.get(m, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+        for acc in cm.accesses:
+            if acc.roots:
+                continue               # nested/handler context: already set
+            meth = acc.qual.split(".", 1)[1] if "." in acc.qual else acc.qual
+            roots = set(reach.get(meth, ()))
+            # a method that is not itself a thread entry point is also
+            # callable by whoever holds the object — the caller root
+            if meth + "*" not in cm.roots and meth not in cm.roots:
+                roots.add(CALLER)
+            acc.roots = frozenset(roots)
+    return fm
+
+
+def _file_model(sf: SourceFile) -> _FileModel:
+    try:
+        return _MODEL_CACHE[sf]
+    except (KeyError, TypeError):
+        fm = _analyze_file(sf)
+        try:
+            _MODEL_CACHE[sf] = fm
+        except TypeError:
+            pass
+        return fm
+
+
+# ------------------------------------------------------------ field verdicts
+
+def _distinct_roots(accesses: list) -> int:
+    """Count concurrency: a many-instance root alone is two threads."""
+    n = 0
+    seen = set()
+    for a in accesses:
+        for r in a.roots:
+            if r in seen:
+                continue
+            seen.add(r)
+            n += 2 if r.endswith("*") else 1
+    return n
+
+
+def _majority_lock(accesses: list) -> Optional[str]:
+    counts: dict[str, int] = {}
+    for a in accesses:
+        for lk in a.locks:
+            counts[lk] = counts.get(lk, 0) + 1
+    if not counts:
+        return None
+    best = max(counts.items(), key=lambda kv: kv[1])
+    # "majority": the lock guards at least half of all accesses
+    return best[0] if best[1] * 2 >= len(accesses) else None
+
+
+def _field_verdicts(cm: _ClassModel) -> Iterable[Finding]:
+    by_field: dict[str, list] = {}
+    for a in cm.accesses:
+        if a.qual.endswith(".__init__") or ".__init__." in a.qual:
+            continue       # pre-thread construction (ordering rule below)
+        by_field.setdefault(a.field, []).append(a)
+    for field, accesses in sorted(by_field.items()):
+        attr = field.split(".", 1)[1]
+        if attr in cm.info.guards:
+            continue       # annotated: the guarded-by rule owns it
+        writes = [a for a in accesses if a.kind in ("write", "compound")]
+        if not writes:
+            continue
+        if _distinct_roots(accesses) < 2:
+            continue
+        write_roots = _distinct_roots(writes)
+        lstar = _majority_lock(accesses)
+        unlocked_writes = [a for a in writes if not a.locks]
+        if lstar is None and write_roots >= 2 and unlocked_writes:
+            a = min(unlocked_writes, key=lambda a: a.node.lineno)
+            others = sorted({r for w in writes for r in w.roots}
+                            - set(a.roots))
+            f = a.sf.finding(
+                "race-unguarded-write", a.node,
+                f"`{field}` is written from {write_roots} concurrent "
+                f"roots ({', '.join(sorted({r for w in writes for r in w.roots}))}) "
+                f"with no lock held at any access — concurrent writes "
+                f"interleave and one update is lost",
+                hint=f"guard every access with one lock and declare it "
+                     f"(`# guarded-by: <lock>` on the field), or confine "
+                     f"the field to a single thread",
+                context=a.qual)
+            if f:
+                yield f
+            continue
+        if lstar is not None:
+            stray = [a for a in writes if lstar not in a.locks]
+            if stray:
+                a = min(stray, key=lambda a: a.node.lineno)
+                guard = (lstar.split(".", 1)[1]
+                         if lstar.startswith(cm.name + ".") else lstar)
+                f = a.sf.finding(
+                    "race-guarded-by-missing", a.node,
+                    f"`{field}` is mostly accessed under {lstar} but "
+                    f"this write in `{a.qual}` (and "
+                    f"{len(stray) - 1} more site(s)) does not hold it — "
+                    f"the lock discipline exists but is not enforced",
+                    hint=f"annotate the field `# guarded-by: {guard}` "
+                         f"and take the lock at the stray sites (the "
+                         f"guarded-by rule then enforces it forever)",
+                    context=a.qual)
+                if f:
+                    yield f
+                continue
+        for a in writes:
+            if a.kind == "compound" and not a.locks:
+                f = a.sf.finding(
+                    "race-compound-rmw", a.node,
+                    f"read-modify-write of shared `{field}` outside any "
+                    f"lock in `{a.qual}` — the read and the store are "
+                    f"separate bytecodes and another thread's write "
+                    f"lands between them",
+                    hint="wrap the check/read and the write in one "
+                         "`with <lock>:` block (GIL atomicity does not "
+                         "cover read-modify-write)",
+                    context=a.qual)
+                if f:
+                    yield f
+
+
+def _global_verdicts(fm: _FileModel) -> Iterable[Finding]:
+    by_name: dict[str, list] = {}
+    for a in fm.mod_accesses:
+        by_name.setdefault(a.field, []).append(a)
+    for name, accesses in sorted(by_name.items()):
+        writes = [a for a in accesses if a.kind in ("write", "compound")]
+        if not writes:
+            continue
+        roots = {r for a in accesses for r in a.roots}
+        if not any(r != CALLER for r in roots):
+            continue       # never touched from a spawned root
+        if _distinct_roots(accesses) < 2:
+            continue
+        lstar = _majority_lock(accesses)
+        unlocked = [a for a in writes if not a.locks]
+        if unlocked and lstar is None and _distinct_roots(writes) >= 2:
+            a = min(unlocked, key=lambda a: a.node.lineno)
+            f = a.sf.finding(
+                "race-unguarded-write", a.node,
+                f"module global `{name}` is written from multiple "
+                f"concurrent roots with no lock — a registry singleton "
+                f"mutated by racing threads",
+                hint="guard it with a module-level lock, or make the "
+                     "mutation single-threaded",
+                context=a.qual)
+            if f:
+                yield f
+        elif lstar is not None:
+            stray = [a for a in writes if lstar not in a.locks]
+            if stray:
+                a = min(stray, key=lambda a: a.node.lineno)
+                f = a.sf.finding(
+                    "race-guarded-by-missing", a.node,
+                    f"module global `{name}` is mostly accessed under "
+                    f"{lstar} but this write does not hold it",
+                    hint=f"take {lstar} at this site too",
+                    context=a.qual)
+                if f:
+                    yield f
+        else:
+            for a in writes:
+                if a.kind == "compound" and not a.locks:
+                    f = a.sf.finding(
+                        "race-compound-rmw", a.node,
+                        f"read-modify-write of shared module global "
+                        f"`{name}` outside any lock in `{a.qual}`",
+                        hint="wrap the read and the write in one "
+                             "`with <lock>:` block",
+                        context=a.qual)
+                    if f:
+                        yield f
+
+
+# -------------------------------------------------------------------- rules
+
+@rule("race-unguarded-write", "races",
+      "shared field written from >=2 thread roots with no common lock",
+      scope="project")
+def check_unguarded_write(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        fm = _file_model(sf)
+        for cm in fm.classes.values():
+            for f in _field_verdicts(cm):
+                if f.rule == "race-unguarded-write":
+                    yield f
+        for f in _global_verdicts(fm):
+            if f.rule == "race-unguarded-write":
+                yield f
+
+
+@rule("race-compound-rmw", "races",
+      "read-modify-write on a shared field outside any lock",
+      scope="project")
+def check_compound_rmw(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        fm = _file_model(sf)
+        for cm in fm.classes.values():
+            for f in _field_verdicts(cm):
+                if f.rule == "race-compound-rmw":
+                    yield f
+        for f in _global_verdicts(fm):
+            if f.rule == "race-compound-rmw":
+                yield f
+
+
+@rule("race-guarded-by-missing", "races",
+      "shared field with a majority lock not held at some write "
+      "(suggests the inferred guarded-by annotation)",
+      scope="project")
+def check_guarded_by_missing(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        fm = _file_model(sf)
+        for cm in fm.classes.values():
+            for f in _field_verdicts(cm):
+                if f.rule == "race-guarded-by-missing":
+                    yield f
+        for f in _global_verdicts(fm):
+            if f.rule == "race-guarded-by-missing":
+                yield f
+
+
+@rule("race-thread-started-before-init", "races",
+      "thread spawned in __init__ before fields its target reads are "
+      "assigned", scope="project")
+def check_started_before_init(project: Project) -> Iterable[Finding]:
+    for sf in project.files:
+        fm = _file_model(sf)
+        for cm in fm.classes.values():
+            if not cm.init_thread_targets:
+                continue
+            # fields each root (transitively) reads
+            for start_line, rid, node in cm.init_thread_targets:
+                entry = rid.rstrip("*")
+                reads: set = set()
+                seen = {entry}
+                stack = [entry]
+                while stack:
+                    m = stack.pop()
+                    reads |= cm.reads_by_method.get(m, set())
+                    for nxt in cm.call_edges.get(m, ()):
+                        if nxt not in seen:
+                            seen.add(nxt)
+                            stack.append(nxt)
+                late = sorted(
+                    f for f in reads
+                    if cm.init_assign_lines.get(f, 0) > start_line
+                    and f not in cm.info.guards)
+                if not late:
+                    continue
+                f = sf.finding(
+                    "race-thread-started-before-init", node,
+                    f"`{cm.name}.__init__` spawns `{rid.rstrip('*')}` "
+                    f"here, but the thread reads "
+                    f"{', '.join('self.' + x for x in late)} which are "
+                    f"only assigned later in __init__ — the thread can "
+                    f"observe the half-constructed object",
+                    hint="assign every field the target reads before "
+                         "the spawn (start threads last)",
+                    context=f"{cm.name}.__init__")
+                if f:
+                    yield f
+
+
+# --------------------------------------------------------- thread-root index
+
+def thread_root_index(project: Project) -> list[dict]:
+    """Every concurrent entry point the analyzer discovered, sorted —
+    the docs' threading-model inventory and the incremental project
+    digest both consume this."""
+    out = []
+    for sf in project.files:
+        fm = _file_model(sf)
+        for cm in fm.classes.values():
+            for sp in cm.spawns:
+                out.append({"file": sf.rel, "class": sp.cls,
+                            "kind": sp.kind, "target": sp.target,
+                            "multi": sp.multi, "line": sp.line})
+    out.sort(key=lambda d: (d["file"], d["line"], d["target"]))
+    return out
+
+
+def thread_root_digest(project: Project) -> str:
+    h = hashlib.sha256()
+    for entry in thread_root_index(project):
+        h.update(f"{entry['file']}|{entry['class']}|{entry['kind']}|"
+                 f"{entry['target']}|{entry['multi']};".encode())
+    return h.hexdigest()

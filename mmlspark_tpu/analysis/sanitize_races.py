@@ -1,0 +1,272 @@
+"""Race sanitizer — the dynamic oracle behind the race rules.
+
+``MMLSPARK_TPU_SANITIZE=races`` arms it (tests and chaos runs; OFF by
+default with zero overhead — :func:`instrument` is a no-op and no class
+is ever patched unless the env knob is set when the object is built).
+Armed, it does two things the static pass
+(:mod:`mmlspark_tpu.analysis.races`) cannot:
+
+* **record the actual interleaving** — every access to an instrumented
+  field is tagged with the accessing thread and the set of
+  :class:`TrackedLock` s that thread holds *right now*, counted on
+  ``mmlspark_sanitizer_race_accesses_total``;
+* **trap the racy pair at the moment it happens** — an **unlocked
+  write** paired with any access to the same field from another thread
+  raises :class:`RaceConflict` immediately (counted on
+  ``mmlspark_sanitizer_race_conflicts_total``), with both sides' thread
+  names and held-lock sets in the message. A *locked* write observed by
+  an unlocked read is recorded but NOT trapped: single-machine-word
+  reads of a locked field (e.g. ``ProcessHTTPSource._offset``'s fast
+  path) are a deliberate, benign pattern in this codebase.
+
+The held-lock bookkeeping doubles as the data source for the
+``/debug/threads`` endpoint: :func:`thread_dump` joins
+``sys._current_frames`` with the per-thread held-lock map, so a wedged
+fleet shows WHICH thread holds WHICH lock under WHICH frame — the
+deadlock-diagnosis twin of ``/debug/flight``.
+
+Production classes opt in cheaply::
+
+    sanitize_races.instrument(self, fields=("_n_pending", "_inflight"),
+                              locks=("_lock",))
+
+Disarmed this returns immediately; armed it wraps the named lock
+attributes in :class:`TrackedLock` and patches the class's
+``__setattr__``/``__getattribute__`` once to observe the named fields.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Iterable, Optional
+
+from .. import telemetry
+
+_m_accesses = telemetry.registry.counter(
+    "mmlspark_sanitizer_race_accesses",
+    "instrumented shared-field accesses observed by the race sanitizer "
+    "(each tagged with the accessing thread and its held-lock set)")
+_m_conflicts = telemetry.registry.counter(
+    "mmlspark_sanitizer_race_conflicts",
+    "conflicting unlocked write/access pairs trapped by the race "
+    "sanitizer — each one is a data race the static race rules should "
+    "also have flagged")
+
+
+class RaceConflict(RuntimeError):
+    """An instrumented field was written without a lock while another
+    thread was accessing it — the dynamic ``race-unguarded-write``."""
+
+
+def enabled() -> bool:
+    from ..core.env import sanitize_mode
+    return sanitize_mode() == "races"
+
+
+#: fast-path gate: flipped on by the first armed instrument() call, so
+#: patched-class hooks cost one global read when a test later disarms
+_armed = False
+
+_state_lock = threading.Lock()
+_held = threading.local()               # .labels: list of lock labels
+_held_by_thread: dict = {}              # ident -> list of labels
+_class_fields: dict = {}                # class -> set of field names
+_patched: set = set()
+#: (id(obj), field) -> (ident, thread name, frozenset(locks), kind)
+_last: dict = {}
+
+
+class TrackedLock:
+    """Proxy around a real lock that records which thread holds it.
+
+    Transparent for correct code: ``acquire``/``release``/``with`` all
+    forward to the wrapped lock; everything else (``locked``,
+    ``notify``, ...) proxies via ``__getattr__``. Reentrant acquires
+    push the label once per level so release bookkeeping stays exact.
+    """
+
+    def __init__(self, inner, label: str):
+        self._inner = inner
+        self._label = label
+
+    def _push(self):
+        labels = getattr(_held, "labels", None)
+        if labels is None:
+            labels = _held.labels = []
+        labels.append(self._label)
+        with _state_lock:
+            _held_by_thread[threading.get_ident()] = list(labels)
+
+    def _pop(self):
+        labels = getattr(_held, "labels", None)
+        if labels and self._label in labels:
+            labels.reverse()
+            labels.remove(self._label)
+            labels.reverse()
+        with _state_lock:
+            ident = threading.get_ident()
+            if labels:
+                _held_by_thread[ident] = list(labels)
+            else:
+                _held_by_thread.pop(ident, None)
+
+    def acquire(self, *a, **kw):
+        got = self._inner.acquire(*a, **kw)
+        if got:
+            self._push()
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._pop()
+
+    def __enter__(self):
+        self._inner.__enter__()
+        self._push()
+        return self
+
+    def __exit__(self, *exc):
+        self._pop()
+        return self._inner.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def held_locks() -> tuple:
+    """Lock labels the CALLING thread holds right now."""
+    return tuple(getattr(_held, "labels", ()))
+
+
+def all_held() -> dict:
+    """``{thread_ident: [lock labels]}`` across every live thread."""
+    with _state_lock:
+        return {k: list(v) for k, v in _held_by_thread.items()}
+
+
+def _on_access(obj, field: str, kind: str):
+    if not _armed:
+        return
+    ident = threading.get_ident()
+    locks = frozenset(getattr(_held, "labels", ()))
+    key = (id(obj), field)
+    _m_accesses.inc()
+    with _state_lock:
+        prev = _last.get(key)
+        _last[key] = (ident, threading.current_thread().name, locks, kind)
+    if prev is None or prev[0] == ident:
+        return
+    # trap only when the WRITE side is unlocked: a locked write observed
+    # by a lock-free read is the benign atomic-read pattern
+    cur_racy = kind == "write" and not locks
+    prev_racy = prev[3] == "write" and not prev[2]
+    if not (cur_racy or prev_racy):
+        return
+    _m_conflicts.inc()
+    label = f"{type(obj).__name__}.{field}"
+    telemetry.trace.instant("sanitizer/race_conflict", field=label)
+    telemetry.flight.note("sanitizer/race_conflict", field=label,
+                          thread=threading.current_thread().name,
+                          other=prev[1])
+    raise RaceConflict(
+        f"unlocked {kind} of {label} by thread "
+        f"{threading.current_thread().name!r} (holding "
+        f"{sorted(locks) or 'no locks'}) races a {prev[3]} by thread "
+        f"{prev[1]!r} (holding {sorted(prev[2]) or 'no locks'}) — take "
+        f"the field's lock on BOTH sides or confine it to one thread")
+
+
+def _patch(cls) -> None:
+    if cls in _patched:
+        return
+    _patched.add(cls)
+    orig_set = cls.__setattr__
+    orig_get = cls.__getattribute__
+
+    def __setattr__(self, name, value):
+        fields = _class_fields.get(type(self))
+        if fields is not None and name in fields:
+            _on_access(self, name, "write")
+        orig_set(self, name, value)
+
+    def __getattribute__(self, name):
+        value = orig_get(self, name)
+        fields = _class_fields.get(type(self))
+        if fields is not None and name in fields:
+            _on_access(self, name, "read")
+        return value
+
+    cls.__setattr__ = __setattr__
+    cls.__getattribute__ = __getattribute__
+
+
+def instrument(obj, fields: Iterable[str], locks: Iterable[str] = (),
+               label: Optional[str] = None):
+    """Opt ``obj`` into race sanitizing (no-op unless armed): track the
+    named ``fields`` and wrap the named ``locks`` attributes so held-
+    lock sets are observable. Returns ``obj``."""
+    global _armed
+    if not enabled():
+        return obj
+    _armed = True
+    cls = type(obj)
+    want = set(fields)
+    with _state_lock:
+        _class_fields.setdefault(cls, set()).update(want)
+    prefix = label or cls.__name__
+    for lname in locks:
+        raw = getattr(obj, lname, None)
+        if raw is not None and not isinstance(raw, TrackedLock):
+            object.__setattr__(obj, lname,
+                               TrackedLock(raw, f"{prefix}.{lname}"))
+    _patch(cls)
+    return obj
+
+
+def clear() -> None:
+    """Forget access history and re-read the env knob (test isolation).
+    Patched classes stay patched — their hooks gate on the armed flag."""
+    global _armed
+    with _state_lock:
+        _last.clear()
+        _held_by_thread.clear()
+    _armed = enabled() and bool(_class_fields)
+
+
+# ------------------------------------------------------------- thread dumps
+
+def thread_dump(max_frames: int = 32, note: bool = True) -> dict:
+    """Every live thread's stack joined with the sanitizer's held-lock
+    map — the payload behind ``GET /debug/threads``. Mirrors a compact
+    summary into the flight recorder (``note=False`` to skip, e.g. when
+    the caller notes a richer record itself)."""
+    frames = sys._current_frames()
+    held = all_held()
+    threads = []
+    for t in sorted(threading.enumerate(), key=lambda t: t.ident or 0):
+        fr = frames.get(t.ident)
+        stack = traceback.format_stack(fr) if fr is not None else []
+        if len(stack) > max_frames:
+            stack = stack[-max_frames:]
+        top = ""
+        if fr is not None:
+            top = (f"{fr.f_code.co_filename.rsplit('/', 1)[-1]}:"
+                   f"{fr.f_lineno}:{fr.f_code.co_name}")
+        threads.append({
+            "name": t.name, "ident": t.ident, "daemon": t.daemon,
+            "top": top,
+            "held_locks": held.get(t.ident, []),
+            "stack": [ln.rstrip("\n") for ln in stack]})
+    doc = {"armed": _armed, "n_threads": len(threads),
+           "locks_held": sum(len(v) for v in held.values()),
+           "race_accesses": _m_accesses.value,
+           "race_conflicts": _m_conflicts.value,
+           "threads": threads}
+    if note:
+        telemetry.flight.note(
+            "debug/threads", n_threads=len(threads),
+            holders={str(i): v for i, v in held.items()},
+            tops=[f"{t['name']}@{t['top']}" for t in threads])
+    return doc

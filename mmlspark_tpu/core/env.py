@@ -71,8 +71,12 @@ def fault_spec() -> Optional[str]:
 def sanitize_mode() -> Optional[str]:
     """MMLSPARK_TPU_SANITIZE=donation: arm the donation sanitizer
     (mmlspark_tpu.analysis.sanitize) — donating dispatches poison their
-    host-aliased donated inputs after dispatch and trap re-reads. Test/
-    chaos-tier knob; unset (the default) costs nothing."""
+    host-aliased donated inputs after dispatch and trap re-reads.
+    MMLSPARK_TPU_SANITIZE=races: arm the race sanitizer
+    (mmlspark_tpu.analysis.sanitize_races) — instrumented classes
+    record (thread, held-lock set) per shared-field access and trap
+    conflicting unlocked cross-thread pairs. Test/chaos-tier knob;
+    unset (the default) costs nothing."""
     v = os.environ.get("MMLSPARK_TPU_SANITIZE", "").strip().lower()
     return v or None
 

@@ -267,6 +267,15 @@ class ProcessHTTPSource:
                                           max_attempts=2, base_delay=0.02,
                                           max_delay=0.1)
         self._lock = threading.Lock()
+        # race-sanitizer opt-in (no-op unless MMLSPARK_TPU_SANITIZE=
+        # races): the offset log is mutated from the serving loop, the
+        # supervisor's flush, and reply paths — record every touch with
+        # the holder's lock set so /debug/threads shows contention
+        from ...analysis import sanitize_races
+        sanitize_races.instrument(
+            self, fields=("_offset", "_committed", "_log", "_log_ids",
+                          "_reply_buf", "_parked_rows", "_parked_replies"),
+            locks=("_lock",), label="fleet-source")
         _m_workers_alive.set(self.aliveCount())
         log.info("fleet of %d worker processes on ports %s",
                  len(self.workers), [w.port for w in self.workers])

@@ -167,6 +167,14 @@ class HTTPSource:
         # either when getBatch picks the exchange or when its client's
         # wait times out unpicked.
         self._n_pending = 0
+        # race-sanitizer opt-in (no-op unless MMLSPARK_TPU_SANITIZE=
+        # races): every touch of the lock-guarded counters is recorded
+        # with the accessing thread's held-lock set, and /debug/threads
+        # can show which thread holds _lock under which frame
+        from ...analysis import sanitize_races
+        sanitize_races.instrument(self,
+                                  fields=("_n_pending", "_inflight"),
+                                  locks=("_lock",), label=f"http-{name}")
         source = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -346,6 +354,20 @@ class HTTPSource:
                     payload = json.dumps(
                         telemetry.flight.bundle("debug-endpoint")) \
                         .encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                elif path == "/debug/threads":
+                    # every live thread's stack joined with the held-lock
+                    # sets the race sanitizer tracks — the deadlock-
+                    # diagnosis twin of /debug/flight. thread_dump()
+                    # mirrors a compact summary into the flight ring, so
+                    # the dump an operator pulled is itself on record.
+                    from ...analysis import sanitize_races
+                    payload = json.dumps(
+                        sanitize_races.thread_dump()).encode("utf-8")
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(payload)))

@@ -68,6 +68,12 @@ class WorkerServer:
                 self.source, self.step, max_wait=max_wait).start()
         self._unacked: dict[str, str] = {}   # id -> value, insertion order
         self._lock = threading.Lock()
+        # race-sanitizer opt-in (no-op unless MMLSPARK_TPU_SANITIZE=
+        # races): control-plane poll threads and the probe surface both
+        # touch _unacked under _lock — record who holds it when
+        from ...analysis import sanitize_races
+        sanitize_races.instrument(self, fields=("_unacked",),
+                                  locks=("_lock",), label="worker-control")
         worker = self
         worker_pid = os.getpid()
 
@@ -156,6 +162,12 @@ class WorkerServer:
                     from ... import telemetry
                     self._json(200,
                                telemetry.flight.bundle("debug-endpoint"))
+                elif self.path == "/debug/threads":
+                    # live stacks + held-lock sets on the control plane:
+                    # a wedged worker shows which thread holds _lock
+                    # under which frame (twin of /debug/flight)
+                    from ...analysis import sanitize_races
+                    self._json(200, sanitize_races.thread_dump())
                 else:
                     self.send_error(404)
 

@@ -117,17 +117,23 @@ class ServingAutoscaler:
                              "(pass objectives=[...] explicitly)")
         self.load_fn = load_fn
         self.interval = float(interval)
-        self._breach_since: Optional[float] = None
-        self._idle_since: Optional[float] = None
-        self._cooldown_until = 0.0
-        self._last_verdict: Optional[str] = None
-        self._last_offset: Optional[tuple[float, int]] = None
-        self._load = None
+        # tick() runs on the daemon thread while state() serves healthz
+        # request threads (and deterministic tests drive tick directly):
+        # every mutable verdict field below is guarded by _lock
+        self._lock = threading.RLock()
+        self._breach_since: Optional[float] = None      # guarded-by: _lock
+        self._idle_since: Optional[float] = None        # guarded-by: _lock
+        self._cooldown_until = 0.0                      # guarded-by: _lock
+        self._last_verdict: Optional[str] = None        # guarded-by: _lock
+        self._last_offset: Optional[tuple[float, int]] = None  # guarded-by: _lock
+        self._load = None                               # guarded-by: _lock
+        self._now = 0.0                                 # guarded-by: _lock
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serving-autoscaler")
 
     # ------------------------------------------------------------- signals
+    # requires-lock: _lock
     def _observe_load(self, now: float) -> Optional[float]:
         """Rows/s per capacity worker since the last tick (None until
         two observations exist)."""
@@ -151,6 +157,11 @@ class ServingAutoscaler:
         / ``"shrink"`` / None). ``now`` drives BOTH the SLO evaluation
         and the hysteresis clocks, so tests replay scenarios exactly."""
         t = time.time() if now is None else float(now)
+        with self._lock:
+            return self._tick_locked(t)
+
+    # requires-lock: _lock
+    def _tick_locked(self, t: float) -> Optional[str]:
         self._now = t
         state = self.slo.evaluate(now=t)
         watched = {n: state[n] for n in self.objectives if n in state}
@@ -211,6 +222,10 @@ class ServingAutoscaler:
                               burns={k: (v if isinstance(v, (int, float))
                                          and math.isfinite(v) else "inf")
                                      for k, v in burns.items()})
+        # _lock serializes whole ticks against healthz readers BY
+        # DESIGN; a verdict fires at most once per cooldown window, so
+        # logging under it is inherent, not a contention bug
+        # graftlint: disable=lock-blocking-call
         log.warning("autoscale %s verdict: desired -> %d (burns %s, "
                     "load/worker %s)", verdict, applied, burns,
                     None if load is None else round(load, 2))
@@ -220,7 +235,12 @@ class ServingAutoscaler:
         """The ``autoscale`` section of the fleet-level healthz doc.
         Durations are measured against the LAST tick's clock, so
         synthetic-clock tests read consistent numbers."""
-        now = getattr(self, "_now", time.time())
+        with self._lock:
+            return self._state_locked()
+
+    # requires-lock: _lock
+    def _state_locked(self) -> dict:
+        now = self._now or time.time()
         return {"desired": self.reconciler.desired,
                 "objectives": list(self.objectives),
                 "grow_window_s": self.grow_window,

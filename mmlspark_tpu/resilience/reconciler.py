@@ -126,9 +126,12 @@ class FleetReconciler:
                                            respawn=self.spawn)
                            if supervise else None)
         self._desired = self._clamp(replicas)
-        self._drain_started: dict[int, float] = {}
-        self._last_error: Optional[str] = None
-        self._converged_at: Optional[float] = None
+        # tick() runs on the daemon thread while state() serves healthz
+        # request threads (and deterministic tests drive tick directly)
+        self._lock = threading.RLock()
+        self._drain_started: dict[int, float] = {}      # guarded-by: _lock
+        self._last_error: Optional[str] = None          # guarded-by: _lock
+        self._converged_at: Optional[float] = None      # guarded-by: _lock
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="fleet-reconciler")
@@ -162,10 +165,12 @@ class FleetReconciler:
         return len(self.capacity_slots())
 
     def converged(self) -> bool:
-        return (self.observed() == self._desired
-                and not self._drain_started)
+        with self._lock:
+            return (self.observed() == self._desired
+                    and not self._drain_started)
 
     # ---- convergence ----
+    # requires-lock: _lock
     def _spawn_into(self, wi: Optional[int], now: float) -> bool:
         """One spawn attempt (``wi`` = retired/dead slot to resurrect,
         None = append a fresh worker). Failures are counted and retried
@@ -188,6 +193,9 @@ class FleetReconciler:
         except Exception as e:
             _m_spawn_failures.inc()
             self._last_error = f"spawn: {e}"
+            # _lock serializes whole reconcile passes BY DESIGN (the
+            # spawn itself blocks under it); rare failure logging under
+            # it is inherent  # graftlint: disable=lock-blocking-call
             log.warning("reconciler spawn failed (retried next tick): %s",
                         e)
             return False
@@ -198,6 +206,11 @@ class FleetReconciler:
         now = time.monotonic() if now is None else now
         if self.supervisor is not None:
             self.supervisor.tick()
+        with self._lock:
+            self._tick_locked(now)
+
+    # requires-lock: _lock
+    def _tick_locked(self, now: float):
         # 1. progress draining workers toward retirement
         for wi, w in enumerate(list(self.source.workers)):
             if not w.draining:
@@ -214,6 +227,9 @@ class FleetReconciler:
                     self._last_error = f"drain probe: {e}"
             if done or now - started >= self.drain_timeout:
                 if not done:
+                    # rare drain-timeout path under the by-design
+                    # whole-tick lock
+                    # graftlint: disable=lock-blocking-call
                     log.warning("worker %d force-retired after %.1fs "
                                 "drain timeout", wi, self.drain_timeout)
                 self.source.retireWorker(wi)
@@ -252,15 +268,17 @@ class FleetReconciler:
 
     def state(self) -> dict:
         """The ``reconciler`` section of the fleet-level healthz doc."""
-        return {"desired": self._desired,
-                "observed": self.observed(),
-                "min_workers": self.min_workers,
-                "max_workers": self.max_workers,
-                "draining": sorted(self._drain_started),
-                "retired": [wi for wi, w in
-                            enumerate(self.source.workers) if w.retired],
-                "converged": self.converged(),
-                "last_error": self._last_error}
+        with self._lock:
+            return {"desired": self._desired,
+                    "observed": self.observed(),
+                    "min_workers": self.min_workers,
+                    "max_workers": self.max_workers,
+                    "draining": sorted(self._drain_started),
+                    "retired": [wi for wi, w in
+                                enumerate(self.source.workers)
+                                if w.retired],
+                    "converged": self.converged(),
+                    "last_error": self._last_error}
 
     # ---- lifecycle ----
     def _run(self):

@@ -63,7 +63,11 @@ class SparkServingStream:
         self.code_col = code_col
         self.max_retries = max_retries
         self.idle_sleep = idle_sleep
-        self.batches_done = 0
+        # processBatch is public (tests / foreachBatch step it) while
+        # _run drives it from the daemon thread: the counter increment
+        # is a read-modify-write and must hold the lock
+        self._lock = threading.Lock()
+        self.batches_done = 0                           # guarded-by: _lock
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
 
@@ -113,7 +117,8 @@ class SparkServingStream:
                     n = len(ids)
         self.source.flush()
         self.source.commit(end)
-        self.batches_done += 1
+        with self._lock:
+            self.batches_done += 1
         return n
 
     # ---- continuous loop (the foreachBatch-style driver thread) ----

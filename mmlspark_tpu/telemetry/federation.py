@@ -452,10 +452,14 @@ class FleetScraper:
         self.breaker = CircuitBreaker("federation.scrape",
                                       failure_threshold=3,
                                       reset_timeout=1.0)
-        self._skewed: set[str] = set()
-        self._last_shed: Optional[tuple] = None
-        self._rounds = 0
-        self._errors: dict[str, str] = {}
+        # scrape_once is public (deterministic tests drive it directly)
+        # while _run calls it from the scraper thread, and healthz()
+        # reads the round bookkeeping from request threads
+        self._lock = threading.RLock()
+        self._skewed: set[str] = set()                  # guarded-by: _lock
+        self._last_shed: Optional[tuple] = None         # guarded-by: _lock
+        self._rounds = 0                                # guarded-by: _lock
+        self._errors: dict[str, str] = {}               # guarded-by: _lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -497,22 +501,26 @@ class FleetScraper:
                 snap = self._retry.run(lambda _a, u=url: self._fetch(u))
                 self.breaker.record(wid, ok=True)
                 self.sampler.ingest(wid, snap, now=t)
-                self._errors.pop(wid, None)
+                with self._lock:
+                    self._errors.pop(wid, None)
                 results[wid] = True
                 _m_scrapes.labels(outcome="ok").inc()
             except Exception as e:
                 self.breaker.record(wid, ok=False)
-                self._errors[wid] = str(e)
+                with self._lock:
+                    self._errors[wid] = str(e)
                 results[wid] = False
                 _m_scrapes.labels(outcome="error").inc()
         self.sampler.merge(now=t)
-        self._rounds += 1
-        self._attribute_skew(t)
+        with self._lock:
+            self._rounds += 1
+            self._attribute_skew(t)
         if self.push_shed and self.slo is not None:
             self._push_shed(shed_urls)
         return results
 
     # ---------------------------------------------------- skew attribution
+    # requires-lock: _lock
     def _attribute_skew(self, now: float):
         fresh = set(self.sampler.fresh_workers(now))
         for wid in self.sampler.stale_workers(now):
@@ -551,8 +559,9 @@ class FleetScraper:
         shed = self.slo.should_shed()
         retry_after = self.slo.retry_after() if shed else None
         state = (shed, retry_after)
-        if state == self._last_shed:
-            return
+        with self._lock:
+            if state == self._last_shed:
+                return
         payload = json.dumps({"shed": shed,
                               "retry_after": retry_after}).encode()
         delivered = True
@@ -566,7 +575,8 @@ class FleetScraper:
             except Exception:
                 delivered = False   # retried next round: state not latched
         if delivered:
-            self._last_shed = state
+            with self._lock:
+                self._last_shed = state
 
     # ------------------------------------------------------------- surface
     def healthz(self) -> dict:
@@ -574,14 +584,15 @@ class FleetScraper:
         now = time.time()
         fresh = self.sampler.fresh_workers(now)
         stale = self.sampler.stale_workers(now)
-        return {"rounds": self._rounds,
-                "interval_s": self.interval,
-                "staleness_s": self.sampler.staleness,
-                "fresh_workers": fresh,
-                "stale_workers": stale,
-                "scrape_errors": dict(self._errors),
-                "breakers": self.breaker.snapshot(),
-                "skew": self.skew.report()}
+        with self._lock:
+            return {"rounds": self._rounds,
+                    "interval_s": self.interval,
+                    "staleness_s": self.sampler.staleness,
+                    "fresh_workers": fresh,
+                    "stale_workers": stale,
+                    "scrape_errors": dict(self._errors),
+                    "breakers": self.breaker.snapshot(),
+                    "skew": self.skew.report()}
 
     # ----------------------------------------------------------- lifecycle
     def start(self) -> "FleetScraper":

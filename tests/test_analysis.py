@@ -1467,7 +1467,7 @@ class TestExpandedGate:
         from mmlspark_tpu.analysis import all_rules
         families = {r.family for r in all_rules()}
         assert {"jit-safety", "concurrency", "consistency", "donation",
-                "protocol"} <= families
+                "protocol", "races"} <= families
         names = {r.name for r in all_rules()}
         assert {"donation-host-alias", "donation-use-after-donate",
                 "protocol-collective-axis",
@@ -1476,6 +1476,12 @@ class TestExpandedGate:
                 "protocol-rename-before-fsync", "protocol-manifest-order",
                 "chaos-test-coverage", "chaos-retry-path",
                 "chaos-io-site"} <= names
+        assert {"race-unguarded-write", "race-compound-rmw",
+                "race-guarded-by-missing",
+                "race-thread-started-before-init"} <= names
+        # the race family is whole-program by construction
+        assert all(r.scope == "project" for r in all_rules()
+                   if r.family == "races")
 
     def test_graftlint_gate_cli_clean(self, tmp_path, capsys):
         """tools/bin/graftlint semantics (the CI gate invocation): the

@@ -1,0 +1,664 @@
+"""The race family (graftlint v3) + its runtime twin.
+
+Static side: positive + clean-twin fixtures for all four rules, the
+annotation-inference hint, the reconstructed real bug (FleetSupervisor's
+``tick`` running on two daemon threads), the thread-root index/digest,
+``--jobs`` parity, and the race family riding ``--changed-only``.
+Dynamic side: the armed sanitizer trapping the SAME seeded race the
+static rule flags, the benign locked-write/unlocked-read pattern staying
+silent, and ``GET /debug/threads`` serving live stacks + held-lock sets
+on the serving and worker-control ports.
+"""
+
+import json
+import textwrap
+import threading
+import urllib.request
+
+import pytest
+
+from mmlspark_tpu import telemetry
+from mmlspark_tpu.analysis import load_project, run_analysis
+from mmlspark_tpu.analysis.races import (thread_root_digest,
+                                         thread_root_index)
+
+
+def lint(tmp_path, source, rules=None, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return run_analysis([str(p)], root=str(tmp_path), rules=rules)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+#: the seeded race, shared between the static and dynamic tests: two
+#: threads write ``_x`` and neither takes the lock sitting right there.
+SEEDED_RACE = """
+    import threading
+
+    class SeededCounter:
+        def __init__(self):
+            self._x = 0
+            self._lock = threading.Lock()
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+        def _run(self):
+            self._x = 1
+
+        def poke(self):
+            self._x = 2
+"""
+
+
+class _SeededCounter:
+    """The runtime shape of SEEDED_RACE (real code, not a fixture
+    string): unlocked writes to ``_x`` from two threads."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._x = 0
+
+
+# ------------------------------------------------------------- static rules
+
+class TestRaceRules:
+    def test_seeded_race_flagged_statically(self, tmp_path):
+        fs = lint(tmp_path, SEEDED_RACE, rules=["race-unguarded-write"])
+        assert rules_of(fs) == ["race-unguarded-write"]
+        assert "_x" in fs[0].message
+
+    def test_unguarded_write_clean_twin_locked(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._x = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self._x = 1
+
+                def poke(self):
+                    with self._lock:
+                        self._x = 2
+        """, rules=["races"])
+        assert fs == []
+
+    def test_compound_rmw_positive(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Tally:
+                def __init__(self):
+                    self._n = 0
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    self._n += 1
+
+                def snapshot(self):
+                    return self._n
+        """, rules=["race-compound-rmw"])
+        assert rules_of(fs) == ["race-compound-rmw"]
+        assert "_n" in fs[0].message
+
+    def test_compound_rmw_clean_twin_locked(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Tally:
+                def __init__(self):
+                    self._n = 0
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self._n += 1
+
+                def snapshot(self):
+                    with self._lock:
+                        return self._n
+        """, rules=["races"])
+        assert fs == []
+
+    def test_guarded_by_missing_infers_annotation(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._rows = []
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self._rows.append(1)
+                        self._rows.append(2)
+
+                def reset(self):
+                    self._rows = []     # the stray unlocked write
+        """, rules=["race-guarded-by-missing"])
+        assert rules_of(fs) == ["race-guarded-by-missing"]
+        # the inference: the majority lock, as a paste-ready annotation
+        assert "# guarded-by: _lock" in fs[0].hint
+        assert "reset" in fs[0].message
+
+    def test_guarded_by_missing_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._rows = []
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self._rows.append(1)
+                        self._rows.append(2)
+
+                def reset(self):
+                    with self._lock:
+                        self._rows = []
+        """, rules=["races"])
+        assert fs == []
+
+    def test_started_before_init_positive(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Loader:
+                def __init__(self, path):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+                    self._path = path      # assigned AFTER the spawn
+
+                def _run(self):
+                    return open(self._path).read()
+        """, rules=["race-thread-started-before-init"])
+        assert rules_of(fs) == ["race-thread-started-before-init"]
+        assert "_path" in fs[0].message
+
+    def test_started_before_init_clean_twin(self, tmp_path):
+        fs = lint(tmp_path, """
+            import threading
+
+            class Loader:
+                def __init__(self, path):
+                    self._path = path
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    return open(self._path).read()
+        """, rules=["races"])
+        assert fs == []
+
+    def test_annotated_field_left_to_guarded_by_rule(self, tmp_path):
+        """A field already carrying # guarded-by: belongs to the
+        concurrency family's stricter check — no double reporting."""
+        fs = lint(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._x = 0            # guarded-by: _lock
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    self._x = 1
+
+                def poke(self):
+                    self._x = 2
+        """, rules=["races"])
+        assert fs == []
+
+    def test_sync_object_use_is_not_a_race(self, tmp_path):
+        """Calling methods on Queue/Event fields is the safe API;
+        only rebinding them would race."""
+        fs = lint(tmp_path, """
+            import queue
+            import threading
+
+            class Pump:
+                def __init__(self):
+                    self._q = queue.Queue()
+                    self._stop = threading.Event()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def _run(self):
+                    while not self._stop.is_set():
+                        self._q.put(1)
+
+                def drain(self):
+                    return self._q.get_nowait()
+
+                def close(self):
+                    self._stop.set()
+        """, rules=["races"])
+        assert fs == []
+
+
+class TestReconstructedRealBug:
+    """The bug the family caught in-tree on introduction: the fleet
+    supervisor's ``tick`` runs on its OWN daemon loop and on the
+    reconciler's (reconciler.tick calls supervisor.tick), so its
+    restart bookkeeping was mutated from two threads with no lock —
+    rebuilt here in fixture form, pinned forever."""
+
+    SRC = """
+        import threading
+
+        class Supervisor:
+            def __init__(self):
+                self._recovery = {}
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run,
+                                                daemon=True)
+                self._thread.start()
+
+            def _run(self):
+                while not self._stop.wait(0.5):
+                    self.tick()
+
+            def tick(self):
+                # ALSO called by the reconciler's daemon thread
+                for wid in list(self._recovery):
+                    self._recovery[wid] = self._recovery.get(wid, 0) + 1
+
+            def state(self):
+                return dict(self._recovery)
+    """
+
+    def test_supervisor_shape_flagged(self, tmp_path):
+        fs = lint(tmp_path, self.SRC, rules=["races"])
+        assert "race-unguarded-write" in rules_of(fs)
+        assert any("_recovery" in f.message for f in fs)
+
+    def test_supervisor_shape_fixed_twin_clean(self, tmp_path):
+        fixed = self.SRC.replace(
+            "self._recovery = {}",
+            "self._recovery = {}\n"
+            "        self._lock = threading.RLock()"
+        ).replace(
+            "        for wid in list(self._recovery):\n"
+            "            self._recovery[wid] = "
+            "self._recovery.get(wid, 0) + 1",
+            "        with self._lock:\n"
+            "            for wid in list(self._recovery):\n"
+            "                self._recovery[wid] = "
+            "self._recovery.get(wid, 0) + 1"
+        ).replace(
+            "        return dict(self._recovery)",
+            "        with self._lock:\n"
+            "            return dict(self._recovery)")
+        fs = lint(tmp_path, fixed, rules=["races"])
+        assert fs == []
+
+
+# ------------------------------------------------------- thread-root index
+
+class TestThreadRootIndex:
+    SRC = """
+        import signal
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        from http.server import BaseHTTPRequestHandler
+
+        def work(i):
+            return i
+
+        class App:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+                self._ex = ThreadPoolExecutor(4)
+                for i in range(4):
+                    self._ex.submit(work, i)
+                signal.signal(signal.SIGTERM, self._on_term)
+
+                class Handler(BaseHTTPRequestHandler):
+                    def do_GET(self):
+                        pass
+
+                self.handler = Handler
+
+            def _run(self):
+                pass
+
+            def _on_term(self, *a):
+                pass
+    """
+
+    def _project(self, tmp_path, src=None):
+        (tmp_path / "app.py").write_text(textwrap.dedent(src or self.SRC))
+        return load_project([str(tmp_path)], root=str(tmp_path))
+
+    def test_discovers_every_root_kind(self, tmp_path):
+        idx = thread_root_index(self._project(tmp_path))
+        kinds = {e["kind"] for e in idx}
+        assert {"thread", "executor", "signal", "handler"} <= kinds
+        ex = [e for e in idx if e["kind"] == "executor"]
+        assert ex and all(e["multi"] for e in ex)
+
+    def test_digest_stable_and_spawn_sensitive(self, tmp_path):
+        d1 = thread_root_digest(self._project(tmp_path))
+        d2 = thread_root_digest(self._project(tmp_path))
+        assert d1 == d2
+        extra = self.SRC + """
+        class Second:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True)
+                self._t.start()
+
+            def _loop(self):
+                pass
+        """
+        d3 = thread_root_digest(self._project(tmp_path, src=extra))
+        assert d3 != d1
+
+    def test_repo_threading_model_is_nonempty(self):
+        """The docs' threading-model inventory has substance: the real
+        package exposes daemon loops, per-request handlers, executor
+        fan-outs, and a signal hook."""
+        import os
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "mmlspark_tpu")
+        idx = thread_root_index(load_project([pkg]))
+        kinds = {e["kind"] for e in idx}
+        assert {"thread", "executor", "signal", "handler"} <= kinds
+        assert len(idx) >= 20
+        files = {e["file"] for e in idx}
+        assert any("supervisor" in f for f in files)
+        assert any("server" in f for f in files)
+
+
+# ------------------------------------------------------- incremental + jobs
+
+class TestRaceIncremental:
+    def _run(self, tmp_path, **kw):
+        from mmlspark_tpu.analysis.incremental import run_changed_only
+        return run_changed_only(
+            [str(tmp_path / "proj")], root=str(tmp_path / "proj"),
+            rules=["races"],
+            cache_path=str(tmp_path / "cache.json"), **kw)
+
+    def test_unchanged_tree_is_pure_cache_hit(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "mod.py").write_text(textwrap.dedent(SEEDED_RACE))
+        fs1, stats1 = self._run(tmp_path)
+        assert stats1["project_rules_run"] is True
+        assert rules_of(fs1) == ["race-unguarded-write"]
+        # unchanged tree: NO race rule runs, findings replay from cache
+        fs2, stats2 = self._run(tmp_path)
+        assert stats2["analyzed_files"] == 0
+        assert stats2["project_rules_run"] is False
+        assert stats2["cache_hit"] is True
+        assert [f.fingerprint() for f in fs2] == \
+            [f.fingerprint() for f in fs1]
+
+    def test_new_spawn_site_reruns_family(self, tmp_path):
+        proj = tmp_path / "proj"
+        proj.mkdir()
+        (proj / "mod.py").write_text(textwrap.dedent(SEEDED_RACE))
+        self._run(tmp_path)
+        (proj / "mod.py").write_text(textwrap.dedent(
+            SEEDED_RACE
+            .replace("self._x = 1",
+                     "self._x = 1\n            self._y = 1")
+            .replace("self._x = 2",
+                     "self._x = 2\n            self._y = 2")))
+        fs, stats = self._run(tmp_path)
+        assert stats["project_rules_run"] is True
+        assert {f.rule for f in fs} == {"race-unguarded-write"}
+        assert {m for f in fs for m in ("_x", "_y") if m in f.message} \
+            == {"_x", "_y"}
+
+
+class TestJobsParity:
+    def test_jobs_matches_serial(self, tmp_path):
+        """--jobs N must produce byte-identical findings to serial —
+        the pool partitions work, never semantics."""
+        (tmp_path / "a.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x)
+        """))
+        (tmp_path / "b.py").write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def g(x):
+                if x > 0:
+                    return x
+                return -x
+        """))
+        (tmp_path / "c.py").write_text(textwrap.dedent(SEEDED_RACE))
+        serial = run_analysis([str(tmp_path)], root=str(tmp_path))
+        parallel = run_analysis([str(tmp_path)], root=str(tmp_path),
+                                jobs=2)
+        assert [f.fingerprint() for f in serial] == \
+            [f.fingerprint() for f in parallel]
+        assert [f.line for f in serial] == [f.line for f in parallel]
+        assert {"jit-host-sync", "jit-traced-branch",
+                "race-unguarded-write"} <= {f.rule for f in serial}
+
+
+class TestRaceCIOutput:
+    def test_sarif_and_findings_gauge_carry_race_family(self, tmp_path,
+                                                        capsys):
+        """CI ingestion: race findings ride the same SARIF log and the
+        mmlspark_graftlint_findings{family="races"} gauge as every
+        other family."""
+        from mmlspark_tpu.analysis.cli import main as graftlint_main
+        (tmp_path / "mod.py").write_text(textwrap.dedent(SEEDED_RACE))
+        out = tmp_path / "out.sarif"
+        telemetry.registry.reset()
+        telemetry.enable()
+        try:
+            rc = graftlint_main([str(tmp_path), "--no-baseline",
+                                 "--sarif", str(out), "--format", "json"])
+            capsys.readouterr()
+            assert rc == 1
+            sarif = json.loads(out.read_text())
+            run = sarif["runs"][0]
+            ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+            assert "race-unguarded-write" in ids
+            assert any(res["ruleId"] == "race-unguarded-write"
+                       for res in run["results"])
+            text = telemetry.prometheus_text()
+            assert ('mmlspark_graftlint_findings{family="races"} 1'
+                    in text)
+        finally:
+            telemetry.disable()
+            telemetry.registry.reset()
+
+
+# ------------------------------------------------------------ the sanitizer
+
+class TestRaceSanitizer:
+    @pytest.fixture
+    def armed(self, monkeypatch):
+        from mmlspark_tpu.analysis import sanitize_races
+        monkeypatch.setenv("MMLSPARK_TPU_SANITIZE", "races")
+        telemetry.registry.reset()
+        telemetry.enable()
+        sanitize_races.clear()
+        yield sanitize_races
+        telemetry.disable()
+        telemetry.registry.reset()
+        monkeypatch.delenv("MMLSPARK_TPU_SANITIZE")
+        sanitize_races.clear()
+
+    def test_disarmed_is_a_noop(self, monkeypatch):
+        from mmlspark_tpu.analysis import sanitize_races
+        monkeypatch.delenv("MMLSPARK_TPU_SANITIZE", raising=False)
+        sanitize_races.clear()
+        obj = _SeededCounter()
+        assert sanitize_races.instrument(
+            obj, fields=("_x",), locks=("_lock",)) is obj
+        # no TrackedLock wrapping, no trapping — zero-overhead path
+        assert isinstance(obj._lock, type(threading.Lock()))
+        obj._x = 1
+        t = threading.Thread(target=lambda: setattr(obj, "_x", 2))
+        t.start()
+        t.join()
+        assert obj._x == 2
+
+    def test_seeded_race_trapped_at_runtime(self, armed):
+        """The dynamic half of the seeded-race contract: the SAME shape
+        the static rule flags (SEEDED_RACE) raises RaceConflict when the
+        second thread's unlocked write lands."""
+        obj = armed.instrument(_SeededCounter(), fields=("_x",),
+                               locks=("_lock",), label="seeded")
+        obj._x = 1                       # unlocked write, main thread
+        trapped = []
+
+        def other():
+            try:
+                obj._x = 2               # unlocked write, second thread
+            except armed.RaceConflict as e:
+                trapped.append(e)
+
+        t = threading.Thread(target=other, name="seeded-writer")
+        t.start()
+        t.join()
+        assert len(trapped) == 1
+        msg = str(trapped[0])
+        assert "_x" in msg and "seeded-writer" in msg
+        assert "no locks" in msg
+        text = telemetry.prometheus_text()
+        assert "mmlspark_sanitizer_race_conflicts_total 1" in text
+        accesses = [ln for ln in text.splitlines()
+                    if ln.startswith("mmlspark_sanitizer_race_accesses"
+                                     "_total ")]
+        assert accesses and float(accesses[0].split()[-1]) >= 2
+
+    def test_locked_writes_do_not_trap(self, armed):
+        obj = armed.instrument(_SeededCounter(), fields=("_x",),
+                               locks=("_lock",), label="clean")
+        with obj._lock:
+            obj._x = 1
+
+        def other():
+            with obj._lock:
+                obj._x = 2
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert obj._x == 2     # no RaceConflict on either side
+
+    def test_locked_write_unlocked_read_is_benign(self, armed):
+        """The monotonic-probe idiom (fleet.py reads _offset lock-free
+        while the writer holds _lock) must NOT trap — only an unlocked
+        WRITE side is a race."""
+        obj = armed.instrument(_SeededCounter(), fields=("_x",),
+                               locks=("_lock",), label="probe")
+        with obj._lock:
+            obj._x = 7
+        seen = []
+        t = threading.Thread(target=lambda: seen.append(obj._x))
+        t.start()
+        t.join()
+        assert seen == [7]
+
+    def test_thread_dump_joins_stacks_and_locks(self, armed):
+        obj = armed.instrument(_SeededCounter(), fields=("_x",),
+                               locks=("_lock",), label="dump")
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with obj._lock:
+                entered.set()
+                release.wait(10)
+
+        t = threading.Thread(target=holder, name="lock-holder",
+                             daemon=True)
+        t.start()
+        assert entered.wait(10)
+        try:
+            doc = armed.thread_dump(note=False)
+            assert doc["armed"] is True
+            assert doc["n_threads"] >= 2
+            mine = [th for th in doc["threads"]
+                    if th["name"] == "lock-holder"]
+            assert mine and mine[0]["held_locks"] == ["dump._lock"]
+            assert any("holder" in ln for ln in mine[0]["stack"])
+            assert mine[0]["top"]
+        finally:
+            release.set()
+            t.join()
+
+
+# ------------------------------------------------------- /debug/threads
+
+class TestDebugThreadsEndpoint:
+    def _get_json(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+
+    def test_serving_port_serves_thread_dump(self, tmp_path):
+        from mmlspark_tpu.io.http.server import HTTPSource
+        telemetry.flight.enable(str(tmp_path))
+        src = HTTPSource(name="threads-test")
+        try:
+            code, doc = self._get_json(src.url + "debug/threads")
+            assert code == 200
+            assert doc["n_threads"] >= 2      # main + serve_forever
+            names = {t["name"] for t in doc["threads"]}
+            assert any("http" in n or "Thread" in n or "Main" in n
+                       for n in names)
+            for t in doc["threads"]:
+                assert {"name", "ident", "daemon", "top", "held_locks",
+                        "stack"} <= set(t)
+            # the dump is mirrored into the flight ring
+            ring = telemetry.flight.bundle("test")["events"]
+            assert any(e.get("name") == "debug/threads" for e in ring)
+        finally:
+            src.close()
+            telemetry.flight.disable()
+            telemetry.flight.clear()
+
+    def test_worker_control_port_serves_thread_dump(self):
+        from mmlspark_tpu.io.http.worker import WorkerServer
+        ws = WorkerServer()
+        try:
+            code, doc = self._get_json(
+                f"http://127.0.0.1:{ws.control_port}/debug/threads")
+            assert code == 200
+            names = {t["name"] for t in doc["threads"]}
+            assert "http-control" in names
+            assert all("held_locks" in t for t in doc["threads"])
+        finally:
+            ws.close()
